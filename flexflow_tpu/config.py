@@ -1,0 +1,191 @@
+"""Runtime configuration and command-line flags.
+
+TPU-native counterpart of the reference's FFConfig (include/flexflow/config.h:92-160)
+and FFConfig::parse_args (src/runtime/model.cc:3596-3731). Instead of Legion
+`-ll:gpu` worker counts, the device pool is the set of JAX devices (TPU chips),
+organized into a `jax.sharding.Mesh` by the strategy layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import List, Optional, Sequence
+
+from .ffconst import CompMode
+
+# Hard limits mirroring config.h:40-53 (informational; nothing in the TPU
+# runtime statically allocates against them).
+MAX_NUM_INPUTS = 2048
+MAX_NUM_WEIGHTS = 2048
+MAX_NUM_OUTPUTS = 2048
+MAX_NUM_WORKERS = 8192
+MAX_TENSOR_DIM = 8
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration attributes (reference: config.h:162-167)."""
+
+    seq_length: int = -1
+
+    def reset(self) -> None:
+        self.seq_length = -1
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global configuration.
+
+    Flags mirror the reference CLI surface (README.md:45-74): `-b/--batch-size`,
+    `-e/--epochs`, `--budget/--search-budget`, `--alpha/--search-alpha`,
+    `--only-data-parallel`, `--enable-parameter-parallel`,
+    `--enable-attribute-parallel`, `--search-overlap-backward-update`,
+    `--base-optimize-threshold`, `--substitution-json`, `--export`/`--import`,
+    `--memory-search`, `--profiling`, `--fusion`.
+    """
+
+    batch_size: int = 64
+    epochs: int = 1
+    iterations: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # Device pool. num_devices=None -> all visible JAX devices.
+    num_devices: Optional[int] = None
+    num_nodes: int = 1
+    # Search knobs
+    search_budget: int = 0
+    search_alpha: float = 1.2
+    base_optimize_threshold: int = 10
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = False
+    search_overlap_backward_update: bool = False
+    memory_search: bool = False
+    memory_budget_mb: float = 16 * 1024.0  # per-chip HBM budget for memory-aware search
+    substitution_json_path: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    export_strategy_computation_graph_file: Optional[str] = None
+    export_strategy_task_graph_file: Optional[str] = None
+    include_costs_dot_graph: bool = False
+    # Execution knobs
+    computation_mode: CompMode = CompMode.COMP_MODE_TRAINING
+    profiling: bool = False
+    perform_fusion: bool = False
+    seed: int = 0
+    # Numerics: compute dtype for matmul-heavy ops (MXU-friendly default).
+    allow_mixed_precision: bool = True
+    simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
+    machine_model_version: int = 0
+    machine_model_file: Optional[str] = None
+    print_freq: int = 10
+    iteration_config: FFIterationConfig = dataclasses.field(
+        default_factory=FFIterationConfig
+    )
+
+    @classmethod
+    def from_command_line(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        """Build a config from CLI flags (reference: FFConfig ctor parses argv).
+        Explicitly opt-in — plain FFConfig() never touches sys.argv, so library
+        users' own flags are not hijacked."""
+        cfg = cls()
+        cfg.parse_args(sys.argv[1:] if argv is None else argv)
+        return cfg
+
+    # -- flag parsing (reference: model.cc:3596-3731) ---------------------
+    def parse_args(self, argv: Sequence[str]) -> List[str]:
+        """Consume known flags from argv; returns the unconsumed remainder."""
+        rest: List[str] = []
+        i = 0
+        args = list(argv)
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            if i >= len(args):
+                raise ValueError(f"flag {args[i - 1]!r} requires a value")
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-b", "--batch-size"):
+                self.batch_size = int(take())
+            elif a in ("-e", "--epochs"):
+                self.epochs = int(take())
+            elif a in ("-i", "--iterations"):
+                self.iterations = int(take())
+            elif a in ("--lr", "--learning-rate"):
+                self.learning_rate = float(take())
+            elif a in ("--wd", "--weight-decay"):
+                self.weight_decay = float(take())
+            elif a in ("--budget", "--search-budget"):
+                self.search_budget = int(take())
+            elif a in ("--alpha", "--search-alpha"):
+                self.search_alpha = float(take())
+            elif a == "--base-optimize-threshold":
+                self.base_optimize_threshold = int(take())
+            elif a == "--only-data-parallel":
+                self.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                self.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                self.enable_attribute_parallel = True
+            elif a == "--search-overlap-backward-update":
+                self.search_overlap_backward_update = True
+            elif a == "--memory-search":
+                self.memory_search = True
+            elif a == "--memory-budget":
+                self.memory_budget_mb = float(take())
+            elif a == "--substitution-json":
+                self.substitution_json_path = take()
+            elif a == "--export":
+                self.export_strategy_file = take()
+            elif a == "--import":
+                self.import_strategy_file = take()
+            elif a == "--export-strategy-computation-graph-file":
+                self.export_strategy_computation_graph_file = take()
+            elif a == "--export-strategy-task-graph-file":
+                self.export_strategy_task_graph_file = take()
+            elif a == "--include-costs-dot-graph":
+                self.include_costs_dot_graph = True
+            elif a == "--profiling":
+                self.profiling = True
+            elif a == "--fusion":
+                self.perform_fusion = True
+            elif a == "--seed":
+                self.seed = int(take())
+            elif a == "--nodes":
+                self.num_nodes = int(take())
+            elif a in ("--chips", "-ll:gpu"):
+                # `-ll:gpu N` accepted for reference-script compatibility.
+                self.num_devices = int(take())
+            elif a == "--machine-model-version":
+                self.machine_model_version = int(take())
+            elif a == "--machine-model-file":
+                self.machine_model_file = take()
+            elif a == "--simulator-workspace-size":
+                self.simulator_work_space_size = int(take())
+            elif a == "--print-freq":
+                self.print_freq = int(take())
+            else:
+                rest.append(a)
+            i += 1
+        return rest
+
+    @property
+    def workers_per_node(self) -> int:
+        return max(1, self.total_devices // max(1, self.num_nodes))
+
+    @property
+    def total_devices(self) -> int:
+        if self.num_devices is not None:
+            return self.num_devices
+        import jax
+
+        return len(jax.devices())
+
+    def get_current_time(self) -> float:
+        import time
+
+        return time.time() * 1e6  # microseconds, like Legion's timestamps

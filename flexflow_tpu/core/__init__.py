@@ -1,0 +1,11 @@
+from .tensor import ParallelDim, ParallelTensorShape, Tensor
+from .machine import MachineView, MachineResource, make_mesh
+
+__all__ = [
+    "ParallelDim",
+    "ParallelTensorShape",
+    "Tensor",
+    "MachineView",
+    "MachineResource",
+    "make_mesh",
+]

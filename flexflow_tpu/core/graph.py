@@ -1,0 +1,192 @@
+"""Parallel Computation Graph (PCG) structure + generic graph algorithms.
+
+TPU-native counterpart of the reference's `PCG::Graph` (include/flexflow/
+graph.h:293-377) and the header-only graph algorithm toolkit (dominators.h,
+basic_graph.h): edges, topological order, roots/leaves/sinks, post-dominators
+(used by the Unity search to find bottleneck split points), hashing, and dot
+export (src/utils/dot)."""
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .op import Op
+from .tensor import Tensor
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    """Dataflow edge (reference: graph.h:31): src op output -> dst op input."""
+
+    src: int  # op guid
+    dst: int  # op guid
+    src_idx: int
+    dst_idx: int
+
+
+class Graph:
+    """A PCG over Op nodes. Ops reference Tensors; edges derive from tensor
+    producer/consumer relationships."""
+
+    def __init__(self, ops: Sequence[Op] = ()):
+        self.ops: Dict[int, Op] = {}
+        for op in ops:
+            self.add_op(op)
+
+    def add_op(self, op: Op) -> None:
+        self.ops[op.guid] = op
+
+    def remove_op(self, op: Op) -> None:
+        del self.ops[op.guid]
+
+    def __len__(self):
+        return len(self.ops)
+
+    def __contains__(self, op: Op):
+        return op.guid in self.ops
+
+    # -- edges ------------------------------------------------------------
+    def edges(self) -> List[Edge]:
+        out: List[Edge] = []
+        for op in self.ops.values():
+            for dst_idx, t in enumerate(op.inputs):
+                if t.owner_op is not None and t.owner_op.guid in self.ops:
+                    out.append(Edge(t.owner_op.guid, op.guid, t.owner_idx, dst_idx))
+        return out
+
+    def in_edges(self, op: Op) -> List[Edge]:
+        return [e for e in self.edges() if e.dst == op.guid]
+
+    def out_edges(self, op: Op) -> List[Edge]:
+        return [e for e in self.edges() if e.src == op.guid]
+
+    def predecessors(self, op: Op) -> List[Op]:
+        seen, out = set(), []
+        for t in op.inputs:
+            o = t.owner_op
+            if o is not None and o.guid in self.ops and o.guid not in seen:
+                seen.add(o.guid)
+                out.append(o)
+        return out
+
+    def successors(self, op: Op) -> List[Op]:
+        out = []
+        for other in self.ops.values():
+            if op in self.predecessors(other):
+                out.append(other)
+        return out
+
+    # -- traversal --------------------------------------------------------
+    def topo_order(self) -> List[Op]:
+        indeg: Dict[int, int] = {g: 0 for g in self.ops}
+        succ: Dict[int, List[int]] = defaultdict(list)
+        for e in self.edges():
+            indeg[e.dst] += 1
+            succ[e.src].append(e.dst)
+        # stable order: seed queue by op guid (construction order)
+        q = deque(sorted(g for g, d in indeg.items() if d == 0))
+        order: List[Op] = []
+        while q:
+            g = q.popleft()
+            order.append(self.ops[g])
+            for s in sorted(set(succ[g])):
+                indeg[s] -= succ[g].count(s)
+                if indeg[s] == 0:
+                    q.append(s)
+        if len(order) != len(self.ops):
+            raise ValueError("PCG has a cycle")
+        return order
+
+    def roots(self) -> List[Op]:
+        dsts = {e.dst for e in self.edges()}
+        return [op for g, op in sorted(self.ops.items()) if g not in dsts]
+
+    def leaves(self) -> List[Op]:
+        srcs = {e.src for e in self.edges()}
+        return [op for g, op in sorted(self.ops.items()) if g not in srcs]
+
+    sinks = leaves
+    sources = roots
+
+    # -- dominators (reference: dominators.h; used for bottleneck splits) --
+    def post_dominators(self) -> Dict[int, Set[int]]:
+        """postdom[n] = set of nodes that post-dominate n (incl. n).
+
+        Standard iterative dataflow over the reversed DAG with a virtual sink.
+        """
+        order = self.topo_order()
+        guids = [op.guid for op in order]
+        succ: Dict[int, Set[int]] = defaultdict(set)
+        for e in self.edges():
+            succ[e.src].add(e.dst)
+        allg = set(guids)
+        postdom: Dict[int, Set[int]] = {g: set(allg) for g in guids}
+        changed = True
+        while changed:
+            changed = False
+            for g in reversed(guids):
+                ss = succ[g]
+                if not ss:
+                    new = {g}
+                else:
+                    new = set(allg)
+                    for s in ss:
+                        new &= postdom[s]
+                    new |= {g}
+                if new != postdom[g]:
+                    postdom[g] = new
+                    changed = True
+        return postdom
+
+    def bottleneck_nodes(self) -> List[Op]:
+        """Nodes that every source-to-sink path passes through (excluding
+        sources), in topological order — the Unity sequence-split candidates
+        (reference: graph.cc find_bottleneck_node)."""
+        order = self.topo_order()
+        if not order:
+            return []
+        postdom = self.post_dominators()
+        sources = self.roots()
+        if not sources:
+            return []
+        common = set.intersection(*[postdom[s.guid] for s in sources])
+        src_guids = {s.guid for s in sources}
+        return [op for op in order if op.guid in common and op.guid not in src_guids]
+
+    # -- hashing (reference: graph.h:149 dp_state_hash) --------------------
+    def hash(self) -> int:
+        h = 0
+        for op in self.topo_order():
+            oh = hash((op.op_type, tuple(t.dims for t in op.inputs)))
+            mv = op.machine_view.hash() if op.machine_view else 0
+            h = (h * 1000000007 + oh * 31 + mv) & 0x7FFFFFFFFFFFFFFF
+        return h
+
+    # -- subgraphs (for sequence splits) ----------------------------------
+    def split_at(self, op: Op) -> Tuple["Graph", "Graph"]:
+        """Split into (prefix including op, suffix) at a bottleneck node."""
+        order = self.topo_order()
+        idx = order.index(op)
+        pre = Graph(order[: idx + 1])
+        post = Graph(order[idx + 1 :])
+        return pre, post
+
+    # -- dot export (reference: --export-strategy-computation-graph-file) --
+    def to_dot(self, include_costs: bool = False, costs: Optional[Dict[int, float]] = None) -> str:
+        lines = ["digraph PCG {", "  rankdir=TB;"]
+        for g, op in sorted(self.ops.items()):
+            label = f"{op.name}\\n{op.op_type.value}"
+            if op.machine_view:
+                label += f"\\n{op.machine_view}"
+            if include_costs and costs and g in costs:
+                label += f"\\ncost={costs[g]:.3g}"
+            lines.append(f'  n{g} [label="{label}", shape=box];')
+        for e in self.edges():
+            lines.append(f"  n{e.src} -> n{e.dst};")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def export_dot(self, path: str, **kw) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_dot(**kw))

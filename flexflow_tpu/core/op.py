@@ -1,0 +1,180 @@
+"""Operator base class and registry.
+
+TPU-native re-design of the reference's `Op` (include/flexflow/operator.h:51-277).
+The reference Op carries Legion task launchers (init/forward/backward) plus
+profiling hooks; here an Op is a pure description: it computes output shapes at
+construction, declares its weights, and provides a single `lower()` that emits
+jax ops inside the traced train/inference step (forward only — backward comes
+from jax.grad, the TPU-native replacement for hand-written backward kernels).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType, OpType, ParameterSyncType
+from .machine import MachineView
+from .tensor import Parameter, Tensor
+
+_op_guid = itertools.count(1)
+
+
+@dataclasses.dataclass
+class WeightSpec:
+    """Declaration of one weight tensor of an op."""
+
+    name: str
+    dims: Tuple[int, ...]
+    dtype: DataType = DataType.DT_FLOAT
+    initializer: Optional[Any] = None  # runtime.initializers.Initializer
+    sync_type: ParameterSyncType = ParameterSyncType.NCCL
+
+
+class LoweringContext:
+    """State threaded through PCG lowering into a jax computation."""
+
+    def __init__(self, config, mode, mesh=None, rng_key=None):
+        self.config = config
+        self.mode = mode  # CompMode
+        self.mesh = mesh
+        self.rng_key = rng_key
+        self._rng_count = 0
+        # tensor guid -> traced jax value
+        self.values: Dict[int, Any] = {}
+
+        # non-trainable per-op state (e.g. batchnorm running stats):
+        # (op_name, var_name) -> traced value; lower() may write updates here.
+        self.state: Dict[Tuple[str, str], Any] = {}
+        self.state_updates: Dict[Tuple[str, str], Any] = {}
+        # auxiliary loss terms ops contribute (e.g. MoE load-balance loss);
+        # summed into the training objective by the executor.
+        self.aux_losses: List[Any] = []
+
+    def next_rng(self):
+        import jax
+
+        if self.rng_key is None:
+            raise RuntimeError("op needs an rng key but none was provided")
+        self._rng_count += 1
+        return jax.random.fold_in(self.rng_key, self._rng_count)
+
+    def constrain(self, value, tensor: Tensor):
+        """Apply the tensor's sharding as a constraint, if meshed + partitioned."""
+        if self.mesh is None or tensor.parallel_shape is None:
+            return value
+        spec = tensor.parallel_shape.partition_spec()
+        if all(p is None for p in spec):
+            return value
+        import jax
+
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(
+            value, NamedSharding(self.mesh, spec)
+        )
+
+
+class Op:
+    """Base operator. Subclasses implement shape inference + lowering."""
+
+    op_type: OpType = OpType.NOOP
+
+    def __init__(
+        self,
+        model,
+        inputs: Sequence[Tensor],
+        name: str = "",
+        **params,
+    ):
+        self.guid = next(_op_guid)
+        self.model = model
+        self.inputs: List[Tensor] = list(inputs)
+        self.params: Dict[str, Any] = params
+        self.name = name or f"{self.op_type.value}_{self.guid}"
+        self.machine_view: Optional[MachineView] = None
+        self.profiling = bool(model is not None and model.config.profiling)
+
+        out_dims, out_dtypes = self.output_shapes()
+        self.outputs: List[Tensor] = [
+            Tensor(dims, dtype, name=f"{self.name}.out{i}", owner_op=self, owner_idx=i)
+            for i, (dims, dtype) in enumerate(zip(out_dims, out_dtypes))
+        ]
+        self.weights: List[Parameter] = []
+        for ws in self.weight_specs():
+            p = Parameter(
+                ws.dims,
+                ws.dtype,
+                name=f"{self.name}.{ws.name}",
+                owner_op=self,
+                sync_type=ws.sync_type,
+                initializer=ws.initializer,
+            )
+            p._weight_spec = ws
+            self.weights.append(p)
+        self.state_vars: List[WeightSpec] = list(self.state_specs())
+
+    # -- subclass API -----------------------------------------------------
+    def output_shapes(self) -> Tuple[List[Tuple[int, ...]], List[DataType]]:
+        """Return (list of output dims, list of output dtypes)."""
+        raise NotImplementedError
+
+    def weight_specs(self) -> List[WeightSpec]:
+        return []
+
+    def state_specs(self) -> List[WeightSpec]:
+        """Non-trainable per-op state (e.g. running statistics)."""
+        return []
+
+    def lower(self, ctx: LoweringContext, inputs: List[Any], weights: Dict[str, Any]):
+        """Emit jax ops; return list of output values (one per output tensor)."""
+        raise NotImplementedError
+
+    # -- cost/analysis hooks (used by the simulator/search) ---------------
+    def flops(self) -> float:
+        """Forward FLOPs estimate; default 0 (elementwise ops dominated by BW)."""
+        return 0.0
+
+    def bytes_accessed(self) -> float:
+        n = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in self.inputs)
+        n += sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in self.outputs)
+        n += sum(w.num_elements() * w.dtype.np_dtype.itemsize for w in self.weights)
+        return float(n)
+
+    def is_parallel_op(self) -> bool:
+        return False
+
+    # -- identity/caching (reference: per-op Params structs + get_or_create_node)
+    def param_key(self) -> Tuple:
+        def freeze(v):
+            if isinstance(v, (list, tuple)):
+                return tuple(freeze(x) for x in v)
+            if isinstance(v, dict):
+                return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+            if isinstance(v, np.ndarray):
+                return (v.shape, v.dtype.str, v.tobytes())
+            if callable(v):
+                return getattr(v, "__name__", repr(v))
+            return v
+
+        return (
+            self.op_type,
+            tuple(t.guid for t in self.inputs),
+            freeze(self.params),
+        )
+
+    def __repr__(self):
+        ins = ",".join(str(t.dims) for t in self.inputs)
+        outs = ",".join(str(t.dims) for t in self.outputs)
+        return f"{self.op_type.value}[{self.name}]({ins})->({outs})"
+
+
+# registry: OpType -> Op subclass
+OP_REGISTRY: Dict[OpType, type] = {}
+
+
+def register_op(cls):
+    OP_REGISTRY[cls.op_type] = cls
+    return cls

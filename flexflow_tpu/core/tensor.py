@@ -1,0 +1,186 @@
+"""Logical and parallel tensors.
+
+TPU-native re-design of the reference's TensorBase (include/flexflow/tensor.h:29)
+and ParallelTensorBase (include/flexflow/parallel_tensor.h:134-198). The central
+idea is kept: a *parallel tensor* is a logical tensor whose every dimension
+carries a partition `degree` (plus replica dims). Where the reference realizes
+degrees as Legion index-space partitions, here each partitioned dim maps to a
+named mesh axis and the whole shape lowers to a `jax.sharding.NamedSharding`.
+
+Dimension order is row-major / numpy-style (batch first) — NOT the reference's
+Legion-reversed order.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import List, Optional, Sequence, Tuple, TYPE_CHECKING
+
+import numpy as np
+
+from ..ffconst import DataType, ParallelDimKind
+
+if TYPE_CHECKING:
+    from jax.sharding import Mesh, NamedSharding
+
+_guid_counter = itertools.count(1000)
+
+
+@dataclasses.dataclass
+class ParallelDim:
+    """One dimension of a parallel tensor (reference: parallel_tensor.h:36-71).
+
+    size: global extent of this dim.
+    degree: number of shards (1 = not partitioned).
+    axis: mesh-axis name this dim is sharded over (None iff degree == 1).
+    is_replica_dim: true for pure replication dims (size == degree; no data).
+    kind: semantic kind used by the strategy search.
+    """
+
+    size: int
+    degree: int = 1
+    axis: Optional[str] = None
+    is_replica_dim: bool = False
+    kind: ParallelDimKind = ParallelDimKind.ATTRIBUTE
+
+    def __post_init__(self):
+        if self.degree > 1 and self.axis is None:
+            raise ValueError("partitioned dim needs a mesh axis name")
+        if self.size % self.degree != 0:
+            raise ValueError(
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+
+@dataclasses.dataclass
+class ParallelTensorShape:
+    """Shape of a parallel tensor (reference: parallel_tensor.h:76-111)."""
+
+    dims: List[ParallelDim]
+    dtype: DataType
+
+    @property
+    def num_replicas(self) -> int:
+        n = 1
+        for d in self.dims:
+            if d.is_replica_dim:
+                n *= d.degree
+        return n
+
+    @property
+    def data_dims(self) -> List[ParallelDim]:
+        return [d for d in self.dims if not d.is_replica_dim]
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """Global (unsharded) data shape, replica dims excluded."""
+        return tuple(d.size for d in self.data_dims)
+
+    @property
+    def local_shape(self) -> Tuple[int, ...]:
+        return tuple(d.size // d.degree for d in self.data_dims)
+
+    def total_degree(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d.degree
+        return n
+
+    def num_elements(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def piece_elements(self) -> int:
+        return int(np.prod(self.local_shape)) if self.local_shape else 1
+
+    def partition_spec(self):
+        """PartitionSpec over the data dims (replica dims -> replication)."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(*[d.axis if d.degree > 1 else None for d in self.data_dims])
+
+    def sharding(self, mesh: "Mesh") -> "NamedSharding":
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.partition_spec())
+
+    def __str__(self):
+        parts = []
+        for d in self.dims:
+            tag = "r" if d.is_replica_dim else ""
+            parts.append(f"{d.size}{tag}/{d.degree}" + (f"@{d.axis}" if d.axis else ""))
+        return f"[{', '.join(parts)}]:{self.dtype.value}"
+
+
+class Tensor:
+    """A tensor in the computation graph.
+
+    Covers both roles of the reference's TensorBase (frontend-visible logical
+    tensor) and ParallelTensorBase (post-compile tensor with partition degrees):
+    before `compile()` only `dims`/`dtype` are meaningful; compile attaches a
+    `ParallelTensorShape` in `parallel_shape` once the strategy is chosen.
+    """
+
+    def __init__(
+        self,
+        dims: Sequence[int],
+        dtype: DataType = DataType.DT_FLOAT,
+        name: str = "",
+        owner_op=None,
+        owner_idx: int = 0,
+        create_gradients: bool = True,
+    ):
+        self.guid: int = next(_guid_counter)
+        self.dims: Tuple[int, ...] = tuple(int(d) for d in dims)
+        self.dtype = dtype
+        self.name = name or f"tensor_{self.guid}"
+        self.owner_op = owner_op  # producing Op (None for graph inputs)
+        self.owner_idx = owner_idx
+        self.create_gradients = create_gradients
+        self.parallel_shape: Optional[ParallelTensorShape] = None
+        # host-attached initial value (reference: attach_raw_ptr / set_tensor)
+        self._host_value: Optional[np.ndarray] = None
+        # model backref, set by FFModel for weight get/set convenience
+        self._model = None
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    def num_elements(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    # -- host I/O (reference: parallel_tensor.h:164-169 set_tensor/get_tensor)
+    def set_tensor(self, model, value: np.ndarray) -> bool:
+        value = np.asarray(value, dtype=self.dtype.np_dtype)
+        if tuple(value.shape) != self.dims:
+            raise ValueError(f"shape mismatch: {value.shape} vs {self.dims}")
+        self._host_value = value
+        if model is not None:
+            model._set_tensor_value(self, value)
+        return True
+
+    def get_tensor(self, model) -> np.ndarray:
+        if model is not None:
+            arr = model._get_tensor_value(self)
+            if arr is not None:
+                return np.asarray(arr)
+        if self._host_value is not None:
+            return self._host_value
+        raise RuntimeError(f"tensor {self.name} has no materialized value")
+
+    def attach_numpy_array(self, value: np.ndarray) -> None:
+        self._host_value = np.ascontiguousarray(value, dtype=self.dtype.np_dtype)
+
+    def __repr__(self):
+        ps = f" {self.parallel_shape}" if self.parallel_shape else ""
+        return f"Tensor({self.name}, dims={self.dims}, {self.dtype.value}{ps})"
+
+
+# Weight tensors are plain Tensors flagged as parameters.
+class Parameter(Tensor):
+    def __init__(self, *args, sync_type=None, initializer=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..ffconst import ParameterSyncType
+
+        self.sync_type = sync_type or ParameterSyncType.NCCL
+        self.initializer = initializer

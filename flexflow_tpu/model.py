@@ -1,0 +1,740 @@
+"""FFModel: the user-facing model container and layer API.
+
+TPU-native re-design of the reference's FFModel (include/flexflow/model.h:326-958,
+src/runtime/model.cc). The layer-building methods mirror model.h:336-554 /
+python flexflow_cffi.py:887+ signatures; `compile()` (reference model.cc:2803)
+chooses a parallelization strategy, builds the device mesh, and compiles the
+whole training iteration with XLA; `fit()` mirrors flexflow_cffi.py:2062.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import FFConfig
+from .core.graph import Graph
+from .core.machine import MachineView, data_parallel_view, make_mesh
+from .core.op import OP_REGISTRY, Op
+from .core.tensor import ParallelDim, ParallelTensorShape, Tensor
+from .ffconst import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpType,
+    ParallelDimKind,
+    PoolType,
+)
+from .runtime.executor import Executor
+from .runtime.losses import Loss, loss_fn_for
+from .runtime.metrics import Metrics, PerfMetrics
+from .runtime.optimizers import Optimizer, SGDOptimizer
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.ops: List[Op] = []
+        self.input_ops: List[Op] = []
+        self.optimizer: Optional[Optimizer] = None
+        self.loss: Optional[Loss] = None
+        self.metrics: Optional[Metrics] = None
+        self.label_tensor: Optional[Tensor] = None
+        self.final_tensor: Optional[Tensor] = None
+        self.graph: Optional[Graph] = None
+        self.executor: Optional[Executor] = None
+        self.mesh = None
+        self.params = None
+        self.opt_state = None
+        self.state = None
+        self.perf_metrics = PerfMetrics()
+        self._rng_seed = self.config.seed
+        self._step_count = 0
+        self._compiled = False
+        self._recompile_state = None
+        self._dataloaders: List[Any] = []
+        # node-key cache (reference: get_or_create_node, model.h:678-706)
+        self._op_cache: Dict[Tuple, Op] = {}
+
+    # ------------------------------------------------------------------
+    # tensor & op creation
+    # ------------------------------------------------------------------
+    def create_tensor(
+        self,
+        dims: Sequence[int],
+        dtype: DataType = DataType.DT_FLOAT,
+        create_grad: bool = True,
+        name: str = "",
+    ) -> Tensor:
+        op = OP_REGISTRY[OpType.INPUT](
+            self, [], name=name or f"input_{len(self.input_ops)}",
+            dims=tuple(dims), dtype=dtype,
+        )
+        self.ops.append(op)
+        self.input_ops.append(op)
+        t = op.outputs[0]
+        t.create_gradients = create_grad
+        t._model = self
+        return t
+
+    def _add_op(self, op_type: OpType, inputs: Sequence[Tensor], name: str = "", **params) -> Op:
+        cls = OP_REGISTRY[op_type]
+        op = cls(self, list(inputs), name=name, **params)
+        self.ops.append(op)
+        for t in op.outputs:
+            t._model = self
+        return op
+
+    def _unary(self, op_type, x, name="", **params) -> Tensor:
+        return self._add_op(op_type, [x], name, **params).outputs[0]
+
+    def _binary(self, op_type, x, y, name="") -> Tensor:
+        return self._add_op(op_type, [x, y], name).outputs[0]
+
+    # -- elementwise (reference model.h:336-400) ------------------------
+    def exp(self, x, name=""):
+        return self._unary(OpType.EXP, x, name)
+
+    def sin(self, x, name=""):
+        return self._unary(OpType.SIN, x, name)
+
+    def cos(self, x, name=""):
+        return self._unary(OpType.COS, x, name)
+
+    def pow(self, x, exponent, name=""):
+        return self._unary(OpType.POW, x, name, exponent=exponent)
+
+    def rsqrt(self, x, name=""):
+        return self._unary(OpType.RSQRT, x, name)
+
+    def add(self, x, y, name=""):
+        return self._binary(OpType.EW_ADD, x, y, name)
+
+    def subtract(self, x, y, name=""):
+        return self._binary(OpType.EW_SUB, x, y, name)
+
+    def multiply(self, x, y, name=""):
+        return self._binary(OpType.EW_MUL, x, y, name)
+
+    def divide(self, x, y, name=""):
+        return self._binary(OpType.EW_DIV, x, y, name)
+
+    def max(self, x, y, name=""):
+        return self._binary(OpType.EW_MAX, x, y, name)
+
+    def min(self, x, y, name=""):
+        return self._binary(OpType.EW_MIN, x, y, name)
+
+    def scalar_multiply(self, x, scalar, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_MULTIPLY, x, name, scalar=scalar)
+
+    def scalar_add(self, x, scalar, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_TRUE_DIV, x, name, scalar=scalar)
+
+    def relu(self, x, name=""):
+        return self._unary(OpType.RELU, x, name)
+
+    def identity(self, x, name=""):
+        return self._unary(OpType.IDENTITY, x, name)
+
+    def sigmoid(self, x, name=""):
+        return self._unary(OpType.SIGMOID, x, name)
+
+    def tanh(self, x, name=""):
+        return self._unary(OpType.TANH, x, name)
+
+    def elu(self, x, inplace=True, name=""):
+        return self._unary(OpType.ELU, x, name)
+
+    def gelu(self, x, name=""):
+        return self._unary(OpType.GELU, x, name)
+
+    # -- dense / conv / pool / norm (reference model.h:401-470) ----------
+    def dense(
+        self,
+        input: Tensor,
+        out_dim: int,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        use_bias: bool = True,
+        datatype: Optional[DataType] = None,
+        shared_op=None,
+        kernel_initializer=None,
+        bias_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.LINEAR,
+            [input],
+            name,
+            out_dim=out_dim,
+            activation=activation,
+            use_bias=use_bias,
+            dtype=datatype,
+            kernel_initializer=kernel_initializer,
+            bias_initializer=bias_initializer,
+        ).outputs[0]
+
+    def conv2d(
+        self,
+        input: Tensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        groups: int = 1,
+        use_bias: bool = True,
+        shared_op=None,
+        kernel_initializer=None,
+        bias_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.CONV2D,
+            [input],
+            name,
+            out_channels=out_channels,
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            activation=activation,
+            groups=groups,
+            use_bias=use_bias,
+            kernel_initializer=kernel_initializer,
+            bias_initializer=bias_initializer,
+        ).outputs[0]
+
+    def pool2d(
+        self,
+        input: Tensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: PoolType = PoolType.POOL_MAX,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.POOL2D,
+            [input],
+            name,
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            pool_type=pool_type,
+            activation=activation,
+        ).outputs[0]
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
+        return self._add_op(OpType.BATCHNORM, [input], name, relu=relu).outputs[0]
+
+    def layer_norm(
+        self,
+        input: Tensor,
+        axes: Sequence[int],
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        name: str = "",
+    ) -> Tensor:
+        axes = [a if a >= 0 else input.num_dims + a for a in axes]
+        return self._add_op(
+            OpType.LAYERNORM, [input], name,
+            axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps,
+        ).outputs[0]
+
+    def softmax(self, input: Tensor, axis: int = -1, name: str = "") -> Tensor:
+        return self._add_op(OpType.SOFTMAX, [input], name, axis=axis).outputs[0]
+
+    def flat(self, input: Tensor, name: str = "") -> Tensor:
+        return self._add_op(OpType.FLAT, [input], name).outputs[0]
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name: str = "") -> Tensor:
+        return self._add_op(OpType.DROPOUT, [input], name, rate=rate, seed=seed).outputs[0]
+
+    # -- embedding / attention ------------------------------------------
+    def embedding(
+        self,
+        input: Tensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+        dtype: DataType = DataType.DT_FLOAT,
+        shared_op=None,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.EMBEDDING,
+            [input],
+            name,
+            num_entries=num_entries,
+            out_dim=out_dim,
+            aggr=aggr,
+            dtype=dtype,
+            kernel_initializer=kernel_initializer,
+        ).outputs[0]
+
+    def multihead_attention(
+        self,
+        query: Tensor,
+        key: Tensor,
+        value: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        bias: bool = True,
+        add_bias_kv: bool = False,
+        add_zero_attn: bool = False,
+        causal: bool = False,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.MULTIHEAD_ATTENTION,
+            [query, key, value],
+            name,
+            embed_dim=embed_dim,
+            num_heads=num_heads,
+            kdim=kdim or None,
+            vdim=vdim or None,
+            dropout=dropout,
+            bias=bias,
+            add_bias_kv=add_bias_kv,
+            add_zero_attn=add_zero_attn,
+            causal=causal,
+            kernel_initializer=kernel_initializer,
+        ).outputs[0]
+
+    # -- shape ops -------------------------------------------------------
+    def concat(self, tensors: Sequence[Tensor], axis: int, name: str = "") -> Tensor:
+        return self._add_op(OpType.CONCAT, list(tensors), name, axis=axis).outputs[0]
+
+    def split(self, input: Tensor, sizes, axis: int, name: str = "") -> List[Tensor]:
+        if isinstance(sizes, int):
+            assert input.dims[axis] % sizes == 0
+            sizes = [input.dims[axis] // sizes] * sizes
+        return self._add_op(
+            OpType.SPLIT, [input], name, sizes=tuple(sizes), axis=axis
+        ).outputs
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name: str = "") -> Tensor:
+        return self._add_op(OpType.RESHAPE, [input], name, shape=tuple(shape)).outputs[0]
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name: str = "") -> Tensor:
+        return self._add_op(OpType.TRANSPOSE, [input], name, perm=tuple(perm)).outputs[0]
+
+    def reverse(self, input: Tensor, axis: int, name: str = "") -> Tensor:
+        return self._add_op(OpType.REVERSE, [input], name, axis=axis).outputs[0]
+
+    def cast(self, input: Tensor, dtype: DataType, name: str = "") -> Tensor:
+        return self._add_op(OpType.CAST, [input], name, dtype=dtype).outputs[0]
+
+    def gather(self, input: Tensor, index: Tensor, dim: int = 0, name: str = "") -> Tensor:
+        return self._add_op(OpType.GATHER, [input, index], name, axis=dim).outputs[0]
+
+    def reduce_sum(self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: str = "") -> Tensor:
+        return self._add_op(
+            OpType.REDUCE_SUM, [input], name, axes=tuple(axes), keepdims=keepdims
+        ).outputs[0]
+
+    def mean(self, input: Tensor, dims: Sequence[int], keepdims: bool = False, name: str = "") -> Tensor:
+        return self._add_op(
+            OpType.MEAN, [input], name, axes=tuple(dims), keepdims=keepdims
+        ).outputs[0]
+
+    def batch_matmul(
+        self, A: Tensor, B: Tensor,
+        a_seq_length_dim: int = -1, b_seq_length_dim: int = -1, name: str = "",
+    ) -> Tensor:
+        return self._add_op(
+            OpType.BATCHMATMUL, [A, B], name,
+            a_seq_length_dim=a_seq_length_dim, b_seq_length_dim=b_seq_length_dim,
+        ).outputs[0]
+
+    # -- MoE (reference model.h:509-514, src/ops/{topk,group_by,aggregate,cache}.cc)
+    def top_k(self, input: Tensor, k: int, sorted: bool = False, name: str = "") -> Tuple[Tensor, Tensor]:
+        outs = self._add_op(OpType.TOPK, [input], name, k=k, sorted=sorted).outputs
+        return outs[0], outs[1]
+
+    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float = 1.0, name: str = "") -> List[Tensor]:
+        return self._add_op(
+            OpType.GROUP_BY, [input, assign], name, n=n, alpha=alpha
+        ).outputs
+
+    def aggregate(
+        self, gate_preds, gate_assign, true_gate_assign, full_gate_grads,
+        exp_preds: Sequence[Tensor], n: int, lambda_bal: float = 0.0, name: str = "",
+    ) -> Tensor:
+        ins = [gate_preds, gate_assign, true_gate_assign, full_gate_grads] + list(exp_preds)
+        return self._add_op(
+            OpType.AGGREGATE, ins, name, n=n, lambda_bal=lambda_bal
+        ).outputs[0]
+
+    def aggregate_spec(self, inputs: Sequence[Tensor], n: int, lambda_bal: float = 0.0, name: str = "") -> Tensor:
+        return self._add_op(OpType.AGGREGATE_SPEC, list(inputs), name, n=n, lambda_bal=lambda_bal).outputs[0]
+
+    def cache(self, input: Tensor, num_batches: int = 1, name: str = "") -> Tensor:
+        return self._add_op(OpType.CACHE, [input], name, num_batches=num_batches).outputs[0]
+
+    def moe(
+        self,
+        input: Tensor,
+        num_exp: int,
+        num_select: int,
+        expert_hidden_size: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.0,
+        name: str = "",
+    ) -> Tensor:
+        """MoE block (reference: FFModel::moe, model.h:509-514 / moe.cc):
+        gating softmax → topk → group_by → per-expert dense → aggregate."""
+        gate = self.dense(input, num_exp, ActiMode.AC_MODE_NONE, name=f"{name}_gate")
+        gate = self.softmax(gate)
+        topk_out, topk_idx = self.top_k(gate, num_select)
+        grouped = self.group_by(input, topk_idx, num_exp, alpha)
+        exp_preds = [
+            self.dense(g, expert_hidden_size, ActiMode.AC_MODE_RELU, name=f"{name}_exp{i}")
+            for i, g in enumerate(grouped)
+        ]
+        return self.aggregate(topk_out, topk_idx, topk_idx, gate, exp_preds, num_exp, lambda_bal)
+
+    # ------------------------------------------------------------------
+    # compile / strategy
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: LossType = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics: Sequence[MetricsType] = (),
+        comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+        parallel_axes: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """reference: FFModel::compile (model.cc:2803) — create operators from
+        layers, run the strategy search, build partitions/comms. Here: build
+        the PCG, pick a strategy (data-parallel default; Unity search when
+        search_budget > 0), build the mesh and compile the step functions."""
+        self.optimizer = optimizer or SGDOptimizer(self, lr=self.config.learning_rate)
+        self.loss = Loss(loss_type) if not isinstance(loss_type, Loss) else loss_type
+        self.metrics = Metrics(self.loss.loss_type, list(metrics))
+        self.comp_mode = comp_mode
+
+        self.graph = Graph(self.ops)
+        order = self.graph.topo_order()
+        self.final_tensor = self.final_tensor or order[-1].outputs[0]
+
+        # label tensor mirrors final op's shape (model.cc:3086-3124)
+        self.label_tensor = Tensor(self._label_dims(), name="label")
+        self.label_tensor._model = self
+
+        # -- strategy assignment ---------------------------------------
+        n_dev = self.config.total_devices
+        if parallel_axes is None:
+            parallel_axes = {"data": n_dev} if n_dev > 1 else {}
+        if self.config.only_data_parallel:
+            parallel_axes = {"data": n_dev} if n_dev > 1 else {}
+        self.parallel_axes = dict(parallel_axes)
+        self._assign_strategy(self.parallel_axes)
+
+        self.mesh = make_mesh(self.parallel_axes) if self.parallel_axes else None
+
+        self.executor = Executor(self.graph, self.config, self.mesh)
+        import jax
+
+        self.params, self.state = self.executor.init_params(
+            jax.random.PRNGKey(self.config.seed)
+        )
+        input_names = [op.name for op in self.input_ops]
+        self._train_step = self.executor.build_train_step(
+            self.optimizer, self.loss.fn, self.metrics, self.final_tensor, input_names
+        )
+        self._eval_step = self.executor.build_eval_step(
+            self.loss.fn, self.metrics, self.final_tensor
+        )
+        self._forward_fn = self.executor.build_forward(self.final_tensor, comp_mode)
+        self._infer_fn = self.executor.build_forward(self.final_tensor)
+        self._grad_step = self.executor.build_grad_step(self.loss.fn, self.final_tensor)
+        self.opt_state = self.optimizer.init_state(self.params)
+        self._compiled = True
+        self._manual: Dict[str, Any] = {}
+
+        if self.config.export_strategy_computation_graph_file:
+            self.graph.export_dot(self.config.export_strategy_computation_graph_file)
+
+    def _label_dims(self):
+        from .ffconst import LossType as LT
+
+        fd = self.final_tensor.dims
+        if self.loss.loss_type == LT.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            return fd[:-1] + (1,)
+        return fd
+
+    def _assign_strategy(self, axes: Dict[str, int]) -> None:
+        """Assign ParallelTensorShapes: batch dim over the 'data' axis
+        (reference: only_data_parallel path model.cc:2638-2642) and — when a
+        'model' axis is present — Megatron-style tensor parallelism: linear
+        out-features, attention heads, and embedding features sharded over
+        'model' (reference analog: create_partition_linear_combine /
+        create_partition_attention_combine substitutions, substitution.cc:
+        1755-1770). The Unity search overrides per-op views when enabled."""
+        batch = self.config.batch_size
+        dp = axes.get("data", 1)
+        tp = axes.get("model", 1)
+        view = MachineView(axes=tuple(axes.items()))
+        for op in self.graph.topo_order():
+            op.machine_view = view
+            for t in list(op.outputs):
+                dims = []
+                for i, size in enumerate(t.dims):
+                    if i == 0 and dp > 1 and size == batch and size % dp == 0:
+                        dims.append(
+                            ParallelDim(size, dp, "data", kind=ParallelDimKind.SAMPLE)
+                        )
+                    else:
+                        dims.append(ParallelDim(size, 1, None))
+                t.parallel_shape = ParallelTensorShape(dims, t.dtype)
+            if tp > 1:
+                self._assign_tp_weights(op, tp)
+
+    def _assign_tp_weights(self, op: Op, tp: int) -> None:
+        """Shard weight dims over the 'model' axis where the op supports TP."""
+        shard_dim = {
+            OpType.LINEAR: {"kernel": -1, "bias": 0},
+            OpType.EMBEDDING: {"weight": -1},
+            OpType.MULTIHEAD_ATTENTION: {
+                "wq": 1, "wk": 1, "wv": 1, "wo": 0,
+                "bq": 0, "bk": 0, "bv": 0,
+            },
+        }.get(op.op_type)
+        for w in op.weights:
+            ws = w._weight_spec
+            dims = [ParallelDim(s, 1, None) for s in w.dims]
+            if shard_dim and ws.name in shard_dim:
+                d = shard_dim[ws.name] % len(w.dims)
+                if w.dims[d] % tp == 0:
+                    dims[d] = ParallelDim(
+                        w.dims[d], tp, "model", kind=ParallelDimKind.CHANNEL
+                    )
+            w.parallel_shape = ParallelTensorShape(dims, w.dtype)
+
+    # ------------------------------------------------------------------
+    # training loop (reference: flexflow_cffi.py fit :2062 / eval :2106)
+    # ------------------------------------------------------------------
+    def _next_rng(self):
+        import jax
+
+        self._step_count += 1
+        return jax.random.PRNGKey(self._rng_seed + self._step_count)
+
+    def _prep_inputs(self, arrays: Sequence[np.ndarray], lo: int, hi: int):
+        out = {}
+        for op, arr in zip(self.input_ops, arrays):
+            batch = np.ascontiguousarray(arr[lo:hi])
+            out[op.name] = self.executor.shard_batch(
+                batch.astype(op.outputs[0].dtype.np_dtype)
+            )
+        return out
+
+    def fit(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray], None] = None,
+        y: Optional[np.ndarray] = None,
+        batch_size: Optional[int] = None,
+        epochs: Optional[int] = None,
+        verbose: bool = False,
+    ) -> List[Dict[str, float]]:
+        assert self._compiled, "call compile() first"
+        if x is None:
+            x, y = self._dataloader_arrays()
+        if isinstance(x, np.ndarray):
+            x = [x]
+        bs = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        n = x[0].shape[0]
+        label_dtype = (
+            DataType.DT_INT32
+            if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+            else DataType.DT_FLOAT
+        )
+        if n < bs:
+            raise ValueError(
+                f"dataset has {n} samples but batch_size is {bs}; "
+                "fit needs at least one full batch"
+            )
+        history = []
+        for epoch in range(epochs):
+            self.reset_metrics()
+            t0 = time.time()
+            mvals: Dict[str, float] = {}
+            for it in range(n // bs):
+                lo, hi = it * bs, (it + 1) * bs
+                inputs = self._prep_inputs(x, lo, hi)
+                label = self.executor.shard_batch(
+                    np.ascontiguousarray(y[lo:hi]).astype(label_dtype.np_dtype)
+                )
+                if self._recompile_state is not None:
+                    self._recompile_state.step(self)
+                self.params, self.opt_state, self.state, mvals = self._train_step(
+                    self.params, self.opt_state, self.state, inputs, label,
+                    self._next_rng(),
+                )
+                mvals = {k: float(v) for k, v in mvals.items()}
+                self.perf_metrics.update(hi - lo, mvals)
+            dt = time.time() - t0
+            summ = self.perf_metrics.summary()
+            summ["epoch"] = epoch
+            summ["throughput"] = (n // bs) * bs / dt
+            history.append(summ)
+            if verbose:
+                print(
+                    f"epoch {epoch}: loss={mvals.get('loss', 0):.4f} "
+                    f"acc={summ['accuracy']:.4f} {summ['throughput']:.1f} samples/s"
+                )
+        return history
+
+    def eval(self, x, y, batch_size: Optional[int] = None) -> Dict[str, float]:
+        assert self._compiled
+        if isinstance(x, np.ndarray):
+            x = [x]
+        bs = batch_size or self.config.batch_size
+        n = x[0].shape[0]
+        label_dtype = (
+            DataType.DT_INT32
+            if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+            else DataType.DT_FLOAT
+        )
+        pm = PerfMetrics()
+        num_batches = (n + bs - 1) // bs  # include the tail partial batch
+        for it in range(num_batches):
+            lo, hi = it * bs, min((it + 1) * bs, n)
+            if hi <= lo:
+                break
+            inputs = self._prep_inputs(x, lo, hi)
+            label = self.executor.shard_batch(
+                np.ascontiguousarray(y[lo:hi]).astype(label_dtype.np_dtype)
+            )
+            mvals, _ = self._eval_step(self.params, self.state, inputs, label)
+            pm.update(hi - lo, {k: float(v) for k, v in mvals.items()})
+        return pm.summary()
+
+    # -- manual loop parity (reference: forward/zero_gradients/backward/update)
+    def set_iteration_batch(self, inputs: Sequence[np.ndarray], label: np.ndarray):
+        self._manual["inputs"] = self._prep_inputs(list(inputs), 0, inputs[0].shape[0])
+        self._manual["label"] = np.asarray(label)
+
+    def forward(self, seq_length: Optional[int] = None):
+        # one rng per iteration, shared with backward() so the differentiated
+        # forward sees the identical dropout masks
+        self._manual["rng"] = self._next_rng()
+        pred, self.state = self._forward_fn(
+            self.params, self.state, self._manual["inputs"], self._manual["rng"]
+        )
+        self._manual["pred"] = pred
+        return pred
+
+    def zero_gradients(self):
+        self._manual.pop("grads", None)
+
+    def backward(self, seq_length: Optional[int] = None):
+        import jax.numpy as jnp
+
+        label = jnp.asarray(self._manual["label"])
+        rng = self._manual.get("rng")
+        if rng is None:
+            rng = self._next_rng()
+        self._manual["grads"] = self._grad_step(
+            self.params, self.state, self._manual["inputs"], label, rng
+        )
+
+    def update(self):
+        self.params, self.opt_state = self.optimizer.update(
+            self.params, self._manual["grads"], self.opt_state
+        )
+
+    def reset_metrics(self):
+        self.perf_metrics = PerfMetrics()
+
+    def get_perf_metrics(self) -> PerfMetrics:
+        return self.perf_metrics
+
+    # -- recompile hook (reference: RecompileState, recompile.h:28-44) ----
+    def recompile_on_condition(self, recompile_state) -> None:
+        self._recompile_state = recompile_state
+
+    def get_cache_score(self, cache_tensor: Tensor) -> float:
+        op = cache_tensor.owner_op
+        return float(self.state[op.name]["score"])
+
+    # ------------------------------------------------------------------
+    # tensor value access (reference: ParallelTensor set_tensor/get_tensor)
+    # ------------------------------------------------------------------
+    def _find_weight(self, tensor: Tensor):
+        op = tensor.owner_op
+        if op is None or not hasattr(tensor, "_weight_spec"):
+            return None
+        return op.name, tensor._weight_spec.name
+
+    def _get_tensor_value(self, tensor: Tensor):
+        loc = self._find_weight(tensor)
+        if loc and self.params is not None:
+            return self.params[loc[0]][loc[1]]
+        return None
+
+    def _set_tensor_value(self, tensor: Tensor, value: np.ndarray):
+        loc = self._find_weight(tensor)
+        if loc and self.params is not None:
+            import jax.numpy as jnp
+
+            self.params[loc[0]][loc[1]] = jnp.asarray(value)
+
+    def get_parameter_by_id(self, op_name: str, weight_name: str):
+        return np.asarray(self.params[op_name][weight_name])
+
+    def get_layers(self) -> List[Op]:
+        return list(self.ops)
+
+    def _attach_dataloader(self, dl) -> None:
+        self._dataloaders.append(dl)
+
+    def _dataloader_arrays(self):
+        """fit() without x/y: pull full arrays from attached SingleDataLoaders
+        (reference: dataloaders created per tensor, flexflow_cffi.py:2451)."""
+        if not self._dataloaders:
+            raise RuntimeError("fit() without x/y requires attached dataloaders")
+        xs, y = [], None
+        by_tensor = {dl.input_tensor.guid: dl for dl in self._dataloaders}
+        for op in self.input_ops:
+            dl = by_tensor.get(op.outputs[0].guid)
+            if dl is not None:
+                xs.append(dl.data[: dl.num_samples])
+        if self.label_tensor is not None and self.label_tensor.guid in by_tensor:
+            dl = by_tensor[self.label_tensor.guid]
+            y = dl.data[: dl.num_samples]
+        return xs, y
+
+    def print_layers(self, id: int = -1) -> None:
+        for op in self.ops:
+            print(op)

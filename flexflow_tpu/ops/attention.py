@@ -1,0 +1,115 @@
+"""Multi-head attention (reference: src/ops/attention.cc:1-926, cuDNN MHA API).
+
+The reference wraps cuDNN's multi-head attention with a packed weight tensor
+carrying a heads dim (attention.cc:212-216) so the search can shard heads (TP).
+Here projections are einsums with an explicit heads axis — shardable over a
+mesh axis the same way — and the softmax(QK^T)V core runs in f32. A Pallas
+flash-attention kernel and ring-attention (sequence-parallel) variant live in
+flexflow_tpu/kernels/ and are selected via params.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import Op, WeightSpec, register_op
+from ..ffconst import CompMode, DataType, OpType
+from ..runtime.initializers import DefaultInitializer, ZeroInitializer
+from .common import matmul_dtype
+
+
+@register_op
+class MultiHeadAttentionOp(Op):
+    op_type = OpType.MULTIHEAD_ATTENTION
+
+    def _dims(self):
+        q, k, v = self.inputs[:3]
+        p = self.params
+        embed = p["embed_dim"]
+        heads = p["num_heads"]
+        kdim = p.get("kdim") or embed // heads
+        vdim = p.get("vdim") or embed // heads
+        return q, k, v, embed, heads, kdim, vdim
+
+    def output_shapes(self):
+        q, k, v, embed, heads, kdim, vdim = self._dims()
+        return [q.dims[:-1] + (embed,)], [q.dtype]
+
+    def weight_specs(self) -> List[WeightSpec]:
+        q, k, v, embed, heads, kdim, vdim = self._dims()
+        user_init = self.params.get("kernel_initializer")
+
+        def init(fan_in, fan_out):
+            return user_init or DefaultInitializer(fan_in=fan_in, fan_out=fan_out)
+
+        dt = q.dtype
+        specs = [
+            WeightSpec("wq", (q.dims[-1], heads, kdim), dt, init(q.dims[-1], heads * kdim)),
+            WeightSpec("wk", (k.dims[-1], heads, kdim), dt, init(k.dims[-1], heads * kdim)),
+            WeightSpec("wv", (v.dims[-1], heads, vdim), dt, init(v.dims[-1], heads * vdim)),
+            WeightSpec("wo", (heads, vdim, embed), dt, init(heads * vdim, embed)),
+        ]
+        if self.params.get("bias", True):
+            specs += [
+                WeightSpec("bq", (heads, kdim), dt, ZeroInitializer()),
+                WeightSpec("bk", (heads, kdim), dt, ZeroInitializer()),
+                WeightSpec("bv", (heads, vdim), dt, ZeroInitializer()),
+                WeightSpec("bo", (embed,), dt, ZeroInitializer()),
+            ]
+        return specs
+
+    def lower(self, ctx, inputs, weights):
+        q_in, k_in, v_in = inputs[:3]
+        p = self.params
+        _, _, _, embed, heads, kdim, vdim = self._dims()
+        cdt = matmul_dtype(ctx.config, q_in.dtype)
+
+        q = jnp.einsum("ble,ehd->blhd", q_in.astype(cdt), weights["wq"].astype(cdt))
+        k = jnp.einsum("ble,ehd->blhd", k_in.astype(cdt), weights["wk"].astype(cdt))
+        v = jnp.einsum("ble,ehd->blhd", v_in.astype(cdt), weights["wv"].astype(cdt))
+        if "bq" in weights:
+            q = q + weights["bq"].astype(cdt)
+            k = k + weights["bk"].astype(cdt)
+            v = v + weights["bv"].astype(cdt)
+
+        scale = 1.0 / np.sqrt(kdim)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        if p.get("causal", False):
+            lq, lk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+            logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        rate = p.get("dropout", 0.0)
+        if rate > 0.0 and ctx.mode == CompMode.COMP_MODE_TRAINING:
+            keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - rate, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - rate), 0.0)
+        ctxv = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs.astype(cdt), v, preferred_element_type=jnp.float32
+        )
+        out = jnp.einsum(
+            "bqhd,hde->bqe",
+            ctxv.astype(cdt),
+            weights["wo"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        ).astype(self.outputs[0].dtype.jnp_dtype)
+        if "bo" in weights:
+            out = out + weights["bo"]
+        return [out]
+
+    def flops(self) -> float:
+        q, k, v, embed, heads, kdim, vdim = self._dims()
+        b, lq = q.dims[0], q.dims[1]
+        lk = k.dims[1]
+        proj = 2.0 * b * heads * (
+            lq * q.dims[-1] * kdim
+            + lk * k.dims[-1] * kdim
+            + lk * v.dims[-1] * vdim
+            + lq * vdim * embed
+        )
+        core = 2.0 * b * heads * lq * lk * (kdim + vdim)
+        return proj + core

@@ -1,0 +1,31 @@
+"""Shared helpers for op lowerings."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ffconst import ActiMode
+
+
+def apply_activation(x, activation: ActiMode):
+    import jax
+
+    if activation is None or activation == ActiMode.AC_MODE_NONE:
+        return x
+    if activation == ActiMode.AC_MODE_RELU:
+        return jax.nn.relu(x)
+    if activation == ActiMode.AC_MODE_SIGMOID:
+        return jax.nn.sigmoid(x)
+    if activation == ActiMode.AC_MODE_TANH:
+        return jnp.tanh(x)
+    if activation == ActiMode.AC_MODE_GELU:
+        return jax.nn.gelu(x)
+    raise ValueError(f"unknown activation {activation}")
+
+
+def matmul_dtype(config, dtype):
+    """bfloat16 accumulate-f32 matmuls on the MXU when allowed."""
+    import jax.numpy as jnp
+
+    if config is not None and config.allow_mixed_precision and dtype == jnp.float32:
+        return jnp.bfloat16
+    return dtype

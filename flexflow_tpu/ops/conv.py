@@ -1,0 +1,192 @@
+"""Conv2D / Pool2D / Flat / BatchNorm.
+
+Reference: src/ops/conv_2d.cc (cuDNN conv fwd/bwd + algo selection),
+pool_2d.cc (cuDNN pooling), flat.cc, batch_norm.cc (cuDNN BN). Here all lower
+to lax convolution/reduce-window primitives which XLA maps onto the MXU
+(convs as implicit GEMMs) — no algorithm selection needed.
+
+Logical layout is NCHW for API parity with the reference; XLA is free to
+re-layout internally for TPU.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import Op, WeightSpec, register_op
+from ..ffconst import ActiMode, DataType, OpType, PoolType
+from ..runtime.initializers import DefaultInitializer, ZeroInitializer
+from .common import apply_activation, matmul_dtype
+
+
+def _out_size(size, pad, kernel, stride):
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register_op
+class Conv2DOp(Op):
+    op_type = OpType.CONV2D
+
+    def output_shapes(self):
+        (x,) = self.inputs
+        n, c, h, w = x.dims
+        p = self.params
+        oh = _out_size(h, p["padding_h"], p["kernel_h"], p["stride_h"])
+        ow = _out_size(w, p["padding_w"], p["kernel_w"], p["stride_w"])
+        return [(n, p["out_channels"], oh, ow)], [x.dtype]
+
+    def weight_specs(self) -> List[WeightSpec]:
+        (x,) = self.inputs
+        p = self.params
+        in_c = x.dims[1] // p.get("groups", 1)
+        rf = p["kernel_h"] * p["kernel_w"]
+        specs = [
+            WeightSpec(
+                "kernel",
+                (p["out_channels"], in_c, p["kernel_h"], p["kernel_w"]),  # OIHW
+                x.dtype,
+                p.get("kernel_initializer")
+                or DefaultInitializer(
+                    fan_in=in_c * rf, fan_out=p["out_channels"] * rf
+                ),
+            )
+        ]
+        if p.get("use_bias", True):
+            specs.append(
+                WeightSpec(
+                    "bias",
+                    (p["out_channels"],),
+                    x.dtype,
+                    p.get("bias_initializer") or ZeroInitializer(),
+                )
+            )
+        return specs
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        p = self.params
+        cdt = matmul_dtype(ctx.config, x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x.astype(cdt),
+            weights["kernel"].astype(cdt),
+            window_strides=(p["stride_h"], p["stride_w"]),
+            padding=[(p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=p.get("groups", 1),
+            preferred_element_type=jnp.float32,
+        ).astype(self.outputs[0].dtype.jnp_dtype)
+        if "bias" in weights:
+            y = y + weights["bias"][None, :, None, None]
+        return [apply_activation(y, p.get("activation", ActiMode.AC_MODE_NONE))]
+
+    def flops(self) -> float:
+        n, oc, oh, ow = self.outputs[0].dims
+        p = self.params
+        in_c = self.inputs[0].dims[1] // p.get("groups", 1)
+        return 2.0 * n * oc * oh * ow * in_c * p["kernel_h"] * p["kernel_w"]
+
+
+@register_op
+class Pool2DOp(Op):
+    op_type = OpType.POOL2D
+
+    def output_shapes(self):
+        (x,) = self.inputs
+        n, c, h, w = x.dims
+        p = self.params
+        oh = _out_size(h, p["padding_h"], p["kernel_h"], p["stride_h"])
+        ow = _out_size(w, p["padding_w"], p["kernel_w"], p["stride_w"])
+        return [(n, c, oh, ow)], [x.dtype]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        p = self.params
+        window = (1, 1, p["kernel_h"], p["kernel_w"])
+        strides = (1, 1, p["stride_h"], p["stride_w"])
+        pads = ((0, 0), (0, 0), (p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"]))
+        if p.get("pool_type", PoolType.POOL_MAX) == PoolType.POOL_MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+        else:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+            y = s / float(p["kernel_h"] * p["kernel_w"])
+        return [apply_activation(y, p.get("activation", ActiMode.AC_MODE_NONE))]
+
+
+@register_op
+class FlatOp(Op):
+    """(N,C,H,W) -> (N, C*H*W) (reference: src/ops/flat.cc)."""
+
+    op_type = OpType.FLAT
+
+    def output_shapes(self):
+        (x,) = self.inputs
+        return [(x.dims[0], int(np.prod(x.dims[1:])))], [x.dtype]
+
+    def lower(self, ctx, inputs, weights):
+        return [inputs[0].reshape(self.outputs[0].dims)]
+
+
+@register_op
+class BatchNormOp(Op):
+    """BatchNorm over NCHW channel dim (reference: src/ops/batch_norm.cc).
+
+    Running statistics live in non-trainable op state, updated functionally
+    inside the train step (the reference mutates cuDNN tensors in-place).
+    """
+
+    op_type = OpType.BATCHNORM
+
+    def output_shapes(self):
+        (x,) = self.inputs
+        return [x.dims], [x.dtype]
+
+    def weight_specs(self):
+        c = self.inputs[0].dims[1]
+        from ..runtime.initializers import ConstantInitializer, ZeroInitializer
+
+        return [
+            WeightSpec("gamma", (c,), self.inputs[0].dtype, ConstantInitializer(1.0)),
+            WeightSpec("beta", (c,), self.inputs[0].dtype, ZeroInitializer()),
+        ]
+
+    def state_specs(self):
+        c = self.inputs[0].dims[1]
+        from ..runtime.initializers import ConstantInitializer, ZeroInitializer
+
+        return [
+            WeightSpec("running_mean", (c,), self.inputs[0].dtype, ZeroInitializer()),
+            WeightSpec("running_var", (c,), self.inputs[0].dtype, ConstantInitializer(1.0)),
+        ]
+
+    def lower(self, ctx, inputs, weights):
+        from ..ffconst import CompMode
+
+        x = inputs[0]
+        eps = self.params.get("eps", 1e-5)
+        momentum = self.params.get("momentum", 0.1)
+        axes = (0, 2, 3)
+        if ctx.mode == CompMode.COMP_MODE_TRAINING:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            rm = ctx.state.get((self.name, "running_mean"))
+            rv = ctx.state.get((self.name, "running_var"))
+            if rm is not None:
+                ctx.state_updates[(self.name, "running_mean")] = (
+                    (1 - momentum) * rm + momentum * mean
+                )
+                ctx.state_updates[(self.name, "running_var")] = (
+                    (1 - momentum) * rv + momentum * var
+                )
+        else:
+            mean = ctx.state[(self.name, "running_mean")]
+            var = ctx.state[(self.name, "running_var")]
+        inv = jax.lax.rsqrt(var + eps)
+        y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+        y = y * weights["gamma"][None, :, None, None] + weights["beta"][None, :, None, None]
+        if self.params.get("relu", False):
+            y = jax.nn.relu(y)
+        return [y]

@@ -1,0 +1,43 @@
+"""PCG source/sink ops: Input, Weight, NoOp (reference: src/ops/noop.cc)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..core.op import Op, register_op
+from ..ffconst import DataType, OpType
+
+
+@register_op
+class InputOp(Op):
+    """Graph input placeholder (reference NoOp with OP_INPUT)."""
+
+    op_type = OpType.INPUT
+
+    def output_shapes(self):
+        return [tuple(self.params["dims"])], [self.params.get("dtype", DataType.DT_FLOAT)]
+
+    def lower(self, ctx, inputs, weights):
+        # value injected by the executor before lowering
+        raise RuntimeError("InputOp is resolved by the executor, not lowered")
+
+
+@register_op
+class NoOp(Op):
+    op_type = OpType.NOOP
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def lower(self, ctx, inputs, weights):
+        return [inputs[0]]
+
+
+@register_op
+class IdentityOp(Op):
+    op_type = OpType.IDENTITY
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def lower(self, ctx, inputs, weights):
+        return [inputs[0]]

@@ -1,0 +1,160 @@
+"""Mixture-of-Experts ops: GroupBy, Aggregate, AggregateSpec, Cache.
+
+Reference: src/ops/group_by.cc (scatter tokens to experts), aggregate.cc
+(gather expert outputs + load-balance gradient shaping), aggregate_spec.cc,
+cache.cc (cached expert assignments with a score callback).
+
+The reference's group_by produces data-dependent shapes; on TPU/XLA shapes
+must be static, so we use the standard capacity-factor formulation: each
+expert receives a fixed-capacity buffer (capacity = ceil(alpha * k * B / n)),
+overflow tokens are dropped, position-in-expert computed with a cumsum over
+the token order (deterministic, recomputable by Aggregate). This is also the
+formulation expert-parallel all_to_all dispatch wants.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import Op, register_op
+from ..ffconst import DataType, OpType
+
+
+def moe_capacity(batch: int, k: int, n: int, alpha: float) -> int:
+    return max(1, int(math.ceil(alpha * k * batch / n)))
+
+
+def _dispatch_plan(assign, n: int, capacity: int):
+    """assign: (B, k) int32 expert ids. Returns (expert_of_token, slot_of_token,
+    valid) each of shape (B*k,), flattened in row-major token order."""
+    flat = assign.reshape(-1)  # (B*k,)
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)  # (T, n)
+    # position of each token within its expert (0-based), in token order
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # (T, n)
+    slot = jnp.sum(pos * onehot, axis=1)  # (T,)
+    valid = slot < capacity
+    return flat, slot, valid
+
+
+@register_op
+class GroupByOp(Op):
+    """inputs: (features (B, F), assign (B, k)); outputs: n buffers (cap, F)."""
+
+    op_type = OpType.GROUP_BY
+
+    def output_shapes(self):
+        x, assign = self.inputs
+        n = self.params["n"]
+        alpha = self.params.get("alpha", 1.0)
+        cap = moe_capacity(x.dims[0], assign.dims[1], n, alpha)
+        return [(cap, x.dims[1])] * n, [x.dtype] * n
+
+    def lower(self, ctx, inputs, weights):
+        x, assign = inputs
+        n = self.params["n"]
+        alpha = self.params.get("alpha", 1.0)
+        b, f = x.shape
+        k = assign.shape[1]
+        cap = moe_capacity(b, k, n, alpha)
+        expert, slot, valid = _dispatch_plan(assign.astype(jnp.int32), n, cap)
+        tokens = jnp.repeat(x, k, axis=0)  # (B*k, F) token features per assignment
+        outs = []
+        for e in range(n):
+            sel = (expert == e) & valid
+            # scatter: buffer[slot[t]] = tokens[t] where sel
+            buf = jnp.zeros((cap, f), x.dtype)
+            idx = jnp.where(sel, slot, cap)  # invalid -> out-of-range (dropped)
+            buf = buf.at[idx].set(jnp.where(sel[:, None], tokens, 0.0), mode="drop")
+            outs.append(buf)
+        return outs
+
+
+@register_op
+class AggregateOp(Op):
+    """inputs: gate_preds (B,k), gate_assign (B,k), true_gate_assign (B,k),
+    full_gate_grads (B,n), exp_preds[n] (cap, out_dim) -> output (B, out_dim).
+
+    Mirrors the reference Aggregate input signature (aggregate.cc); the
+    load-balance gradient shaping (lambda_bal) arrives via jax.grad of the
+    combined weighting, so no custom backward kernel is needed.
+    """
+
+    op_type = OpType.AGGREGATE
+
+    def output_shapes(self):
+        n = self.params["n"]
+        exp0 = self.inputs[4]
+        b = self.inputs[0].dims[0]
+        return [(b, exp0.dims[1])], [exp0.dtype]
+
+    def lower(self, ctx, inputs, weights):
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        n = self.params["n"]
+        exp_preds = inputs[4 : 4 + n]
+        b, k = gate_assign.shape
+        cap = exp_preds[0].shape[0]
+        lambda_bal = self.params.get("lambda_bal", 0.0)
+        if lambda_bal:
+            # Switch-Transformer-style load-balance loss (functional stand-in
+            # for the reference's lambda_bal gradient shaping in
+            # aggregate.cu's backward kernel): n * sum_e(importance_e * load_e)
+            full_gate = inputs[3].astype(jnp.float32)  # (B, n) gate distribution
+            importance = jnp.mean(full_gate, axis=0)
+            load = jnp.mean(
+                jax.nn.one_hot(gate_assign.reshape(-1), n, dtype=jnp.float32), axis=0
+            )
+            ctx.aux_losses.append(lambda_bal * n * jnp.sum(importance * load))
+        expert, slot, valid = _dispatch_plan(gate_assign.astype(jnp.int32), n, cap)
+        stacked = jnp.stack(exp_preds)  # (n, cap, out_dim)
+        # gather each token-assignment's expert output (invalid -> zeros)
+        tok_out = stacked[expert, jnp.minimum(slot, cap - 1)]  # (B*k, out_dim)
+        tok_out = jnp.where(valid[:, None], tok_out, 0.0)
+        tok_out = tok_out.reshape(b, k, -1)
+        return [jnp.sum(tok_out * gate_preds[..., None].astype(tok_out.dtype), axis=1)]
+
+
+@register_op
+class AggregateSpecOp(AggregateOp):
+    """Variant used with speculative expert predictions (aggregate_spec.cc);
+    same dataflow, kept as a distinct type for graph-substitution parity."""
+
+    op_type = OpType.AGGREGATE_SPEC
+
+
+@register_op
+class CacheOp(Op):
+    """Cached tensor with staleness score (reference: src/ops/cache.cc).
+
+    Holds the last seen input in non-trainable state; `score_f` (host
+    callback in the reference) becomes an on-device L1 divergence score the
+    recompile trigger can read via model.get_cache_score().
+    """
+
+    op_type = OpType.CACHE
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def state_specs(self):
+        from ..core.op import WeightSpec
+        from ..runtime.initializers import ZeroInitializer
+
+        return [
+            WeightSpec("cached", self.inputs[0].dims, self.inputs[0].dtype, ZeroInitializer()),
+            WeightSpec("score", (), DataType.DT_FLOAT, ZeroInitializer()),
+        ]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        cached = ctx.state.get((self.name, "cached"))
+        use_cached = self.params.get("use_cached", False)
+        if cached is None:
+            return [x]
+        score = jnp.mean(jnp.abs(x.astype(jnp.float32) - cached.astype(jnp.float32)))
+        ctx.state_updates[(self.name, "score")] = score
+        ctx.state_updates[(self.name, "cached")] = x
+        return [cached if use_cached else x]

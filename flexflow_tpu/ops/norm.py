@@ -1,0 +1,82 @@
+"""LayerNorm / Softmax / Dropout.
+
+Reference: src/ops/layer_norm.cc (custom CUDA kernels), softmax.cc (cuDNN),
+dropout.cc (cuDNN dropout states). Dropout here uses jax PRNG threaded through
+the LoweringContext — functional replacement for cuDNN's stateful RNG.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import Op, WeightSpec, register_op
+from ..ffconst import CompMode, DataType, OpType
+from ..runtime.initializers import ConstantInitializer, ZeroInitializer
+
+
+@register_op
+class LayerNormOp(Op):
+    op_type = OpType.LAYERNORM
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def _norm_shape(self):
+        axes = self.params["axes"]
+        return tuple(self.inputs[0].dims[a] for a in axes)
+
+    def weight_specs(self) -> List[WeightSpec]:
+        if not self.params.get("elementwise_affine", True):
+            return []
+        shape = self._norm_shape()
+        return [
+            WeightSpec("gamma", shape, self.inputs[0].dtype, ConstantInitializer(1.0)),
+            WeightSpec("beta", shape, self.inputs[0].dtype, ZeroInitializer()),
+        ]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        axes = tuple(self.params["axes"])
+        eps = self.params.get("eps", 1e-5)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        if "gamma" in weights:
+            # broadcast affine params over the normalized axes
+            shape = [1] * x.ndim
+            for a in axes:
+                shape[a] = x.shape[a]
+            y = y * weights["gamma"].reshape(shape) + weights["beta"].reshape(shape)
+        return [y]
+
+
+@register_op
+class SoftmaxOp(Op):
+    op_type = OpType.SOFTMAX
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def lower(self, ctx, inputs, weights):
+        axis = self.params.get("axis", -1)
+        return [jax.nn.softmax(inputs[0], axis=axis)]
+
+
+@register_op
+class DropoutOp(Op):
+    op_type = OpType.DROPOUT
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        rate = self.params.get("rate", 0.5)
+        if ctx.mode != CompMode.COMP_MODE_TRAINING or rate <= 0.0:
+            return [x]
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(ctx.next_rng(), keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

@@ -1,0 +1,41 @@
+"""SingleDataLoader (reference: src/dataloader/dataloader.cc:1-842,
+flexflow_cffi.py:2451).
+
+The reference loads the full numpy dataset into zero-copy host memory and
+index-launches per-shard GPU copy tasks each `next_batch`. Here the dataset
+stays in host numpy; `next_batch` device_puts the next slice with the batch
+sharded over the mesh's data axis (the host→HBM transfer the reference does
+with Legion copies)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SingleDataLoader:
+    def __init__(self, ffmodel, input_tensor, full_array: np.ndarray,
+                 num_samples: Optional[int] = None, data_type=None):
+        self.model = ffmodel
+        self.input_tensor = input_tensor
+        self.data = np.ascontiguousarray(full_array)
+        self.num_samples = num_samples or full_array.shape[0]
+        self.batch_size = ffmodel.config.batch_size
+        self.next_index = 0
+        ffmodel._attach_dataloader(self)
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self) -> None:
+        self.next_index = 0
+
+    def next_batch(self, ffmodel=None) -> np.ndarray:
+        lo = self.next_index
+        hi = lo + self.batch_size
+        if hi > self.num_samples:
+            self.reset()
+            lo, hi = 0, self.batch_size
+        self.next_index = hi
+        return self.data[lo:hi]

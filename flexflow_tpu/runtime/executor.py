@@ -1,0 +1,186 @@
+"""Executor: lowers a PCG to jitted SPMD train/inference steps.
+
+This is the TPU-native replacement for the reference's execution stack —
+Legion index-task launches per op (e.g. Linear::forward linear.cc:347 →
+FFMapper::slice_task mapper.cc:364 → per-GPU kernels) plus Legion iteration
+tracing (flexflow_cffi.py:2097-2104). Here the *entire* training iteration
+(forward, loss, backward via jax.grad, metrics, optimizer update with
+data-parallel gradient reduction) is one traced jax function compiled once by
+XLA: tracing+replay is free, fusion replaces FusedOp, and GSPMD inserts the
+collectives the reference got from NCCL/Legion copies.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import Graph
+from ..core.op import LoweringContext, Op
+from ..ffconst import CompMode, OpType
+from .metrics import Metrics
+
+
+class Executor:
+    def __init__(self, graph: Graph, config, mesh=None):
+        self.graph = graph
+        self.config = config
+        self.mesh = mesh
+        self.topo = graph.topo_order()
+        self._train_step = None
+        self._eval_step = None
+        self._forward_jit = None
+
+    # -- parameter/state initialization (reference: init_operators + initializer tasks)
+    def init_params(self, key) -> Tuple[Dict, Dict]:
+        params: Dict[str, Dict[str, Any]] = {}
+        state: Dict[str, Dict[str, Any]] = {}
+        for op in self.topo:
+            if op.weights:
+                params[op.name] = {}
+                for w in op.weights:
+                    key, sub = jax.random.split(key)
+                    ws = w._weight_spec
+                    init = ws.initializer
+                    if w._host_value is not None:
+                        val = jnp.asarray(w._host_value)
+                    else:
+                        val = init(sub, ws.dims, ws.dtype.jnp_dtype)
+                    # place with the strategy's weight sharding (TP) so the
+                    # jitted step starts from sharded parameters
+                    if self.mesh is not None and w.parallel_shape is not None:
+                        val = jax.device_put(
+                            val, w.parallel_shape.sharding(self.mesh)
+                        )
+                    params[op.name][ws.name] = val
+            if op.state_vars:
+                state[op.name] = {}
+                for sv in op.state_vars:
+                    key, sub = jax.random.split(key)
+                    state[op.name][sv.name] = sv.initializer(
+                        sub, sv.dims, sv.dtype.jnp_dtype
+                    )
+        return params, state
+
+    # -- forward walk ------------------------------------------------------
+    def forward_values(
+        self,
+        params: Dict,
+        state: Dict,
+        input_values: Dict[str, Any],
+        rng,
+        mode: CompMode,
+    ) -> Tuple[Dict[int, Any], Dict]:
+        """Returns (tensor guid -> value, new state)."""
+        ctx = LoweringContext(self.config, mode, self.mesh, rng)
+        # flatten state into ctx keyed by (op_name, var)
+        for op_name, vars_ in state.items():
+            for var, val in vars_.items():
+                ctx.state[(op_name, var)] = val
+        for op in self.topo:
+            if op.op_type == OpType.INPUT:
+                val = input_values[op.name]
+                ctx.values[op.outputs[0].guid] = ctx.constrain(val, op.outputs[0])
+                continue
+            ins = [ctx.values[t.guid] for t in op.inputs]
+            weights = dict(params.get(op.name, {}))
+            for w in op.weights:
+                ws = w._weight_spec
+                if ws.name in weights:
+                    weights[ws.name] = ctx.constrain(weights[ws.name], w)
+            outs = op.lower(ctx, ins, weights)
+            for t, v in zip(op.outputs, outs):
+                ctx.values[t.guid] = ctx.constrain(v, t)
+        new_state = {
+            op_name: {
+                var: ctx.state_updates.get((op_name, var), val)
+                for var, val in vars_.items()
+            }
+            for op_name, vars_ in state.items()
+        }
+        aux_loss = sum(ctx.aux_losses) if ctx.aux_losses else 0.0
+        return ctx.values, new_state, aux_loss
+
+    # -- step builders -----------------------------------------------------
+    def build_train_step(self, optimizer, loss_fn, metrics: Metrics,
+                         final_tensor, input_names: List[str]):
+        def train_step(params, opt_state, state, inputs, label, rng):
+            def loss_and_aux(p):
+                values, new_state, aux = self.forward_values(
+                    p, state, inputs, rng, CompMode.COMP_MODE_TRAINING
+                )
+                pred = values[final_tensor.guid]
+                loss = loss_fn(pred, label) + aux
+                mvals = metrics.compute(pred, label) if metrics else {}
+                return loss, (mvals, new_state)
+
+            (loss, (mvals, new_state)), grads = jax.value_and_grad(
+                loss_and_aux, has_aux=True
+            )(params)
+            new_params, new_opt_state = optimizer.update(params, grads, opt_state)
+            mvals = dict(mvals)
+            mvals["loss"] = loss
+            return new_params, new_opt_state, new_state, mvals
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return self._train_step
+
+    def build_eval_step(self, loss_fn, metrics: Metrics, final_tensor):
+        def eval_step(params, state, inputs, label):
+            values, _, _ = self.forward_values(
+                params, state, inputs, None, CompMode.COMP_MODE_INFERENCE
+            )
+            pred = values[final_tensor.guid]
+            mvals = metrics.compute(pred, label) if metrics else {}
+            mvals["loss"] = loss_fn(pred, label)
+            return mvals, pred
+
+        self._eval_step = jax.jit(eval_step)
+        return self._eval_step
+
+    def build_forward(self, final_tensor, mode: CompMode = CompMode.COMP_MODE_INFERENCE):
+        """mode matters for the manual loop: the reference's forward() during
+        training is a training-mode pass (dropout active, BN batch stats), so
+        FFModel passes its comp_mode here."""
+
+        def fwd(params, state, inputs, rng):
+            values, new_state, _ = self.forward_values(
+                params, state, inputs, rng, mode
+            )
+            return values[final_tensor.guid], new_state
+
+        self._forward_jit = jax.jit(fwd)
+        return self._forward_jit
+
+    def build_grad_step(self, loss_fn, final_tensor):
+        """Separate backward pass for the manual forward/backward/update API
+        (reference: FFModel::backward model.cc:2438)."""
+
+        def grad_step(params, state, inputs, label, rng):
+            def loss_of(p):
+                values, _, aux = self.forward_values(
+                    p, state, inputs, rng, CompMode.COMP_MODE_TRAINING
+                )
+                return loss_fn(values[final_tensor.guid], label) + aux
+
+            return jax.grad(loss_of)(params)
+
+        return jax.jit(grad_step)
+
+    def shard_batch(self, arr, batch_axis: int = 0):
+        """Place a host batch on the mesh, sharded over the data axis."""
+        if self.mesh is None or "data" not in self.mesh.axis_names:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = [None] * arr.ndim
+        # replicate when the batch doesn't divide the data axis (e.g. a
+        # short final eval batch) instead of failing the device_put
+        if arr.shape[batch_axis] % self.mesh.shape["data"] == 0:
+            spec[batch_axis] = "data"
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, PartitionSpec(*spec))
+        )

@@ -1,0 +1,78 @@
+"""Weight initializers.
+
+Mirrors the reference's initializer set (include/flexflow/initializer.h:26-110:
+Glorot/Zero/Uniform/Norm/Constant), each of which is a GPU Legion task there;
+here each is a pure function of a jax PRNG key, executed on device at
+`init_operators()` time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class GlorotUniformInitializer(Initializer):
+    """Glorot/Xavier uniform. For rank>2 kernels the correct fans depend on
+    the op's layout (e.g. OIHW conv: fan_in=I*Kh*Kw, fan_out=O*Kh*Kw), so ops
+    pass explicit fan_in/fan_out; the default covers rank-2 (in, out)."""
+
+    def __init__(self, seed: int = 0, fan_in: int = 0, fan_out: int = 0):
+        self.seed = seed
+        self.fan_in = fan_in
+        self.fan_out = fan_out
+
+    def __call__(self, key, shape, dtype):
+        fan_in, fan_out = self.fan_in, self.fan_out
+        if not (fan_in and fan_out):
+            if len(shape) >= 2:
+                fan_in, fan_out = int(np.prod(shape[:-1])), shape[-1]
+            elif len(shape) == 1:
+                fan_in = fan_out = shape[0]
+            else:
+                fan_in = fan_out = 1
+        scale = float(np.sqrt(6.0 / max(1, fan_in + fan_out)))
+        return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_val: float = -0.1, max_val: float = 0.1):
+        self.seed = seed
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(
+            key, shape, dtype, minval=self.min_val, maxval=self.max_val
+        )
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed = seed
+        self.mean = mean
+        self.stddev = stddev
+
+    def __call__(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+DefaultInitializer = GlorotUniformInitializer

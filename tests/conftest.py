@@ -1,0 +1,22 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+Mirrors SURVEY.md §4's implication: the reference can only test multi-device
+logic on a real cluster; we test multi-chip sharding without hardware via
+XLA's host-platform device-count override.
+
+Note: the TPU platform plugin may already be registered at interpreter start
+(site hook), so JAX_PLATFORMS in os.environ alone is not enough — we force the
+platform through jax.config, which takes effect before any backend client is
+created."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
