@@ -24,6 +24,7 @@ from .core.tensor import ParallelDim, ParallelTensorShape, Tensor
 from .core.machine import MachineResource, MachineView, make_mesh
 from .core.graph import Graph
 from . import ops  # registers all operator types
+from . import parallel  # registers parallel ops
 from .runtime.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .runtime.losses import Loss
 from .runtime.metrics import Metrics, PerfMetrics

@@ -31,8 +31,19 @@ class Graph:
 
     def __init__(self, ops: Sequence[Op] = ()):
         self.ops: Dict[int, Op] = {}
+        # tensor guid -> replacement tensor, recorded by substitutions that
+        # remove producers; resolve_tensor follows the chain so externally
+        # held references (e.g. FFModel.final_tensor) stay valid
+        self.tensor_aliases: Dict[int, "Tensor"] = {}
         for op in ops:
             self.add_op(op)
+
+    def resolve_tensor(self, tensor: Tensor) -> Tensor:
+        seen = set()
+        while tensor.guid in self.tensor_aliases and tensor.guid not in seen:
+            seen.add(tensor.guid)
+            tensor = self.tensor_aliases[tensor.guid]
+        return tensor
 
     def add_op(self, op: Op) -> None:
         self.ops[op.guid] = op

@@ -52,6 +52,9 @@ class LoweringContext:
         # auxiliary loss terms ops contribute (e.g. MoE load-balance loss);
         # summed into the training objective by the executor.
         self.aux_losses: List[Any] = []
+        # true while lowering inside a shard_map manual-collective region
+        # (ring attention, expert all_to_all) where lax collectives are legal
+        self.in_shard_map: bool = False
 
     def next_rng(self):
         import jax

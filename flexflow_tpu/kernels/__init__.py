@@ -1,0 +1,7 @@
+"""TPU kernels: manual-collective (shard_map) and Pallas implementations of
+the hot ops. The reference has no equivalent — cuDNN/cuBLAS play this role
+there; here ring attention (sequence/context parallelism over ICI) is a new
+capability required by BASELINE.md's north star."""
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["ring_attention", "ring_attention_sharded"]

@@ -56,6 +56,8 @@ class FFModel:
         self._step_count = 0
         self._compiled = False
         self._recompile_state = None
+        self._op_strategies = None
+        self.search_result = None
         self._dataloaders: List[Any] = []
         # node-key cache (reference: get_or_create_node, model.h:678-706)
         self._op_cache: Dict[Tuple, Op] = {}
@@ -310,6 +312,7 @@ class FFModel:
         add_bias_kv: bool = False,
         add_zero_attn: bool = False,
         causal: bool = False,
+        sequence_parallel: bool = False,
         kernel_initializer=None,
         name: str = "",
     ) -> Tensor:
@@ -326,6 +329,7 @@ class FFModel:
             add_bias_kv=add_bias_kv,
             add_zero_attn=add_zero_attn,
             causal=causal,
+            sequence_parallel=sequence_parallel,
             kernel_initializer=kernel_initializer,
         ).outputs[0]
 
@@ -400,6 +404,24 @@ class FFModel:
     def cache(self, input: Tensor, num_batches: int = 1, name: str = "") -> Tensor:
         return self._add_op(OpType.CACHE, [input], name, num_batches=num_batches).outputs[0]
 
+    # -- explicit parallel ops (reference: src/parallel_ops/) ------------
+    def repartition(self, input: Tensor, dim: int, degree: int,
+                    axis: Optional[str] = None, name: str = "") -> Tensor:
+        return self._add_op(OpType.REPARTITION, [input], name, dim=dim,
+                            degree=degree, axis=axis).outputs[0]
+
+    def combine(self, input: Tensor, dim: int, degree: int = 1, name: str = "") -> Tensor:
+        return self._add_op(OpType.COMBINE, [input], name, dim=dim, degree=degree).outputs[0]
+
+    def replicate(self, input: Tensor, degree: int = 1, name: str = "") -> Tensor:
+        return self._add_op(OpType.REPLICATE, [input], name, degree=degree).outputs[0]
+
+    def reduction(self, input: Tensor, degree: int = 1, name: str = "") -> Tensor:
+        return self._add_op(OpType.REDUCTION, [input], name, degree=degree).outputs[0]
+
+    def allreduce(self, input: Tensor, axis_name: str = "data", name: str = "") -> Tensor:
+        return self._add_op(OpType.ALLREDUCE, [input], name, axis_name=axis_name).outputs[0]
+
     def moe(
         self,
         input: Tensor,
@@ -450,12 +472,48 @@ class FFModel:
         self.label_tensor = Tensor(self._label_dims(), name="label")
         self.label_tensor._model = self
 
-        # -- strategy assignment ---------------------------------------
+        # -- strategy selection (reference: GRAPH_OPTIMIZE task model.cc:2826)
         n_dev = self.config.total_devices
+        self.search_result = None
+        self._op_strategies = None
         if parallel_axes is None:
-            parallel_axes = {"data": n_dev} if n_dev > 1 else {}
+            if self.config.import_strategy_file:
+                from .search.unity import import_strategy
+
+                strategies, axes = import_strategy(
+                    self.graph, self.config.import_strategy_file
+                )
+                self._op_strategies = strategies
+                parallel_axes = axes
+            elif (
+                self.config.search_budget > 0
+                and n_dev > 1
+                and not self.config.only_data_parallel
+            ):
+                from .search.machine_model import make_machine_model
+                from .search.unity import export_strategy, unity_optimize
+
+                machine = make_machine_model(self.config, n_dev)
+                self.search_result = unity_optimize(
+                    self.graph, self.config, machine,
+                    self.config.batch_size, n_dev,
+                )
+                self._op_strategies = self.search_result.strategies
+                parallel_axes = self.search_result.mesh_axes
+                if self.config.export_strategy_file:
+                    export_strategy(
+                        self.search_result, self.graph,
+                        self.config.export_strategy_file,
+                    )
+            else:
+                parallel_axes = {"data": n_dev} if n_dev > 1 else {}
         if self.config.only_data_parallel:
             parallel_axes = {"data": n_dev} if n_dev > 1 else {}
+        # substitutions may have removed/fused ops: follow tensor aliases and
+        # drop removed ops from the model so a re-compile() sees the rewritten
+        # graph, not the original op list
+        self.final_tensor = self.graph.resolve_tensor(self.final_tensor)
+        self.ops = [op for op in self.ops if op.guid in self.graph.ops]
         self.parallel_axes = dict(parallel_axes)
         self._assign_strategy(self.parallel_axes)
 
@@ -505,19 +563,61 @@ class FFModel:
         tp = axes.get("model", 1)
         view = MachineView(axes=tuple(axes.items()))
         for op in self.graph.topo_order():
+            # per-op search result overrides the mesh-wide default
+            s = (self._op_strategies or {}).get(op.guid)
+            op_dp = min(s.dp, dp) if s else dp
+            op_tp = min(s.tp, tp) if s else tp
             op.machine_view = view
             for t in list(op.outputs):
                 dims = []
                 for i, size in enumerate(t.dims):
-                    if i == 0 and dp > 1 and size == batch and size % dp == 0:
+                    if i == 0 and op_dp > 1 and size == batch and size % op_dp == 0:
                         dims.append(
-                            ParallelDim(size, dp, "data", kind=ParallelDimKind.SAMPLE)
+                            ParallelDim(size, op_dp, "data", kind=ParallelDimKind.SAMPLE)
                         )
                     else:
                         dims.append(ParallelDim(size, 1, None))
                 t.parallel_shape = ParallelTensorShape(dims, t.dtype)
-            if tp > 1:
-                self._assign_tp_weights(op, tp)
+            if op_tp > 1:
+                self._assign_tp_weights(op, op_tp)
+            elif tp > 1:
+                # non-TP op under a TP mesh: weights replicated
+                for w in op.weights:
+                    w.parallel_shape = ParallelTensorShape(
+                        [ParallelDim(sz, 1, None) for sz in w.dims], w.dtype
+                    )
+            # explicit parallel ops override the default output sharding
+            if op.op_type == OpType.REPARTITION:
+                degree = op.params["degree"]
+                # explicit axis param wins; else dim-kind convention
+                # (dim 0 = batch -> 'data', others -> 'model'); else any
+                # axis whose size matches
+                axis = op.params.get("axis")
+                if axis is None:
+                    cand = "data" if op.params["dim"] == 0 else "model"
+                    if axes.get(cand) == degree:
+                        axis = cand
+                    else:
+                        axis = next(
+                            (n for n, s in axes.items() if s == degree), None
+                        )
+                if axis is None:
+                    if degree > 1 and axes:
+                        raise ValueError(
+                            f"repartition {op.name}: no mesh axis of size "
+                            f"{degree} in {axes}"
+                        )
+                elif axes.get(axis) != degree:
+                    raise ValueError(
+                        f"repartition {op.name}: axis {axis!r} has size "
+                        f"{axes.get(axis)}, need {degree}"
+                    )
+                else:
+                    op.apply_parallel_shape(axis)
+            elif op.op_type == OpType.COMBINE:
+                op.apply_parallel_shape()
+            elif op.op_type == OpType.REPLICATE:
+                op.apply_parallel_shape()
 
     def _assign_tp_weights(self, op: Op, tp: int) -> None:
         """Shard weight dims over the 'model' axis where the op supports TP."""

@@ -36,6 +36,13 @@ class MultiHeadAttentionOp(Op):
 
     def output_shapes(self):
         q, k, v, embed, heads, kdim, vdim = self._dims()
+        if self.params.get("sequence_parallel") and self.params.get("dropout", 0.0) > 0:
+            # the ring kernel has no attention-probability dropout; fail loudly
+            # rather than silently train with different regularization
+            raise ValueError(
+                "sequence_parallel attention does not support attention-prob "
+                "dropout; set dropout=0 or sequence_parallel=False"
+            )
         return [q.dims[:-1] + (embed,)], [q.dtype]
 
     def weight_specs(self) -> List[WeightSpec]:
@@ -76,6 +83,32 @@ class MultiHeadAttentionOp(Op):
             v = v + weights["bv"].astype(cdt)
 
         scale = 1.0 / np.sqrt(kdim)
+
+        if (
+            p.get("sequence_parallel", False)
+            and ctx.mesh is not None
+            and "seq" in getattr(ctx.mesh, "axis_names", ())
+        ):
+            # sequence/context parallelism: ring attention over the 'seq'
+            # mesh axis (kernels/ring_attention.py) — K/V blocks rotate on
+            # ICI neighbor links instead of materializing the full L x L
+            # score matrix per chip
+            from ..kernels.ring_attention import ring_attention_sharded
+
+            ctxv = ring_attention_sharded(
+                q, k, v, ctx.mesh, axis_name="seq",
+                causal=p.get("causal", False), scale=scale,
+            )
+            out = jnp.einsum(
+                "bqhd,hde->bqe",
+                ctxv.astype(cdt),
+                weights["wo"].astype(cdt),
+                preferred_element_type=jnp.float32,
+            ).astype(self.outputs[0].dtype.jnp_dtype)
+            if "bo" in weights:
+                out = out + weights["bo"]
+            return [out]
+
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
         ) * scale

@@ -1,0 +1,17 @@
+from .parallel_ops import (
+    AllReduceOp,
+    CombineOp,
+    FusedParallelOp,
+    ReductionOp,
+    RepartitionOp,
+    ReplicateOp,
+)
+
+__all__ = [
+    "RepartitionOp",
+    "CombineOp",
+    "ReplicateOp",
+    "ReductionOp",
+    "AllReduceOp",
+    "FusedParallelOp",
+]

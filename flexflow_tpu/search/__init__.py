@@ -1,0 +1,22 @@
+from .machine_model import (
+    MachineModel,
+    SimpleMachineModel,
+    TpuPodModel,
+    NetworkedMachineModel,
+)
+from .simulator import CostModel, Simulator, OpCostCache
+from .unity import GraphSearchHelper, unity_optimize
+from .mcmc import mcmc_optimize
+
+__all__ = [
+    "MachineModel",
+    "SimpleMachineModel",
+    "TpuPodModel",
+    "NetworkedMachineModel",
+    "CostModel",
+    "Simulator",
+    "OpCostCache",
+    "GraphSearchHelper",
+    "unity_optimize",
+    "mcmc_optimize",
+]

