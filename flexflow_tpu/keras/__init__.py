@@ -1,0 +1,27 @@
+"""flexflow_tpu.keras: tf.keras-compatible frontend over the core FFModel.
+
+reference parity: python/flexflow/keras/ (SURVEY.md §2.6) — Sequential and
+functional Model, layer/optimizer/loss/metric/initializer/regularizer/callback
+surface, datasets, preprocessing. compile() builds an FFModel and runs the
+normal strategy-search + jit pipeline; fit() drives the same training loop.
+"""
+from . import (
+    callbacks,
+    datasets,
+    initializers,
+    layers,
+    losses,
+    metrics,
+    models,
+    optimizers,
+    preprocessing,
+    regularizers,
+    utils,
+)
+from .models import Model, Sequential
+
+__all__ = [
+    "models", "layers", "optimizers", "losses", "metrics", "callbacks",
+    "initializers", "regularizers", "datasets", "preprocessing", "utils",
+    "Model", "Sequential",
+]

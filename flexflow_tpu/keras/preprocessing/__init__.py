@@ -1,0 +1,3 @@
+from . import sequence, text
+
+__all__ = ["sequence", "text"]

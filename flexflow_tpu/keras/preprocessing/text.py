@@ -1,0 +1,52 @@
+"""Text preprocessing. reference parity:
+python/flexflow/keras/preprocessing/text.py (Tokenizer)."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+
+def text_to_word_sequence(text: str, lower: bool = True) -> List[str]:
+    if lower:
+        text = text.lower()
+    for ch in '!"#$%&()*+,-./:;<=>?@[\\]^_`{|}~\t\n':
+        text = text.replace(ch, " ")
+    return [w for w in text.split(" ") if w]
+
+
+class Tokenizer:
+    def __init__(self, num_words=None, lower: bool = True, oov_token=None):
+        self.num_words = num_words
+        self.lower = lower
+        self.oov_token = oov_token
+        self.word_counts = Counter()
+        self.word_index = {}
+
+    def fit_on_texts(self, texts):
+        for text in texts:
+            self.word_counts.update(text_to_word_sequence(text, self.lower))
+        idx = 1
+        self.word_index = {}
+        if self.oov_token is not None:
+            self.word_index[self.oov_token] = idx
+            idx += 1
+        for word, _ in self.word_counts.most_common():
+            self.word_index[word] = idx
+            idx += 1
+
+    def texts_to_sequences(self, texts):
+        oov = self.word_index.get(self.oov_token) if self.oov_token else None
+        out = []
+        for text in texts:
+            seq = []
+            for w in text_to_word_sequence(text, self.lower):
+                i = self.word_index.get(w, oov)
+                if i is None:
+                    continue
+                if self.num_words and i >= self.num_words:
+                    i = oov
+                    if i is None:
+                        continue
+                seq.append(i)
+            out.append(seq)
+        return out

@@ -1,0 +1,34 @@
+"""Weight regularizers, applied as extra loss terms on the named weight.
+
+reference parity: python/flexflow/keras/regularizers.py.
+"""
+from __future__ import annotations
+
+
+class Regularizer:
+    def __call__(self, weight):
+        raise NotImplementedError
+
+
+class L1L2(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def __call__(self, weight):
+        import jax.numpy as jnp
+
+        total = 0.0
+        if self.l1:
+            total = total + self.l1 * jnp.sum(jnp.abs(weight))
+        if self.l2:
+            total = total + self.l2 * jnp.sum(weight * weight)
+        return total
+
+
+def L1(l1: float = 0.01) -> L1L2:
+    return L1L2(l1=l1)
+
+
+def L2(l2: float = 0.01) -> L1L2:
+    return L1L2(l2=l2)

@@ -59,8 +59,15 @@ class FFModel:
         self._op_strategies = None
         self.search_result = None
         self._dataloaders: List[Any] = []
+        # (op_name, weight_name, fn) regularization terms added to the loss
+        self.weight_regularizers: List[Tuple[str, str, Any]] = []
         # node-key cache (reference: get_or_create_node, model.h:678-706)
         self._op_cache: Dict[Tuple, Op] = {}
+
+    def add_weight_regularizer(self, op_name: str, weight_name: str, fn) -> None:
+        """Add a per-weight regularization term fn(weight)->scalar to the
+        training loss (keras kernel_regularizer support)."""
+        self.weight_regularizers.append((op_name, weight_name, fn))
 
     # ------------------------------------------------------------------
     # tensor & op creation
@@ -526,8 +533,20 @@ class FFModel:
             jax.random.PRNGKey(self.config.seed)
         )
         input_names = [op.name for op in self.input_ops]
+        reg_fn = None
+        if self.weight_regularizers:
+            regs = list(self.weight_regularizers)
+
+            def reg_fn(params):
+                total = 0.0
+                for op_name, w_name, fn in regs:
+                    if op_name in params and w_name in params[op_name]:
+                        total = total + fn(params[op_name][w_name])
+                return total
+
         self._train_step = self.executor.build_train_step(
-            self.optimizer, self.loss.fn, self.metrics, self.final_tensor, input_names
+            self.optimizer, self.loss.fn, self.metrics, self.final_tensor, input_names,
+            reg_fn=reg_fn,
         )
         self._eval_step = self.executor.build_eval_step(
             self.loss.fn, self.metrics, self.final_tensor
@@ -773,6 +792,27 @@ class FFModel:
         self.params, self.opt_state = self.optimizer.update(
             self.params, self._manual["grads"], self.opt_state
         )
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Change the learning rate without recompiling (lr is carried as a
+        traced scalar in opt_state)."""
+        self.opt_state = self.optimizer.set_lr(self.opt_state, lr)
+
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Inference-mode forward over a dataset, batched. Returns the final
+        tensor's values stacked over all samples."""
+        assert self._compiled
+        if isinstance(x, np.ndarray):
+            x = [x]
+        bs = batch_size or self.config.batch_size
+        n = x[0].shape[0]
+        outs = []
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            inputs = self._prep_inputs(x, lo, hi)
+            pred, _ = self._infer_fn(self.params, self.state, inputs, self._next_rng())
+            outs.append(np.asarray(pred))
+        return np.concatenate(outs, axis=0)
 
     def reset_metrics(self):
         self.perf_metrics = PerfMetrics()

@@ -69,6 +69,9 @@ class Conv2DOp(Op):
         x = inputs[0]
         p = self.params
         cdt = matmul_dtype(ctx.config, x.dtype)
+        # conv runs fully in the compute dtype (bf16 on the MXU, which still
+        # accumulates in f32 internally); keeping operand/output dtypes equal
+        # keeps the VJP's transposed convs well-typed
         y = jax.lax.conv_general_dilated(
             x.astype(cdt),
             weights["kernel"].astype(cdt),
@@ -76,7 +79,6 @@ class Conv2DOp(Op):
             padding=[(p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=p.get("groups", 1),
-            preferred_element_type=jnp.float32,
         ).astype(self.outputs[0].dtype.jnp_dtype)
         if "bias" in weights:
             y = y + weights["bias"][None, :, None, None]

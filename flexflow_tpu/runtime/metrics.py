@@ -26,6 +26,7 @@ class PerfMetrics:
     mse_loss: float = 0.0
     rmse_loss: float = 0.0
     mae_loss: float = 0.0
+    loss_sum: float = 0.0
     start_time: float = 0.0
 
     def update(self, batch: int, vals: Dict[str, float]) -> None:
@@ -37,6 +38,7 @@ class PerfMetrics:
         self.mse_loss += vals.get("mse", 0.0) * batch
         self.rmse_loss += vals.get("rmse", 0.0) * batch
         self.mae_loss += vals.get("mae", 0.0) * batch
+        self.loss_sum += vals.get("loss", 0.0) * batch
 
     @property
     def accuracy(self) -> float:
@@ -47,6 +49,7 @@ class PerfMetrics:
         return {
             "samples": self.train_all,
             "accuracy": self.accuracy,
+            "loss": self.loss_sum / n,
             "cce": self.cce_loss / n,
             "sparse_cce": self.sparse_cce_loss / n,
             "mse": self.mse_loss / n,

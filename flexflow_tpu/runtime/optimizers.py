@@ -22,6 +22,14 @@ class Optimizer:
     def update(self, params, grads, opt_state) -> Tuple[Any, Any]:
         raise NotImplementedError
 
+    def set_lr(self, opt_state, lr: float):
+        """Return opt_state with a new learning rate. The lr is carried in
+        opt_state (a traced scalar), so schedules (keras
+        LearningRateScheduler) change it without recompiling the train step."""
+        new = dict(opt_state)
+        new["lr"] = jnp.asarray(lr, jnp.float32)
+        return new
+
 
 class SGDOptimizer(Optimizer):
     """reference: optimizer.h:33-60, optimizer_kernel.cu sgd_update."""
@@ -34,15 +42,17 @@ class SGDOptimizer(Optimizer):
         self.weight_decay = weight_decay
 
     def init_state(self, params):
-        if self.momentum == 0.0:
-            return {"step": jnp.zeros((), jnp.int32)}
-        return {
+        base = {
             "step": jnp.zeros((), jnp.int32),
-            "v": jax.tree.map(jnp.zeros_like, params),
+            "lr": jnp.asarray(self.lr, jnp.float32),
         }
+        if self.momentum != 0.0:
+            base["v"] = jax.tree.map(jnp.zeros_like, params)
+        return base
 
     def update(self, params, grads, opt_state):
-        lr, mom, wd = self.lr, self.momentum, self.weight_decay
+        mom, wd = self.momentum, self.weight_decay
+        lr = opt_state.get("lr", self.lr)
 
         if mom == 0.0:
             def upd(w, g):
@@ -50,7 +60,7 @@ class SGDOptimizer(Optimizer):
                 return (w - lr * gt).astype(w.dtype)
 
             new_params = jax.tree.map(upd, params, grads)
-            return new_params, {"step": opt_state["step"] + 1}
+            return new_params, {"step": opt_state["step"] + 1, "lr": lr}
 
         def upd(w, g, v):
             gt = g + wd * w if wd else g
@@ -61,7 +71,7 @@ class SGDOptimizer(Optimizer):
         flat = jax.tree.map(upd, params, grads, opt_state["v"])
         new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
         new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
-        return new_params, {"step": opt_state["step"] + 1, "v": new_v}
+        return new_params, {"step": opt_state["step"] + 1, "lr": lr, "v": new_v}
 
 
 class AdamOptimizer(Optimizer):
@@ -82,15 +92,17 @@ class AdamOptimizer(Optimizer):
     def init_state(self, params):
         return {
             "step": jnp.zeros((), jnp.int32),
+            "lr": jnp.asarray(self.alpha, jnp.float32),
             "m": jax.tree.map(jnp.zeros_like, params),
             "v": jax.tree.map(jnp.zeros_like, params),
         }
 
     def update(self, params, grads, opt_state):
         b1, b2, wd, eps = self.beta1, self.beta2, self.weight_decay, self.epsilon
+        alpha = opt_state.get("lr", self.alpha)
         step = opt_state["step"] + 1
         t = step.astype(jnp.float32)
-        alpha_t = self.alpha * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+        alpha_t = alpha * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
 
         def upd(w, g, m, v):
             g32 = g.astype(jnp.float32)
@@ -108,6 +120,7 @@ class AdamOptimizer(Optimizer):
             jax.tree.map(lambda t: t[0], out, is_leaf=is3),
             {
                 "step": step,
+                "lr": alpha,
                 "m": jax.tree.map(lambda t: t[1], out, is_leaf=is3),
                 "v": jax.tree.map(lambda t: t[2], out, is_leaf=is3),
             },

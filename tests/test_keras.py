@@ -1,0 +1,257 @@
+"""Keras frontend tests (reference test model: tests/python_interface_test.sh,
+examples/python/keras/*)."""
+import numpy as np
+import pytest
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.layers import (
+    Activation,
+    Add,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    MaxPooling2D,
+)
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.config import FFConfig
+
+
+def small_config(batch=16):
+    c = FFConfig()
+    c.batch_size = batch
+    c.num_devices = 1
+    return c
+
+
+def separable_data(n=128, dim=20, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3.0
+    y = rng.randint(0, classes, size=n).astype(np.int32)
+    x = (centers[y] + rng.randn(n, dim) * 0.5).astype(np.float32)
+    return x, y.reshape(-1, 1)
+
+
+def test_sequential_mlp_learns():
+    x, y = separable_data()
+    model = Sequential()
+    model.add(Dense(32, activation="relu", input_shape=(20,)))
+    model.add(Dense(4))
+    model.add(Activation("softmax"))
+    model.compile(
+        optimizer=keras.optimizers.SGD(learning_rate=0.1),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        ffconfig=small_config(),
+    )
+    hist = model.fit(x, y, epochs=8)
+    assert hist.history["accuracy"][-1] > 0.8
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_sequential_cnn_compiles_and_trains():
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 2, size=(16, 1)).astype(np.int32)
+    model = Sequential()
+    model.add(Conv2D(filters=4, input_shape=(3, 8, 8), kernel_size=(3, 3),
+                     strides=(1, 1), padding=(1, 1), activation="relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"))
+    model.add(Flatten())
+    model.add(Dense(2))
+    model.add(Activation("softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    hist = model.fit(x, y, epochs=1)
+    assert "loss" in hist.history
+
+
+def test_functional_model_merge():
+    a = Input(shape=(10,))
+    b = Input(shape=(10,))
+    ha = Dense(8, activation="relu")(a)
+    hb = Dense(8, activation="relu")(b)
+    merged = Concatenate(axis=1)([ha, hb])
+    out = Dense(3, activation="softmax")(merged)
+    model = Model(inputs=[a, b], outputs=out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    rng = np.random.RandomState(1)
+    xa = rng.rand(32, 10).astype(np.float32)
+    xb = rng.rand(32, 10).astype(np.float32)
+    y = rng.randint(0, 3, size=(32, 1)).astype(np.int32)
+    hist = model.fit([xa, xb], y, epochs=2)
+    assert len(hist.history["loss"]) == 2
+    s = model.summary()
+    assert "Total params" in s
+
+
+def test_residual_add():
+    a = Input(shape=(16,))
+    h = Dense(16, activation="relu")(a)
+    res = Add()([a, h])
+    out = Dense(2, activation="softmax")(res)
+    model = Model(inputs=a, outputs=out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    x = np.random.RandomState(2).rand(16, 16).astype(np.float32)
+    y = np.zeros((16, 1), dtype=np.int32)
+    model.fit(x, y, epochs=1)
+
+
+def test_embedding_sequential():
+    model = Sequential()
+    model.add(Embedding(100, 8, input_shape=(12,)))
+    model.add(Flatten())
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    x = np.random.RandomState(3).randint(0, 100, size=(16, 12)).astype(np.int32)
+    y = np.random.RandomState(4).randint(0, 4, size=(16, 1)).astype(np.int32)
+    model.fit(x, y, epochs=1)
+
+
+def test_lr_scheduler_and_early_stop():
+    x, y = separable_data(n=64)
+    lrs = []
+
+    def schedule(epoch):
+        lr = 0.1 * (0.5 ** epoch)
+        lrs.append(lr)
+        return lr
+
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(20,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    cb = keras.callbacks.LearningRateScheduler(schedule)
+    stop = keras.callbacks.EpochVerifyMetrics(10.0)  # stop once acc >= 10%
+    hist = model.fit(x, y, epochs=6, callbacks=[cb, stop])
+    assert len(lrs) >= 1
+    assert len(hist.epoch) < 6  # early stop triggered
+
+
+def test_predict_and_weights_roundtrip():
+    x, y = separable_data(n=32)
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(20,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    pred = model.predict(x)
+    assert pred.shape == (32, 4)
+    w = model.get_weights()
+    w2 = [np.zeros_like(a) for a in w]
+    model.set_weights(w2)
+    assert float(np.abs(model.get_weights()[0]).sum()) == 0.0
+    model.set_weights(w)
+
+
+def test_regularizer_increases_loss():
+    x, y = separable_data(n=32)
+    def build(reg):
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(20,),
+                        kernel_regularizer=reg))
+        model.add(Dense(4, activation="softmax"))
+        model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.0),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=[], ffconfig=small_config())
+        return model.fit(x, y, epochs=1).history["loss"][0]
+
+    base = build(None)
+    reg = build(keras.regularizers.L2(10.0))
+    assert reg > base
+
+
+def test_datasets_and_utils():
+    (xt, yt), (xv, yv) = keras.datasets.mnist.load_data()
+    assert xt.shape[1:] == (28, 28) and xt.dtype == np.uint8
+    (xc, yc), _ = keras.datasets.cifar10.load_data(num_samples=100)
+    assert xc.shape == (100, 3, 32, 32) and yc.shape == (100, 1)
+    (xr, yr), _ = keras.datasets.reuters.load_data()
+    assert len(xr) > 0
+
+    oh = keras.utils.to_categorical([0, 2, 1], 3)
+    assert oh.shape == (3, 3) and oh[1, 2] == 1
+
+    padded = keras.preprocessing.sequence.pad_sequences([[1, 2], [3]], maxlen=4)
+    assert padded.shape == (2, 4) and padded[0, -1] == 2
+
+    tok = keras.preprocessing.text.Tokenizer()
+    tok.fit_on_texts(["hello world", "hello there"])
+    seqs = tok.texts_to_sequences(["hello world"])
+    assert len(seqs[0]) == 2
+
+
+def test_model_checkpoint_callback(tmp_path):
+    x, y = separable_data(n=32)
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(20,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], ffconfig=small_config())
+    path = str(tmp_path / "ckpt_{epoch}")
+    cb = keras.callbacks.ModelCheckpoint(path)
+    model.fit(x, y, epochs=2, callbacks=[cb])
+    import os
+
+    assert os.path.exists(str(tmp_path / "ckpt_1.npz"))
+
+    from flexflow_tpu.runtime.checkpoint import restore_checkpoint
+
+    step = restore_checkpoint(str(tmp_path / "ckpt_1"), model.ffmodel)
+    assert step == 1
+
+
+def test_stable_layer_names_across_models():
+    def build():
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(20,)))
+        m.add(Dense(4, activation="softmax"))
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=[], ffconfig=small_config())
+        return sorted(m.ffmodel.params.keys())
+
+    names1 = build()
+    names2 = build()  # second model in same process must get identical keys
+    assert names1 == names2
+
+
+def test_bfloat16_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    import ml_dtypes
+    from flexflow_tpu.runtime.checkpoint import restore_checkpoint, save_checkpoint
+
+    x, y = separable_data(n=32)
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(20,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=[], ffconfig=small_config())
+    fm = model.ffmodel
+    # force a bf16 param
+    op = sorted(fm.params)[0]
+    w = sorted(fm.params[op])[0]
+    orig = fm.params[op][w]
+    fm.params[op][w] = orig.astype(jnp.bfloat16)
+    save_checkpoint(str(tmp_path / "bf16"), fm, step=3)
+    fm.params[op][w] = orig
+    step = restore_checkpoint(str(tmp_path / "bf16"), fm)
+    assert step == 3
+    assert fm.params[op][w].dtype == jnp.bfloat16
+
+
+def test_same_padding_stride_aware():
+    from flexflow_tpu.keras.layers.convolutional import _padding
+
+    # stride==kernel -> no padding (reference formula max(k-s,0)//2)
+    assert _padding("same", (2, 2), (2, 2)) == (0, 0)
+    assert _padding("same", (3, 3), (1, 1)) == (1, 1)
+    pool = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="same")
+    assert pool.compute_output_shape([(None, 4, 8, 8)]) == (None, 4, 4, 4)
