@@ -51,14 +51,14 @@ class BaseModel:
         """Rename auto-named layers deterministically by position within THIS
         model (class-global counters would make op names — the checkpoint
         pytree keys — depend on how many models the process built before)."""
-        import re
+        from ..layers.base_layer import _snake
 
         counts: Dict[str, int] = {}
         taken = {l.name for l in self._layers if not getattr(l, "_auto_named", False)}
         for layer in self._layers:
             if not getattr(layer, "_auto_named", False):
                 continue
-            base = re.sub(r"(?<!^)(?=[A-Z])", "_", type(layer).__name__).lower()
+            base = _snake(type(layer).__name__)
             while True:
                 idx = counts.get(base, 0)
                 counts[base] = idx + 1
@@ -136,27 +136,13 @@ class BaseModel:
                 self.ffmodel.params[op_name][w_name] = jnp.asarray(next(it))
 
     def summary(self) -> str:
-        lines = [f'Model: "{self.name}"', "-" * 64,
-                 f"{'Layer':<28}{'Output Shape':<22}{'Params':>12}", "-" * 64]
+        lines = [f'Model: "{self.name}"', "-" * 52,
+                 f"{'Layer':<28}{'Params':>12}", "-" * 52]
         total = 0
-        seen = set()
-
-        def walk(t: KerasTensor):
-            for i in t.inputs:
-                walk(i)
-            if t.layer is not None and id(t.layer) not in seen:
-                seen.add(id(t.layer))
-                n = t.layer.count_params()
-                total_shape = tuple(d if d is not None else -1 for d in t.shape)
-                lines.append(
-                    f"{t.layer.name:<28}{str(total_shape):<22}{n:>12}"
-                )
-                nonlocal_total[0] = nonlocal_total[0] + n
-
-        nonlocal_total = [0]
-        for t in self.outputs:
-            walk(t)
-        total = nonlocal_total[0]
-        lines.append("-" * 64)
+        for layer in self._layers:
+            n = layer.count_params()
+            total += n
+            lines.append(f"{layer.name:<28}{n:>12}")
+        lines.append("-" * 52)
         lines.append(f"Total params: {total}")
         return "\n".join(lines)

@@ -1,0 +1,3 @@
+from .model import ONNXModel, ONNXModelKeras
+
+__all__ = ["ONNXModel", "ONNXModelKeras"]

@@ -1,0 +1,232 @@
+"""ONNX importer.
+
+reference parity: python/flexflow/onnx/model.py:56 (ONNXModel(path).apply
+(ffmodel, inputs)) and :339 (ONNXModelKeras). Requires the `onnx` package at
+construction time (not baked into every environment — import is deferred so
+the rest of the framework works without it).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from ..core.tensor import Tensor
+from ..ffconst import ActiMode, AggrMode, DataType, PoolType
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return onnx
+    except ImportError as e:  # pragma: no cover - environment dependent
+        raise ImportError(
+            "the onnx package is required for flexflow_tpu.onnx; install onnx "
+            "or use the torch.fx / keras frontends"
+        ) from e
+
+
+def _attrs(node) -> Dict:
+    import onnx
+
+    out = {}
+    for a in node.attribute:
+        out[a.name] = onnx.helper.get_attribute_value(a)
+    return out
+
+
+class ONNXModel:
+    """Replays an ONNX graph as flexflow_tpu layer calls."""
+
+    def __init__(self, path_or_proto):
+        onnx = _require_onnx()
+        if isinstance(path_or_proto, (str, bytes)):
+            self.model = onnx.load(path_or_proto)
+        else:
+            self.model = path_or_proto
+        self.graph = self.model.graph
+        self.inits = {i.name: i for i in self.graph.initializer}
+
+    def _init_array(self, name):
+        import onnx.numpy_helper as nph
+
+        return nph.to_array(self.inits[name])
+
+    def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
+        env: Dict[str, object] = {}
+        graph_inputs = [i.name for i in self.graph.input if i.name not in self.inits]
+        for name, t in zip(graph_inputs, input_tensors):
+            env[name] = t
+        self._pending_weights: Dict[str, Dict[str, object]] = {}
+        for node in self.graph.node:
+            self._emit(ffmodel, node, env)
+        return [env[o.name] for o in self.graph.output]
+
+    # ------------------------------------------------------------------
+    def _emit(self, fm, node, env):
+        op = node.op_type
+        at = _attrs(node)
+        ins = node.input
+        name = node.name or node.output[0]
+
+        def x(i=0):
+            return env[ins[i]]
+
+        if op == "Gemm":
+            w = self._init_array(ins[1])
+            out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
+            t = fm.dense(x(), int(out_dim), ActiMode.AC_MODE_NONE,
+                         use_bias=len(ins) > 2, name=name)
+            self._stash(name, kernel=w.T if at.get("transB", 0) else w,
+                        bias=self._init_array(ins[2]) if len(ins) > 2 else None)
+        elif op == "MatMul":
+            if ins[1] in self.inits:
+                w = self._init_array(ins[1])
+                t = fm.dense(x(), int(w.shape[-1]), ActiMode.AC_MODE_NONE,
+                             use_bias=False, name=name)
+                self._stash(name, kernel=w)
+            else:
+                t = fm.batch_matmul(x(0), x(1), name=name)
+        elif op == "Conv":
+            w = self._init_array(ins[1])
+            kh, kw = at.get("kernel_shape", w.shape[2:])
+            strides = at.get("strides", [1, 1])
+            pads = at.get("pads", [0, 0, 0, 0])
+            t = fm.conv2d(x(), int(w.shape[0]), int(kh), int(kw),
+                          int(strides[0]), int(strides[1]),
+                          int(pads[0]), int(pads[1]),
+                          groups=int(at.get("group", 1)),
+                          use_bias=len(ins) > 2, name=name)
+            self._stash(name, kernel=w,
+                        bias=self._init_array(ins[2]) if len(ins) > 2 else None)
+        elif op in ("MaxPool", "AveragePool"):
+            kh, kw = at["kernel_shape"]
+            strides = at.get("strides", [1, 1])
+            pads = at.get("pads", [0, 0, 0, 0])
+            pt = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
+            t = fm.pool2d(x(), int(kh), int(kw), int(strides[0]), int(strides[1]),
+                          int(pads[0]), int(pads[1]), pool_type=pt, name=name)
+        elif op == "GlobalAveragePool":
+            _, _, h, w_ = x().dims
+            t = fm.pool2d(x(), h, w_, 1, 1, 0, 0, pool_type=PoolType.POOL_AVG,
+                          name=name)
+        elif op == "Relu":
+            t = fm.relu(x(), name=name)
+        elif op == "Sigmoid":
+            t = fm.sigmoid(x(), name=name)
+        elif op == "Tanh":
+            t = fm.tanh(x(), name=name)
+        elif op == "Elu":
+            t = fm.elu(x(), name=name)
+        elif op == "Gelu":
+            t = fm.gelu(x(), name=name)
+        elif op == "Softmax":
+            t = fm.softmax(x(), int(at.get("axis", -1)), name=name)
+        elif op == "Dropout":
+            t = fm.dropout(x(), float(at.get("ratio", 0.5)), name=name)
+        elif op == "Flatten":
+            t = fm.flat(x(), name=name)
+        elif op == "Reshape":
+            shape = [int(v) for v in self._init_array(ins[1])]
+            if -1 in shape or 0 in shape:
+                import math
+
+                dims = list(x().dims)
+                shape = [dims[i] if s == 0 else s for i, s in enumerate(shape)]
+                if -1 in shape:
+                    known = math.prod(s for s in shape if s != -1)
+                    shape[shape.index(-1)] = math.prod(dims) // known
+            t = fm.reshape(x(), shape, name=name)
+        elif op == "Transpose":
+            t = fm.transpose(x(), [int(v) for v in at["perm"]], name=name)
+        elif op == "Concat":
+            t = fm.concat([env[i] for i in ins], int(at["axis"]), name=name)
+        elif op == "Split":
+            sizes = [int(v) for v in at.get("split", self._init_array(ins[1])
+                                            if len(ins) > 1 else [])]
+            parts = fm.split(x(), sizes, int(at.get("axis", 0)), name=name)
+            for out_name, part in zip(node.output, parts):
+                env[out_name] = part
+            return
+        elif op == "Add":
+            t = self._binary(fm, fm.add, fm.scalar_add, env, ins, name)
+        elif op == "Sub":
+            t = self._binary(fm, fm.subtract, fm.scalar_sub, env, ins, name)
+        elif op == "Mul":
+            t = self._binary(fm, fm.multiply, fm.scalar_multiply, env, ins, name)
+        elif op == "Div":
+            t = self._binary(fm, fm.divide, fm.scalar_true_divide, env, ins, name)
+        elif op == "ReduceMean":
+            axes = [int(v) for v in at.get("axes", [])] or [
+                int(v) for v in self._init_array(ins[1])
+            ]
+            t = fm.mean(x(), axes, bool(at.get("keepdims", 1)), name=name)
+        elif op == "ReduceSum":
+            axes = [int(v) for v in at.get("axes", [])] or [
+                int(v) for v in self._init_array(ins[1])
+            ]
+            t = fm.reduce_sum(x(), axes, bool(at.get("keepdims", 1)), name=name)
+        elif op == "Cast":
+            onnx_to_ff = {1: DataType.DT_FLOAT, 6: DataType.DT_INT32,
+                          7: DataType.DT_INT64, 10: DataType.DT_HALF,
+                          11: DataType.DT_DOUBLE}
+            t = fm.cast(x(), onnx_to_ff[int(at["to"])], name=name)
+        elif op == "Gather" and ins[0] in self.inits:
+            w = self._init_array(ins[0])
+            t = fm.embedding(env[ins[1]], int(w.shape[0]), int(w.shape[1]),
+                             AggrMode.AGGR_MODE_NONE, name=name)
+            self._stash(name, weight=w)
+        elif op == "Identity":
+            t = x()
+        else:
+            raise NotImplementedError(f"ONNX op {op} not supported")
+        env[node.output[0]] = t
+
+    def _binary(self, fm, tensor_fn, scalar_fn, env, ins, name):
+        a_const = ins[0] in self.inits
+        b_const = ins[1] in self.inits
+        if not a_const and not b_const:
+            return tensor_fn(env[ins[0]], env[ins[1]], name=name)
+        arr = self._init_array(ins[0] if a_const else ins[1])
+        t = env[ins[1] if a_const else ins[0]]
+        if arr.size != 1:
+            raise NotImplementedError("binary op with non-scalar initializer")
+        c = float(arr.reshape(()))
+        if not a_const:
+            return scalar_fn(t, c, name=name)
+        # constant on the LEFT: rewrite the non-commutative cases
+        if tensor_fn is fm.subtract:  # c - t
+            return fm.scalar_add(fm.scalar_multiply(t, -1.0, name=f"{name}_neg"),
+                                 c, name=name)
+        if tensor_fn is fm.divide:  # c / t
+            return fm.scalar_multiply(fm.pow(t, -1.0, name=f"{name}_inv"),
+                                      c, name=name)
+        return scalar_fn(t, c, name=name)
+
+    def _stash(self, name, **arrays):
+        self._pending_weights[name] = {
+            k: v for k, v in arrays.items() if v is not None
+        }
+
+    def transfer_weights(self, ffmodel) -> int:
+        """Copy the ONNX initializer values into the compiled FFModel."""
+        import jax.numpy as jnp
+
+        copied = 0
+        for name, slot in (self._pending_weights or {}).items():
+            if name not in (ffmodel.params or {}):
+                continue
+            for key, arr in slot.items():
+                if key in ffmodel.params[name]:
+                    ffmodel.params[name][key] = jnp.asarray(arr).astype(
+                        ffmodel.params[name][key].dtype
+                    )
+                    copied += 1
+        return copied
+
+
+class ONNXModelKeras(ONNXModel):
+    """reference parity: onnx/model.py:339 — same replay, constructed from a
+    keras-exported ONNX proto."""
+
+    def __init__(self, path_or_proto, ffconfig=None, ffmodel=None):
+        super().__init__(path_or_proto)

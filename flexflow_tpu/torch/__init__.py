@@ -1,0 +1,12 @@
+"""flexflow_tpu.torch: PyTorch (torch.fx) frontend.
+
+reference parity: python/flexflow/torch/ (SURVEY.md §2.6) —
+fx.torch_to_flexflow(model, path) serializes a symbolic trace to a .ff file;
+PyTorchModel(path_or_module).apply(ffmodel, inputs) replays the graph as
+flexflow_tpu layer calls. Extension over the reference: optional weight
+transfer from the torch module into the compiled FFModel.
+"""
+from . import fx
+from .model import PyTorchModel
+
+__all__ = ["fx", "PyTorchModel"]

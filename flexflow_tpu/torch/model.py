@@ -1,0 +1,377 @@
+"""Replay a torch.fx trace (.ff records) into flexflow_tpu layer calls.
+
+reference parity: python/flexflow/torch/model.py:2408 (PyTorchModel.apply and
+the per-op Node translation classes at model.py:43+). Design differs: one
+dispatch table over serialized JSON records instead of a class per op.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core.tensor import Tensor
+from ..ffconst import ActiMode, AggrMode, PoolType
+
+
+class _Env(dict):
+    """node name -> flexflow_tpu Tensor or plain python value."""
+
+
+def _is_tensor(v) -> bool:
+    return isinstance(v, Tensor)
+
+
+class PyTorchModel:
+    def __init__(self, model_or_path, tracer_cls=None, batch_size: Optional[int] = None):
+        """model_or_path: a torch.nn.Module (traced on the fly) or a path to a
+        .ff file written by fx.torch_to_flexflow."""
+        from . import fx
+
+        self._torch_module = None
+        if isinstance(model_or_path, str):
+            self.records = fx.load_ff_file(model_or_path)
+        else:
+            self._torch_module = model_or_path
+            self.records = fx.trace_to_records(model_or_path, tracer_cls=tracer_cls)
+        self.batch_size = batch_size
+        # node name -> ff op name (for weight transfer)
+        self._name_map: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+    def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
+        env = _Env()
+        inputs = list(input_tensors)
+        outputs: List[Tensor] = []
+        for rec in self.records:
+            op = rec["op"]
+            if op == "placeholder":
+                env[rec["name"]] = inputs.pop(0)
+            elif op == "call_module":
+                env[rec["name"]] = self._call_module(ffmodel, rec, env)
+            elif op == "call_function":
+                env[rec["name"]] = self._call_function(ffmodel, rec, env)
+            elif op == "call_method":
+                env[rec["name"]] = self._call_method(ffmodel, rec, env)
+            elif op == "get_attr":
+                raise NotImplementedError(
+                    f"get_attr node {rec['name']} ({rec['target']}): direct "
+                    "parameter access is not supported by the importer"
+                )
+            elif op == "output":
+                out = self._decode(rec["args"], env)[0]
+                outputs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def _decode(self, a, env):
+        if isinstance(a, dict):
+            if "node" in a:
+                return env[a["node"]]
+            if "dtype" in a or "repr" in a:
+                return a
+            return {k: self._decode(v, env) for k, v in a.items()}
+        if isinstance(a, list):
+            return [self._decode(x, env) for x in a]
+        return a
+
+    def _args(self, rec, env):
+        return self._decode(rec["args"], env), self._decode(rec["kwargs"], env)
+
+    # -- call_module ----------------------------------------------------
+    def _call_module(self, fm, rec, env):
+        spec = rec["module"]
+        t = spec["type"]
+        args, kwargs = self._args(rec, env)
+        x = args[0] if args else None
+        name = rec["name"]
+        self._name_map[name] = name
+
+        if t == "Linear":
+            return fm.dense(x, spec["out_features"], ActiMode.AC_MODE_NONE,
+                            spec["bias"], name=name)
+        if t == "Conv2d":
+            pad = spec["padding"]
+            if pad == "same":
+                pad = [spec["kernel_size"][0] // 2, spec["kernel_size"][1] // 2]
+            elif pad == "valid":
+                pad = [0, 0]
+            return fm.conv2d(
+                x, spec["out_channels"], spec["kernel_size"][0], spec["kernel_size"][1],
+                spec["stride"][0], spec["stride"][1], pad[0], pad[1],
+                groups=spec["groups"], use_bias=spec["bias"], name=name,
+            )
+        if t in ("MaxPool2d", "AvgPool2d"):
+            pt = PoolType.POOL_MAX if t == "MaxPool2d" else PoolType.POOL_AVG
+            return fm.pool2d(
+                x, spec["kernel_size"][0], spec["kernel_size"][1],
+                spec["stride"][0], spec["stride"][1],
+                spec["padding"][0], spec["padding"][1], pool_type=pt, name=name,
+            )
+        if t == "AdaptiveAvgPool2d":
+            oh, ow = spec["output_size"]
+            _, _, h, w = x.dims
+            sh, sw = h // oh, w // ow
+            kh, kw = h - (oh - 1) * sh, w - (ow - 1) * sw
+            return fm.pool2d(x, kh, kw, sh, sw, 0, 0,
+                             pool_type=PoolType.POOL_AVG, name=name)
+        if t in ("BatchNorm2d",):
+            return fm.batch_norm(x, relu=False, name=name)
+        if t == "LayerNorm":
+            axes = list(range(-len(spec["normalized_shape"]), 0))
+            return fm.layer_norm(x, axes, spec["elementwise_affine"],
+                                 spec["eps"], name=name)
+        if t == "Embedding":
+            return fm.embedding(x, spec["num_embeddings"], spec["embedding_dim"],
+                                AggrMode.AGGR_MODE_NONE, name=name)
+        if t == "Dropout":
+            return fm.dropout(x, spec["p"], name=name)
+        if t == "Softmax":
+            return fm.softmax(x, spec.get("dim", -1), name=name)
+        if t == "Flatten":
+            if spec.get("start_dim", 1) == 1 and spec.get("end_dim", -1) == -1:
+                return fm.flat(x, name=name)
+            return self._flatten_range(fm, x, spec["start_dim"], spec["end_dim"], name)
+        if t == "MultiheadAttention":
+            q, k, v = args[0], args[1], args[2]
+            if not spec.get("batch_first", False):
+                # torch default layout is (L, N, E); the core op is batch-first
+                q = fm.transpose(q, [1, 0, 2], name=f"{name}_qT")
+                k = fm.transpose(k, [1, 0, 2], name=f"{name}_kT")
+                v = fm.transpose(v, [1, 0, 2], name=f"{name}_vT")
+            out = fm.multihead_attention(q, k, v, spec["embed_dim"],
+                                         spec["num_heads"], name=name)
+            if not spec.get("batch_first", False):
+                out = fm.transpose(out, [1, 0, 2], name=f"{name}_oT")
+            return [out, None]
+        unary = {
+            "ReLU": fm.relu, "GELU": fm.gelu, "Sigmoid": fm.sigmoid,
+            "Tanh": fm.tanh, "ELU": fm.elu, "Identity": fm.identity,
+        }
+        if t in unary:
+            return unary[t](x, name=name)
+        raise NotImplementedError(f"call_module type {t} not supported")
+
+    # -- call_function --------------------------------------------------
+    def _call_function(self, fm, rec, env):
+        target = rec["target"]
+        name = rec["name"]
+        args, kwargs = self._args(rec, env)
+
+        def binop(tensor_fn, scalar_fn, rev_scalar_fn=None):
+            """rev_scalar_fn(t, c) computes c OP t for non-commutative ops
+            when the scalar is on the LEFT (e.g. 1.0 - x)."""
+            a, b = args[0], args[1]
+            if _is_tensor(a) and _is_tensor(b):
+                return tensor_fn(a, b, name=name)
+            if _is_tensor(a):
+                return scalar_fn(a, float(b), name=name)
+            if rev_scalar_fn is not None:
+                return rev_scalar_fn(b, float(a))
+            return scalar_fn(b, float(a), name=name)
+
+        def rev_sub(t, c):  # c - t
+            return fm.scalar_add(fm.scalar_multiply(t, -1.0, name=f"{name}_neg"),
+                                 c, name=name)
+
+        def rev_div(t, c):  # c / t
+            return fm.scalar_multiply(fm.pow(t, -1.0, name=f"{name}_inv"),
+                                      c, name=name)
+
+        if target in ("add", "iadd"):
+            return binop(fm.add, fm.scalar_add)
+        if target in ("sub", "isub"):
+            return binop(fm.subtract, fm.scalar_sub, rev_sub)
+        if target in ("mul", "imul"):
+            return binop(fm.multiply, fm.scalar_multiply)
+        if target in ("truediv", "div"):
+            return binop(fm.divide, fm.scalar_true_divide, rev_div)
+        if target == "matmul" or target == "bmm":
+            return fm.batch_matmul(args[0], args[1], name=name)
+        if target == "cat":
+            dim = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return fm.concat(args[0], dim, name=name)
+        if target == "split":
+            sizes = args[1]
+            dim = kwargs.get("dim", args[2] if len(args) > 2 else 0)
+            if isinstance(sizes, int):
+                # torch: int is the chunk SIZE; fm.split: int is the COUNT
+                total = args[0].dims[dim]
+                sizes = [sizes] * (total // sizes) + (
+                    [total % sizes] if total % sizes else []
+                )
+            return fm.split(args[0], sizes, dim, name=name)
+        if target == "flatten":
+            start = kwargs.get("start_dim", args[1] if len(args) > 1 else 0)
+            if start == 1:
+                return fm.flat(args[0], name=name)
+            return self._flatten_range(fm, args[0], start, -1, name)
+        if target == "relu":
+            return fm.relu(args[0], name=name)
+        if target == "gelu":
+            return fm.gelu(args[0], name=name)
+        if target == "sigmoid":
+            return fm.sigmoid(args[0], name=name)
+        if target == "tanh":
+            return fm.tanh(args[0], name=name)
+        if target == "softmax":
+            dim = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return fm.softmax(args[0], dim, name=name)
+        if target == "dropout":
+            p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
+            return fm.dropout(args[0], p, name=name)
+        if target == "getitem":
+            return args[0][args[1]]
+        if target == "getattr":
+            if args[1] == "shape":
+                return args[0].dims
+            raise NotImplementedError(f"getattr {args[1]}")
+        if target in ("mean",):
+            dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            keep = kwargs.get("keepdim", False)
+            return fm.mean(args[0], self._axes(args[0], dims), keep, name=name)
+        if target in ("sum",):
+            dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+            keep = kwargs.get("keepdim", False)
+            return fm.reduce_sum(args[0], self._axes(args[0], dims), keep,
+                                 name=name)
+        if target == "transpose":
+            return self._transpose(fm, args[0], args[1], args[2], name)
+        if target == "permute":
+            perm = args[1] if isinstance(args[1], list) else list(args[1:])
+            return fm.transpose(args[0], perm, name=name)
+        if target == "reshape":
+            return self._reshape(fm, args[0], args[1], name)
+        raise NotImplementedError(f"call_function {target} not supported")
+
+    # -- call_method ----------------------------------------------------
+    def _call_method(self, fm, rec, env):
+        target = rec["target"]
+        name = rec["name"]
+        args, kwargs = self._args(rec, env)
+        x = args[0]
+        if target in ("view", "reshape"):
+            shape = args[1] if isinstance(args[1], list) else list(args[1:])
+            return self._reshape(fm, x, shape, name)
+        if target == "permute":
+            perm = args[1] if isinstance(args[1], list) else list(args[1:])
+            return fm.transpose(x, perm, name=name)
+        if target == "transpose":
+            return self._transpose(fm, x, args[1], args[2], name)
+        if target == "flatten":
+            start = args[1] if len(args) > 1 else 0
+            if start == 1:
+                return fm.flat(x, name=name)
+            return self._flatten_range(fm, x, start, -1, name)
+        if target == "contiguous":
+            return x
+        if target == "size":
+            return x.dims if len(args) == 1 else x.dims[args[1]]
+        if target == "mean":
+            dims = args[1] if len(args) > 1 else kwargs.get("dim")
+            keep = kwargs.get("keepdim", False)
+            return fm.mean(x, self._axes(x, dims), keep, name=name)
+        if target in ("squeeze", "unsqueeze"):
+            dims = list(x.dims)
+            d = args[1]
+            if target == "squeeze":
+                dims.pop(d)
+            else:
+                dims.insert(d if d >= 0 else len(dims) + d + 1, 1)
+            return fm.reshape(x, dims, name=name)
+        if target == "softmax":
+            return fm.softmax(x, args[1] if len(args) > 1 else -1, name=name)
+        raise NotImplementedError(f"call_method {target} not supported")
+
+    # -- helpers --------------------------------------------------------
+    @staticmethod
+    def _axes(x, dims):
+        """torch dim=None means reduce over ALL axes."""
+        if dims is None:
+            return list(range(len(x.dims)))
+        return dims if isinstance(dims, list) else [dims]
+
+    def _reshape(self, fm, x, shape, name):
+        shape = list(shape)
+        total = math.prod(x.dims)
+        if -1 in shape:
+            known = math.prod(d for d in shape if d != -1)
+            shape[shape.index(-1)] = total // known
+        return fm.reshape(x, shape, name=name)
+
+    def _transpose(self, fm, x, d0, d1, name):
+        perm = list(range(len(x.dims)))
+        perm[d0], perm[d1] = perm[d1], perm[d0]
+        return fm.transpose(x, perm, name=name)
+
+    def _flatten_range(self, fm, x, start, end, name):
+        dims = list(x.dims)
+        n = len(dims)
+        start %= n
+        end %= n
+        merged = math.prod(dims[start:end + 1])
+        return fm.reshape(x, dims[:start] + [merged] + dims[end + 1:], name=name)
+
+    # ------------------------------------------------------------------
+    def transfer_weights(self, ffmodel) -> int:
+        """Copy weights from the traced torch module into the compiled
+        FFModel's params (extension; the reference re-initializes). Returns
+        the number of tensors copied."""
+        if self._torch_module is None:
+            raise ValueError("weight transfer needs a live torch module")
+        import jax.numpy as jnp
+        import torch.nn as nn
+
+        modules = dict(self._torch_module.named_modules())
+        # fx node target -> node name happens via records
+        copied = 0
+        for rec in self.records:
+            if rec["op"] != "call_module":
+                continue
+            name = rec["name"]
+            if name not in (ffmodel.params or {}):
+                continue
+            mod = modules[rec["target"]]
+            slot = ffmodel.params[name]
+
+            def put(key, arr):
+                nonlocal copied
+                slot[key] = jnp.asarray(arr.detach().cpu().numpy()).astype(
+                    slot[key].dtype
+                )
+                copied += 1
+
+            if isinstance(mod, nn.Linear):
+                put("kernel", mod.weight.T)
+                if mod.bias is not None:
+                    put("bias", mod.bias)
+            elif isinstance(mod, nn.Conv2d):
+                put("kernel", mod.weight)  # torch OIHW == ours
+                if mod.bias is not None:
+                    put("bias", mod.bias)
+            elif isinstance(mod, nn.Embedding):
+                put("weight", mod.weight)
+            elif isinstance(mod, nn.LayerNorm) and mod.elementwise_affine:
+                put("gamma", mod.weight)
+                put("beta", mod.bias)
+            elif isinstance(mod, nn.MultiheadAttention):
+                e = mod.embed_dim
+                h = mod.num_heads
+                hd = e // h
+                if mod.in_proj_weight is not None:
+                    wq, wk, wv = mod.in_proj_weight.chunk(3, dim=0)
+                else:
+                    wq, wk, wv = (mod.q_proj_weight, mod.k_proj_weight,
+                                  mod.v_proj_weight)
+                # torch proj weight is (E_out, E_in); ours is (E_in, h, hd)
+                put("wq", wq.T.reshape(e, h, hd))
+                put("wk", wk.T.reshape(e, h, hd))
+                put("wv", wv.T.reshape(e, h, hd))
+                # out_proj (E, E) -> (h, hd, E)
+                put("wo", mod.out_proj.weight.T.reshape(h, hd, e))
+                if mod.in_proj_bias is not None and "bq" in slot:
+                    bq, bk, bv = mod.in_proj_bias.chunk(3, dim=0)
+                    put("bq", bq.reshape(h, hd))
+                    put("bk", bk.reshape(h, hd))
+                    put("bv", bv.reshape(h, hd))
+                    put("bo", mod.out_proj.bias)
+        return copied

@@ -1,0 +1,63 @@
+"""ONNX importer tests — exercised fully only when the onnx package is
+installed (reference: examples/python/onnx). Without onnx we still verify the
+module is importable and fails with a clear error."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+try:
+    import onnx
+
+    HAS_ONNX = True
+except ImportError:
+    HAS_ONNX = False
+
+
+def test_module_imports_without_onnx():
+    from flexflow_tpu.onnx import ONNXModel, ONNXModelKeras  # noqa: F401
+
+    if not HAS_ONNX:
+        with pytest.raises(ImportError, match="onnx"):
+            ONNXModel("nonexistent.onnx")
+
+
+@pytest.mark.skipif(not HAS_ONNX, reason="onnx not installed")
+def test_onnx_mlp_roundtrip(tmp_path):
+    import onnx.helper as oh
+    import onnx.numpy_helper as nph
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(20, 32).astype(np.float32)
+    w2 = rng.randn(32, 4).astype(np.float32)
+    nodes = [
+        oh.make_node("MatMul", ["x", "w1"], ["h"], name="fc1"),
+        oh.make_node("Relu", ["h"], ["hr"], name="relu1"),
+        oh.make_node("MatMul", ["hr", "w2"], ["y"], name="fc2"),
+    ]
+    graph = oh.make_graph(
+        nodes, "mlp",
+        [oh.make_tensor_value_info("x", 1, [8, 20])],
+        [oh.make_tensor_value_info("y", 1, [8, 4])],
+        initializer=[nph.from_array(w1, "w1"), nph.from_array(w2, "w2")],
+    )
+    proto = oh.make_model(graph)
+
+    from flexflow_tpu.onnx import ONNXModel
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 20], ff.DataType.DT_FLOAT)
+    om = ONNXModel(proto)
+    outs = om.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    assert om.transfer_weights(model) == 2
+    x = rng.randn(8, 20).astype(np.float32)
+    ours = model.predict(x)
+    ref = np.maximum(x @ w1, 0) @ w2
+    np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)

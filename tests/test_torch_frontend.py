@@ -1,0 +1,234 @@
+"""torch.fx importer tests (reference test model: tests/align +
+examples/python/pytorch)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn
+import torch.nn.functional as F
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch import PyTorchModel, fx
+
+
+def make_config(batch=8):
+    c = ff.FFConfig()
+    c.batch_size = batch
+    c.num_devices = 1
+    c.allow_mixed_precision = False  # exact parity vs torch f32
+    return c
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(20, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        x = F.relu(self.fc1(x))
+        return self.fc2(x)
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.fc = nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        x = self.pool(F.relu(self.conv1(x)))
+        x = torch.flatten(x, 1)
+        return self.fc(x)
+
+
+class Residual(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 16)
+        self.ln = nn.LayerNorm(16)
+
+    def forward(self, x):
+        h = self.fc1(x)
+        return self.ln(x + h)
+
+
+def build_and_compare(module, x_np, input_dims, dtype=ff.DataType.DT_FLOAT,
+                      atol=1e-4):
+    """Apply the fx import, transfer weights, compare forward vs torch."""
+    module.eval()
+    config = make_config(batch=x_np.shape[0])
+    model = ff.FFModel(config)
+    t = model.create_tensor(list(input_dims), dtype)
+    pt = PyTorchModel(module)
+    outs = pt.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.0),
+        loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+    )
+    n = pt.transfer_weights(model)
+    assert n > 0
+    ours = model.predict(x_np)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x_np)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-4)
+
+
+def test_mlp_numerical_parity():
+    x = np.random.RandomState(0).randn(8, 20).astype(np.float32)
+    build_and_compare(MLP(), x, (8, 20))
+
+
+def test_cnn_numerical_parity():
+    x = np.random.RandomState(1).randn(8, 3, 8, 8).astype(np.float32)
+    build_and_compare(CNN(), x, (8, 3, 8, 8))
+
+
+def test_residual_layernorm_parity():
+    x = np.random.RandomState(2).randn(8, 16).astype(np.float32)
+    build_and_compare(Residual(), x, (8, 16))
+
+
+def test_ff_file_roundtrip(tmp_path):
+    path = str(tmp_path / "mlp.ff")
+    fx.torch_to_flexflow(MLP(), path)
+    config = make_config()
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 20], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(path).apply(model, [t])
+    assert outs[0].dims == (8, 4)
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.01),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+    x = np.random.RandomState(3).randn(16, 20).astype(np.float32)
+    y = np.random.RandomState(4).randint(0, 4, size=(16, 1)).astype(np.int32)
+    hist = model.fit([x], y, epochs=1)
+    assert len(hist) == 1
+
+
+def test_embedding_and_methods():
+    class Tok(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 8)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            h = self.emb(x)
+            h = h.mean([1])
+            return self.fc(h)
+
+    module = Tok().eval()
+    config = make_config()
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 6], ff.DataType.DT_INT32)
+    pt = PyTorchModel(module)
+    outs = pt.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    pt.transfer_weights(model)
+    x = np.random.RandomState(5).randint(0, 50, size=(8, 6)).astype(np.int32)
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x.astype(np.int64))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_scalar_left_sub_div_parity():
+    class M(nn.Module):
+        def forward(self, x):
+            return 1.0 - x + 2.0 / (x * x + 1.0)
+
+    x = np.random.RandomState(6).rand(8, 10).astype(np.float32) + 0.5
+    module = M().eval()
+    config = make_config()
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 10], ff.DataType.DT_FLOAT)
+    pt = PyTorchModel(module)
+    outs = pt.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5, rtol=1e-5)
+
+
+def test_split_chunk_size_semantics():
+    class M(nn.Module):
+        def forward(self, x):
+            a, b, c = torch.split(x, 2, dim=1)  # chunk SIZE 2 over dim of 6
+            return a + b + c
+
+    x = np.random.RandomState(7).rand(8, 6).astype(np.float32)
+    module = M().eval()
+    config = make_config()
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 6], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(module).apply(model, [t])
+    assert outs[0].dims == (8, 2)
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_global_mean_reduction():
+    class M(nn.Module):
+        def forward(self, x):
+            return x - x.mean()
+
+    x = np.random.RandomState(8).rand(8, 5).astype(np.float32)
+    module = M().eval()
+    config = make_config()
+    model = ff.FFModel(config)
+    t = model.create_tensor([8, 5], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(module).apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_multihead_attention_parity():
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.attn = nn.MultiheadAttention(16, 4, batch_first=True)
+
+        def forward(self, x):
+            out, _ = self.attn(x, x, x)
+            return out
+
+    x = np.random.RandomState(9).randn(4, 6, 16).astype(np.float32)
+    module = M().eval()
+    config = make_config(batch=4)
+    model = ff.FFModel(config)
+    t = model.create_tensor([4, 6, 16], ff.DataType.DT_FLOAT)
+    pt = PyTorchModel(module)
+    outs = pt.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    assert pt.transfer_weights(model) >= 8
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
