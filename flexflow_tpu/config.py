@@ -64,6 +64,9 @@ class FFConfig:
     memory_search: bool = False
     memory_budget_mb: float = 16 * 1024.0  # per-chip HBM budget for memory-aware search
     substitution_json_path: Optional[str] = None
+    # Prefer the native C++ search core (src/ffcore) when buildable; the
+    # pure-Python search is the fallback and the reference semantics.
+    use_native_search: bool = True
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None

@@ -1,0 +1,209 @@
+"""ctypes binding to the native C++ core (libffcore.so).
+
+reference parity: the reference implements its graph/search/simulator core in
+C++ (src/runtime/graph.cc, substitution.cc, simulator.cc, machine_model.cc)
+under a C API (src/c/flexflow_c.cc) consumed by Python via cffi. Here the
+native core owns the same device-independent host logic — PCG algorithms,
+TPU machine model, Unity DP + MCMC search — and Python feeds it a line
+protocol. Pure-Python fallbacks (flexflow_tpu.search) remain when the
+library can't be built.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "src", "ffcore")
+_LIB_NAME = "libffcore.so"
+
+_lib = None
+_load_error: Optional[str] = None
+
+
+def _sources_newer_than(lib_path: str) -> bool:
+    lib_mtime = os.path.getmtime(lib_path)
+    for fn in os.listdir(_SRC_DIR):
+        if fn.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, fn)) > lib_mtime:
+                return True
+    return False
+
+
+def ensure_built() -> Optional[str]:
+    """Build libffcore.so if missing or stale. Returns the path or None."""
+    global _load_error
+    src = os.path.abspath(_SRC_DIR)
+    lib = os.path.join(src, _LIB_NAME)
+    if os.path.exists(lib) and not _sources_newer_than(lib):
+        return lib
+    try:
+        subprocess.run(["make", "-s"], cwd=src, check=True,
+                       capture_output=True, timeout=120)
+        return lib
+    except Exception as e:  # toolchain missing or compile error
+        _load_error = f"native build failed: {e}"
+        return lib if os.path.exists(lib) else None
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    path = ensure_built()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ffc_run.argtypes = [ctypes.c_char_p]
+        lib.ffc_run.restype = ctypes.c_void_p
+        lib.ffc_free.argtypes = [ctypes.c_void_p]
+        lib.ffc_version.restype = ctypes.c_char_p
+        _lib = lib
+    except OSError as e:
+        _load_error = str(e)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def version() -> Optional[str]:
+    lib = _load()
+    return lib.ffc_version().decode() if lib else None
+
+
+def run(protocol_text: str) -> str:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"libffcore unavailable: {_load_error}")
+    ptr = lib.ffc_run(protocol_text.encode())
+    try:
+        out = ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+    finally:
+        lib.ffc_free(ptr)
+    if out.startswith("error "):
+        raise RuntimeError(f"ffcore: {out[6:].strip()}")
+    return out
+
+
+# ---------------------------------------------------------------- protocol
+def _tp_divisor(op) -> int:
+    from ..ffconst import OpType
+
+    if op.op_type == OpType.LINEAR:
+        return int(op.params["out_dim"])
+    if op.op_type == OpType.MULTIHEAD_ATTENTION:
+        return int(op.params["num_heads"])
+    if op.op_type == OpType.EMBEDDING:
+        return int(op.params["out_dim"])
+    if op.op_type == OpType.BATCHMATMUL:
+        return 0  # always divisible
+    return -1
+
+
+def serialize_graph(graph, machine=None, config=None, batch: int = 1,
+                    n_devices: int = 1, mcmc_iters: int = 0) -> str:
+    """Render the PCG + machine + options into the ffcore line protocol."""
+    from ..ffconst import OpType
+    from .. import search  # noqa: F401  (ensures simulator constants import)
+    from ..search.simulator import TP_CAPABLE
+
+    lines: List[str] = []
+    if machine is not None:
+        c = machine.chip
+        link_mult = 2.0 if machine.version() >= 1 else 1.0
+        chips_per_pod = getattr(machine, "chips_per_pod", 256)
+        lines.append(
+            f"machine {machine.num_chips} {c.peak_bf16_tflops} "
+            f"{c.peak_f32_tflops} {c.hbm_gb} {c.hbm_bw_gbps} "
+            f"{c.ici_link_gbps} {c.dcn_gbps} {link_mult} {chips_per_pod}"
+        )
+    if config is not None:
+        lines.append(
+            "options "
+            f"{n_devices} {batch} {max(0, config.search_budget)} "
+            f"{config.search_alpha} {int(config.only_data_parallel)} "
+            f"{int(config.allow_mixed_precision)} "
+            f"{int(config.search_overlap_backward_update)} "
+            f"{int(config.memory_search)} "
+            f"{config.memory_budget_mb * 1e6 if config.memory_search else 0} "
+            f"{mcmc_iters} {config.seed}"
+        )
+    inert_types = (OpType.INPUT, OpType.NOOP, OpType.WEIGHT)
+    for op in graph.topo_order():
+        weight_bytes = sum(
+            w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights
+        )
+        act_bytes = sum(
+            t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs
+        )
+        out_elems = op.outputs[0].num_elements() if op.outputs else 0
+        dtype_bytes = (
+            op.outputs[0].dtype.np_dtype.itemsize if op.outputs else 4
+        )
+        lines.append(
+            f"node {op.guid} {op.flops()} {op.bytes_accessed()} "
+            f"{weight_bytes} {act_bytes} {out_elems} {dtype_bytes} "
+            f"{int(op.op_type in TP_CAPABLE)} {_tp_divisor(op)} "
+            f"{int(op.op_type in inert_types)}"
+        )
+    for e in graph.edges():
+        t = graph.ops[e.src].outputs[e.src_idx]
+        bytes_ = t.num_elements() * t.dtype.np_dtype.itemsize
+        lines.append(f"edge {e.src} {e.dst} {bytes_}")
+    return "\n".join(lines) + "\n"
+
+
+def topo_order(graph) -> List[int]:
+    out = run("cmd topo\n" + serialize_graph(graph))
+    return [int(g) for g in out.split()]
+
+
+def bottlenecks(graph) -> List[int]:
+    out = run("cmd bottlenecks\n" + serialize_graph(graph))
+    return [int(g) for g in out.split()]
+
+
+def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
+                      mcmc_iters: int = 0):
+    """Native Unity search. Returns a search.unity.SearchResult."""
+    from ..search.simulator import OpStrategy
+    from ..search.unity import SearchResult
+
+    text = "cmd optimize\n" + serialize_graph(
+        graph, machine, config, batch, n_devices, mcmc_iters
+    )
+    out = run(text)
+    cost = mem = 0.0
+    mesh_dp = mesh_tp = 1
+    strategies: Dict[int, OpStrategy] = {}
+    log: List[str] = ["native ffcore search"]
+    for line in out.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == "cost":
+            cost = float(parts[1])
+        elif parts[0] == "memory":
+            mem = float(parts[1])
+        elif parts[0] == "mesh":
+            mesh_dp, mesh_tp = int(parts[1]), int(parts[2])
+        elif parts[0] == "strategy":
+            strategies[int(parts[1])] = OpStrategy(
+                dp=int(parts[2]), tp=int(parts[3])
+            )
+        elif parts[0] == "log":
+            log.append(line[4:])
+    if cost < 0 or not strategies:
+        # mirror the Python search's behavior (no silent degenerate result)
+        raise ValueError("no feasible mesh factorization")
+    axes = {}
+    if mesh_dp > 1 and any(s.dp > 1 for s in strategies.values()):
+        axes["data"] = mesh_dp
+    if mesh_tp > 1 and any(s.tp > 1 for s in strategies.values()):
+        axes["model"] = mesh_tp
+    return SearchResult(strategies, axes, cost, mem, log)

@@ -72,12 +72,18 @@ class ONNXModel:
             return env[ins[i]]
 
         if op == "Gemm":
+            if at.get("transA", 0):
+                raise NotImplementedError("Gemm with transA=1")
             w = self._init_array(ins[1])
             out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
             t = fm.dense(x(), int(out_dim), ActiMode.AC_MODE_NONE,
                          use_bias=len(ins) > 2, name=name)
-            self._stash(name, kernel=w.T if at.get("transB", 0) else w,
-                        bias=self._init_array(ins[2]) if len(ins) > 2 else None)
+            # y = alpha*A@B + beta*C folds exactly into the stashed weights
+            kernel = (w.T if at.get("transB", 0) else w) * float(at.get("alpha", 1.0))
+            bias = None
+            if len(ins) > 2:
+                bias = self._init_array(ins[2]) * float(at.get("beta", 1.0))
+            self._stash(name, kernel=kernel, bias=bias)
         elif op == "MatMul":
             if ins[1] in self.inits:
                 w = self._init_array(ins[1])
@@ -90,10 +96,9 @@ class ONNXModel:
             w = self._init_array(ins[1])
             kh, kw = at.get("kernel_shape", w.shape[2:])
             strides = at.get("strides", [1, 1])
-            pads = at.get("pads", [0, 0, 0, 0])
+            ph, pw = self._spatial_pads(at, (int(kh), int(kw)))
             t = fm.conv2d(x(), int(w.shape[0]), int(kh), int(kw),
-                          int(strides[0]), int(strides[1]),
-                          int(pads[0]), int(pads[1]),
+                          int(strides[0]), int(strides[1]), ph, pw,
                           groups=int(at.get("group", 1)),
                           use_bias=len(ins) > 2, name=name)
             self._stash(name, kernel=w,
@@ -101,10 +106,10 @@ class ONNXModel:
         elif op in ("MaxPool", "AveragePool"):
             kh, kw = at["kernel_shape"]
             strides = at.get("strides", [1, 1])
-            pads = at.get("pads", [0, 0, 0, 0])
+            ph, pw = self._spatial_pads(at, (int(kh), int(kw)))
             pt = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
             t = fm.pool2d(x(), int(kh), int(kw), int(strides[0]), int(strides[1]),
-                          int(pads[0]), int(pads[1]), pool_type=pt, name=name)
+                          ph, pw, pool_type=pt, name=name)
         elif op == "GlobalAveragePool":
             _, _, h, w_ = x().dims
             t = fm.pool2d(x(), h, w_, 1, 1, 0, 0, pool_type=PoolType.POOL_AVG,
@@ -141,9 +146,21 @@ class ONNXModel:
         elif op == "Concat":
             t = fm.concat([env[i] for i in ins], int(at["axis"]), name=name)
         elif op == "Split":
-            sizes = [int(v) for v in at.get("split", self._init_array(ins[1])
-                                            if len(ins) > 1 else [])]
-            parts = fm.split(x(), sizes, int(at.get("axis", 0)), name=name)
+            axis = int(at.get("axis", 0))
+            if "split" in at:
+                sizes = [int(v) for v in at["split"]]
+            elif len(ins) > 1 and ins[1] in self.inits:
+                sizes = [int(v) for v in self._init_array(ins[1])]
+            else:
+                # equal split over the declared number of outputs
+                n_out = len(node.output)
+                total = x().dims[axis]
+                if total % n_out:
+                    raise NotImplementedError(
+                        f"Split: {total} not divisible into {n_out} equal parts"
+                    )
+                sizes = [total // n_out] * n_out
+            parts = fm.split(x(), sizes, axis, name=name)
             for out_name, part in zip(node.output, parts):
                 env[out_name] = part
             return
@@ -155,16 +172,14 @@ class ONNXModel:
             t = self._binary(fm, fm.multiply, fm.scalar_multiply, env, ins, name)
         elif op == "Div":
             t = self._binary(fm, fm.divide, fm.scalar_true_divide, env, ins, name)
-        elif op == "ReduceMean":
-            axes = [int(v) for v in at.get("axes", [])] or [
-                int(v) for v in self._init_array(ins[1])
-            ]
-            t = fm.mean(x(), axes, bool(at.get("keepdims", 1)), name=name)
-        elif op == "ReduceSum":
-            axes = [int(v) for v in at.get("axes", [])] or [
-                int(v) for v in self._init_array(ins[1])
-            ]
-            t = fm.reduce_sum(x(), axes, bool(at.get("keepdims", 1)), name=name)
+        elif op in ("ReduceMean", "ReduceSum"):
+            axes = [int(v) for v in at.get("axes", [])]
+            if not axes and len(ins) > 1 and ins[1] in self.inits:
+                axes = [int(v) for v in self._init_array(ins[1])]
+            if not axes:  # ONNX default: reduce over ALL axes
+                axes = list(range(len(x().dims)))
+            fn = fm.mean if op == "ReduceMean" else fm.reduce_sum
+            t = fn(x(), axes, bool(at.get("keepdims", 1)), name=name)
         elif op == "Cast":
             onnx_to_ff = {1: DataType.DT_FLOAT, 6: DataType.DT_INT32,
                           7: DataType.DT_INT64, 10: DataType.DT_HALF,
@@ -180,6 +195,25 @@ class ONNXModel:
         else:
             raise NotImplementedError(f"ONNX op {op} not supported")
         env[node.output[0]] = t
+
+    @staticmethod
+    def _spatial_pads(at, kernel):
+        """Resolve pads/auto_pad to symmetric (ph, pw); asymmetric padding
+        and stride-dependent SAME that can't be expressed symmetrically
+        raise rather than silently shifting the output."""
+        auto = at.get("auto_pad", b"NOTSET")
+        auto = auto.decode() if isinstance(auto, bytes) else auto
+        if auto in ("SAME_UPPER", "SAME_LOWER"):
+            kh, kw = kernel
+            if kh % 2 == 0 or kw % 2 == 0:
+                raise NotImplementedError(
+                    f"auto_pad={auto} with even kernel {kernel} is asymmetric"
+                )
+            return kh // 2, kw // 2
+        pads = [int(v) for v in at.get("pads", [0, 0, 0, 0])]
+        if pads[0] != pads[2] or pads[1] != pads[3]:
+            raise NotImplementedError(f"asymmetric pads {pads}")
+        return pads[0], pads[1]
 
     def _binary(self, fm, tensor_fn, scalar_fn, env, ins, name):
         a_const = ins[0] in self.inits

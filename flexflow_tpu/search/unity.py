@@ -217,7 +217,26 @@ class GraphSearchHelper:
 def unity_optimize(graph: Graph, config, machine: MachineModel,
                    batch_size: int, n_devices: int,
                    simulator: Optional[Simulator] = None) -> SearchResult:
-    """Entry point (reference: FFModel::graph_optimize, substitution.cc:3589)."""
+    """Entry point (reference: FFModel::graph_optimize, substitution.cc:3589).
+
+    Dispatches to the native C++ core (src/ffcore, loaded via ctypes) when
+    available; the pure-Python path below is the fallback and the behavioral
+    spec. A custom simulator (e.g. measured costs) forces the Python path."""
+    if simulator is None and getattr(config, "use_native_search", True):
+        from .. import native
+
+        if native.available():
+            from .substitution import apply_substitutions, load_rule_set
+
+            applied = apply_substitutions(
+                graph, load_rule_set(config.substitution_json_path)
+            )
+            result = native.optimize_strategy(
+                graph, config, machine, batch_size, n_devices
+            )
+            if applied:
+                result.log.append(f"substitutions: {applied}")
+            return result
     helper = GraphSearchHelper(graph, config, machine, simulator)
     budget = None
     if config.memory_search:

@@ -34,8 +34,6 @@ class PyTorchModel:
             self._torch_module = model_or_path
             self.records = fx.trace_to_records(model_or_path, tracer_cls=tracer_cls)
         self.batch_size = batch_size
-        # node name -> ff op name (for weight transfer)
-        self._name_map: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
@@ -84,7 +82,6 @@ class PyTorchModel:
         args, kwargs = self._args(rec, env)
         x = args[0] if args else None
         name = rec["name"]
-        self._name_map[name] = name
 
         if t == "Linear":
             return fm.dense(x, spec["out_features"], ActiMode.AC_MODE_NONE,
@@ -157,10 +154,13 @@ class PyTorchModel:
         name = rec["name"]
         args, kwargs = self._args(rec, env)
 
-        def binop(tensor_fn, scalar_fn, rev_scalar_fn=None):
+        def binop(tensor_fn, scalar_fn, rev_scalar_fn=None, py_fn=None):
             """rev_scalar_fn(t, c) computes c OP t for non-commutative ops
-            when the scalar is on the LEFT (e.g. 1.0 - x)."""
+            when the scalar is on the LEFT (e.g. 1.0 - x). Two plain numbers
+            (traced size() arithmetic) fold in Python via py_fn."""
             a, b = args[0], args[1]
+            if not _is_tensor(a) and not _is_tensor(b):
+                return py_fn(a, b)
             if _is_tensor(a) and _is_tensor(b):
                 return tensor_fn(a, b, name=name)
             if _is_tensor(a):
@@ -178,13 +178,18 @@ class PyTorchModel:
                                       c, name=name)
 
         if target in ("add", "iadd"):
-            return binop(fm.add, fm.scalar_add)
+            return binop(fm.add, fm.scalar_add, py_fn=lambda a, b: a + b)
         if target in ("sub", "isub"):
-            return binop(fm.subtract, fm.scalar_sub, rev_sub)
+            return binop(fm.subtract, fm.scalar_sub, rev_sub,
+                         py_fn=lambda a, b: a - b)
         if target in ("mul", "imul"):
-            return binop(fm.multiply, fm.scalar_multiply)
+            return binop(fm.multiply, fm.scalar_multiply,
+                         py_fn=lambda a, b: a * b)
         if target in ("truediv", "div"):
-            return binop(fm.divide, fm.scalar_true_divide, rev_div)
+            return binop(fm.divide, fm.scalar_true_divide, rev_div,
+                         py_fn=lambda a, b: a / b)
+        if target == "floordiv":
+            return binop(None, None, py_fn=lambda a, b: a // b)
         if target == "matmul" or target == "bmm":
             return fm.batch_matmul(args[0], args[1], name=name)
         if target == "cat":
@@ -270,13 +275,22 @@ class PyTorchModel:
             dims = args[1] if len(args) > 1 else kwargs.get("dim")
             keep = kwargs.get("keepdim", False)
             return fm.mean(x, self._axes(x, dims), keep, name=name)
-        if target in ("squeeze", "unsqueeze"):
+        if target == "squeeze":
             dims = list(x.dims)
-            d = args[1]
-            if target == "squeeze":
+            if len(args) > 1:
+                d = args[1]
+                if dims[d] != 1:
+                    return x  # torch: squeezing a non-1 dim is a no-op
                 dims.pop(d)
             else:
-                dims.insert(d if d >= 0 else len(dims) + d + 1, 1)
+                dims = [s for s in dims if s != 1]
+            return fm.reshape(x, dims, name=name)
+        if target == "unsqueeze":
+            if len(args) < 2:
+                raise NotImplementedError("unsqueeze requires a dim argument")
+            dims = list(x.dims)
+            d = args[1]
+            dims.insert(d if d >= 0 else len(dims) + d + 1, 1)
             return fm.reshape(x, dims, name=name)
         if target == "softmax":
             return fm.softmax(x, args[1] if len(args) > 1 else -1, name=name)

@@ -1,0 +1,116 @@
+// C API over ffcore (reference role: include/flexflow/flexflow_c.h /
+// src/c/flexflow_c.cc — the C surface the Python binding loads). The Python
+// side talks a line-oriented text protocol; see run_text_protocol.
+#include "ffcore.h"
+
+#include <cstring>
+#include <sstream>
+
+namespace ffcore {
+
+static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
+                       Options& o) {
+  std::istringstream ss(line);
+  std::string kind;
+  ss >> kind;
+  if (kind == "machine") {
+    ss >> m.num_chips >> m.peak_bf16_tflops >> m.peak_f32_tflops >> m.hbm_gb >>
+        m.hbm_bw_gbps >> m.ici_gbps >> m.dcn_gbps >> m.link_mult >>
+        m.chips_per_pod;
+  } else if (kind == "options") {
+    int only_dp, mixed, overlap, memory_search;
+    ss >> o.n_devices >> o.batch >> o.budget >> o.alpha >> only_dp >> mixed >>
+        overlap >> memory_search >> o.memory_budget_bytes >> o.mcmc_iters >>
+        o.seed;
+    o.only_dp = only_dp;
+    o.mixed = mixed;
+    o.overlap = overlap;
+    o.memory_search = memory_search;
+  } else if (kind == "node") {
+    NodeDesc n;
+    int tp_capable, inert;
+    ss >> n.guid >> n.flops >> n.bytes_accessed >> n.weight_bytes >>
+        n.act_bytes >> n.out_elems >> n.dtype_bytes >> tp_capable >>
+        n.tp_divisor >> inert;
+    n.tp_capable = tp_capable;
+    n.inert = inert;
+    g.nodes.push_back(n);
+  } else if (kind == "edge") {
+    EdgeDesc e;
+    ss >> e.src >> e.dst >> e.bytes;
+    g.edges.push_back(e);
+  }
+}
+
+std::string run_text_protocol(const std::string& input) {
+  Graph g;
+  MachineSpec m;
+  Options o;
+  std::istringstream in(input);
+  std::string line, cmd = "optimize";
+  while (std::getline(in, line)) {
+    if (line.rfind("cmd ", 0) == 0) {
+      cmd = line.substr(4);
+      continue;
+    }
+    parse_line(line, g, m, o);
+  }
+  std::ostringstream out;
+  out.precision(17);
+  g.finalize();
+  if (cmd == "topo") {
+    for (int i : g.topo_order()) out << g.nodes[i].guid << " ";
+    out << "\n";
+  } else if (cmd == "bottlenecks") {
+    for (int i : g.bottlenecks()) out << g.nodes[i].guid << " ";
+    out << "\n";
+  } else if (cmd == "postdom") {
+    auto pd = g.post_dominators();
+    for (size_t i = 0; i < g.nodes.size(); ++i) {
+      out << g.nodes[i].guid << ":";
+      for (int j : pd[i]) out << " " << g.nodes[j].guid;
+      out << "\n";
+    }
+  } else if (cmd == "simulate") {
+    Simulator sim(g, m, o);
+    std::map<int64_t, Strategy> strategies;  // all-default
+    out << "cost " << sim.simulate(strategies) << "\n";
+  } else {  // optimize
+    SearchResult r = optimize(g, m, o);
+    out << "cost " << r.cost_us << "\n";
+    out << "memory " << r.memory_bytes << "\n";
+    out << "mesh " << r.mesh_dp << " " << r.mesh_tp << "\n";
+    for (const auto& [guid, s] : r.strategies)
+      out << "strategy " << guid << " " << s.dp << " " << s.tp << "\n";
+    std::istringstream logss(r.log);
+    std::string logline;
+    while (std::getline(logss, logline)) out << "log " << logline << "\n";
+  }
+  return out.str();
+}
+
+}  // namespace ffcore
+
+extern "C" {
+
+const char* ffc_version() { return "ffcore-0.1.0"; }
+
+// Runs the text protocol; returns a malloc'd string the caller frees with
+// ffc_free.
+char* ffc_run(const char* input) {
+  try {
+    std::string out = ffcore::run_text_protocol(input ? input : "");
+    char* buf = (char*)malloc(out.size() + 1);
+    memcpy(buf, out.c_str(), out.size() + 1);
+    return buf;
+  } catch (const std::exception& e) {
+    std::string err = std::string("error ") + e.what() + "\n";
+    char* buf = (char*)malloc(err.size() + 1);
+    memcpy(buf, err.c_str(), err.size() + 1);
+    return buf;
+  }
+}
+
+void ffc_free(char* p) { free(p); }
+
+}  // extern "C"

@@ -1,0 +1,147 @@
+// ffcore: native host-side core of flexflow_tpu.
+//
+// Plays the role the reference implements in C++ in src/runtime/graph.cc,
+// substitution.cc (search), simulator.cc / machine_model.cc (cost model) and
+// the dominator utilities of include/flexflow/dominators.h: a device-
+// independent PCG over opaque op descriptors, an analytic TPU machine model,
+// and the Unity-style strategy search (sequence splits at post-dominator
+// bottlenecks + best-first refinement) plus an MCMC fallback. Exposed to
+// Python through the C API in capi.cc (reference role: flexflow_c.h).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace ffcore {
+
+// ---------------------------------------------------------------- machine
+struct MachineSpec {
+  int num_chips = 1;
+  double peak_bf16_tflops = 197.0;
+  double peak_f32_tflops = 49.0;
+  double hbm_gb = 16.0;
+  double hbm_bw_gbps = 819.0;
+  double ici_gbps = 45.0;
+  double dcn_gbps = 25.0 / 8.0;
+  double link_mult = 1.0;  // 2.0 for a bidirectional torus ring
+  int chips_per_pod = 256;
+
+  double link_bw(int n) const;
+  double compute_time_us(double flops, double bytes, int dtype_bytes) const;
+  double allreduce_us(double bytes, int n) const;
+  double allgather_us(double bytes_per_shard, int n) const;
+  double reduce_scatter_us(double bytes, int n) const;
+  double memory_budget_bytes() const { return hbm_gb * 1e9; }
+};
+
+// ---------------------------------------------------------------- graph
+struct NodeDesc {
+  int64_t guid = 0;
+  double flops = 0;
+  double bytes_accessed = 0;
+  double weight_bytes = 0;   // native-dtype bytes of all weights
+  double act_bytes = 0;      // native-dtype bytes of all outputs
+  double out_elems = 0;      // elements of output[0]
+  int dtype_bytes = 4;       // native itemsize of output[0]
+  bool tp_capable = false;
+  int64_t tp_divisor = -1;   // quantity tp must divide; 0 = always ok
+  bool inert = false;        // INPUT / NOOP / WEIGHT
+};
+
+struct EdgeDesc {
+  int64_t src = 0;
+  int64_t dst = 0;
+  double bytes = 0;  // native-dtype bytes of the tensor on this edge
+};
+
+struct Graph {
+  std::vector<NodeDesc> nodes;
+  std::vector<EdgeDesc> edges;
+  std::map<int64_t, int> index;  // guid -> position in nodes
+
+  void finalize();
+  // stable topological order of node indices (by guid among ready nodes)
+  std::vector<int> topo_order() const;
+  // postdom[i] = set of node indices post-dominating i (incl. i)
+  std::vector<std::set<int>> post_dominators() const;
+  // indices of nodes every source->sink path passes through (excl. sources)
+  std::vector<int> bottlenecks() const;
+  std::vector<std::vector<int>> succ() const;
+  std::vector<std::vector<int>> pred() const;
+};
+
+// ---------------------------------------------------------------- search
+struct Options {
+  int n_devices = 1;
+  int batch = 1;
+  int budget = 10;
+  double alpha = 1.05;
+  bool only_dp = false;
+  bool mixed = true;       // bf16 compute dtype
+  bool overlap = false;    // overlap grad allreduce with backward
+  bool memory_search = false;
+  double memory_budget_bytes = 0;
+  int mcmc_iters = 0;      // >0: refine with simulated annealing
+  uint64_t seed = 17;
+};
+
+struct Strategy {
+  int dp = 1;
+  int tp = 1;
+  bool operator==(const Strategy& o) const { return dp == o.dp && tp == o.tp; }
+};
+
+struct SearchResult {
+  double cost_us = 0;
+  double memory_bytes = 0;
+  int mesh_dp = 1;
+  int mesh_tp = 1;
+  std::map<int64_t, Strategy> strategies;
+  std::string log;
+};
+
+class CostModel {
+ public:
+  CostModel(const MachineSpec& m, const Options& o) : m_(m), o_(o) {}
+  int eff_dtype_bytes(const NodeDesc& n) const {
+    return o_.mixed ? 2 : n.dtype_bytes;
+  }
+  double forward_us(const NodeDesc& n, const Strategy& s) const;
+  double backward_us(const NodeDesc& n, const Strategy& s) const;
+  double tp_collective_us(const NodeDesc& n, const Strategy& s) const;
+  double xfer_us(double bytes, const Strategy& src, const Strategy& dst) const;
+  double grad_sync_us(const NodeDesc& n, const Strategy& s) const;
+  double memory_bytes(const NodeDesc& n, const Strategy& s) const;
+  double op_step_us(const NodeDesc& n, const Strategy& s) const;
+
+ private:
+  const MachineSpec& m_;
+  const Options& o_;
+};
+
+class Simulator {
+ public:
+  Simulator(const Graph& g, const MachineSpec& m, const Options& o)
+      : g_(g), cost_(m, o), o_(o) {}
+  double simulate(const std::map<int64_t, Strategy>& strategies,
+                  const std::vector<int>* subset = nullptr) const;
+  double memory(const std::map<int64_t, Strategy>& strategies) const;
+  const CostModel& cost() const { return cost_; }
+
+ private:
+  const Graph& g_;
+  CostModel cost_;
+  Options o_;
+};
+
+SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o);
+
+// -------------------------------------------------------------- protocol
+// Parses the text protocol fed by the Python binding (machine/options/node/
+// edge lines) and renders the result (cost/memory/mesh/strategy lines).
+std::string run_text_protocol(const std::string& input);
+
+}  // namespace ffcore

@@ -1,0 +1,131 @@
+// Analytic TPU machine model + per-op/per-edge cost model.
+//
+// Reference roles: MachineModel hierarchy (include/flexflow/simulator.h:212,
+// 229, 279, 515) and the per-op cost logic of Simulator::measure_operator_
+// cost / simulate_runtime (simulator.cc). Formulas mirror the Python
+// flexflow_tpu/search/machine_model.py + simulator.py cost model exactly so
+// native and Python searches agree.
+#include "ffcore.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace ffcore {
+
+double MachineSpec::link_bw(int n) const {
+  if (n > chips_per_pod) return dcn_gbps * 1e9;
+  return link_mult * ici_gbps * 1e9;
+}
+
+double MachineSpec::compute_time_us(double flops, double bytes,
+                                    int dtype_bytes) const {
+  double peak =
+      (dtype_bytes <= 2 ? peak_bf16_tflops : peak_f32_tflops) * 1e12;
+  double t_flops = flops / peak;
+  double t_mem = bytes / (hbm_bw_gbps * 1e9);
+  return std::max(t_flops, t_mem) * 1e6 + 1.0;
+}
+
+double MachineSpec::allreduce_us(double bytes, int n) const {
+  if (n <= 1) return 0.0;
+  return 2.0 * (n - 1) / n * bytes / link_bw(n) * 1e6 + 1.0;
+}
+
+double MachineSpec::allgather_us(double bytes_per_shard, int n) const {
+  if (n <= 1) return 0.0;
+  return (n - 1) * bytes_per_shard / link_bw(n) * 1e6 + 1.0;
+}
+
+double MachineSpec::reduce_scatter_us(double bytes, int n) const {
+  if (n <= 1) return 0.0;
+  return (double)(n - 1) / n * bytes / link_bw(n) * 1e6 + 1.0;
+}
+
+// ---------------------------------------------------------------- costs
+static const double kBwdFactor = 2.0;  // two grad GEMMs per fwd GEMM
+
+double CostModel::forward_us(const NodeDesc& n, const Strategy& s) const {
+  if (n.inert) return 0.0;
+  double shards = (double)s.dp * (n.tp_capable ? s.tp : 1);
+  if (shards < 1) shards = 1;
+  return m_.compute_time_us(n.flops / shards, n.bytes_accessed / shards,
+                            eff_dtype_bytes(n));
+}
+
+double CostModel::backward_us(const NodeDesc& n, const Strategy& s) const {
+  if (n.inert) return 0.0;
+  return kBwdFactor * forward_us(n, s);
+}
+
+double CostModel::tp_collective_us(const NodeDesc& n, const Strategy& s) const {
+  if (s.tp <= 1 || !n.tp_capable || n.out_elems <= 0) return 0.0;
+  double bytes = n.out_elems * eff_dtype_bytes(n) / std::max(1, s.dp);
+  return m_.allgather_us(bytes / s.tp, s.tp) +
+         m_.reduce_scatter_us(bytes, s.tp);
+}
+
+double CostModel::xfer_us(double bytes, const Strategy& src,
+                          const Strategy& dst) const {
+  if (src.dp == dst.dp) return 0.0;
+  int n = std::max(src.dp, dst.dp);
+  if (dst.dp > src.dp) return 0.0;  // finer consumer: local slice
+  return m_.allgather_us(bytes / n, n);
+}
+
+double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
+  if (s.dp <= 1 || n.weight_bytes <= 0) return 0.0;
+  double wb = n.weight_bytes / std::max(1, s.tp);
+  return m_.allreduce_us(wb, s.dp);
+}
+
+double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
+  double wb = n.weight_bytes / (n.tp_capable ? std::max(1, s.tp) : 1);
+  double ab = n.act_bytes / std::max(1, s.dp * s.tp);
+  return 3.0 * wb + ab;
+}
+
+double CostModel::op_step_us(const NodeDesc& n, const Strategy& s) const {
+  return forward_us(n, s) + backward_us(n, s) + tp_collective_us(n, s);
+}
+
+// ------------------------------------------------------------- simulator
+double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
+                           const std::vector<int>* subset) const {
+  Strategy def;
+  auto get = [&](int64_t guid) {
+    auto it = strategies.find(guid);
+    return it == strategies.end() ? def : it->second;
+  };
+  std::set<int64_t> in_scope;
+  if (subset) {
+    for (int i : *subset) in_scope.insert(g_.nodes[i].guid);
+  } else {
+    for (const auto& n : g_.nodes) in_scope.insert(n.guid);
+  }
+  double total = 0, grad_sync = 0, bwd_sum = 0;
+  for (const auto& n : g_.nodes) {
+    if (!in_scope.count(n.guid)) continue;
+    Strategy s = get(n.guid);
+    total += cost_.op_step_us(n, s);
+    bwd_sum += cost_.backward_us(n, s);
+    grad_sync += cost_.grad_sync_us(n, s);
+  }
+  for (const auto& e : g_.edges) {
+    if (!in_scope.count(e.src) || !in_scope.count(e.dst)) continue;
+    total += 2.0 * cost_.xfer_us(e.bytes, get(e.src), get(e.dst));
+  }
+  if (o_.overlap) grad_sync = std::max(0.0, grad_sync - 0.8 * bwd_sum);
+  return total + grad_sync;
+}
+
+double Simulator::memory(const std::map<int64_t, Strategy>& strategies) const {
+  Strategy def;
+  double total = 0;
+  for (const auto& n : g_.nodes) {
+    auto it = strategies.find(n.guid);
+    total += cost_.memory_bytes(n, it == strategies.end() ? def : it->second);
+  }
+  return total;
+}
+
+}  // namespace ffcore

@@ -1,0 +1,181 @@
+// Unity-style strategy search + MCMC refinement.
+//
+// Reference roles: GraphSearchHelper::graph_optimize / base_optimize
+// (substitution.cc:1898, 2229 — sequence splits at bottleneck nodes,
+// memoized, best-first refinement with alpha pruning and an iteration
+// budget) and FFModel::mcmc_optimize (model.cc:3286 — simulated annealing
+// over per-op configs). Algorithms re-implemented over NodeDesc graphs.
+#include "ffcore.h"
+
+#include <algorithm>
+#include <cmath>
+#include <queue>
+#include <random>
+#include <sstream>
+
+namespace ffcore {
+
+static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
+                                  const Options& o) {
+  std::vector<int> dps;
+  if (o.batch % dp == 0) dps.push_back(dp);
+  if (dp != 1) dps.push_back(1);
+  if (dps.empty()) dps.push_back(1);
+  std::vector<int> tps = {1};
+  bool tp_ok = tp > 1 && n.tp_capable && !o.only_dp &&
+               (n.tp_divisor == 0 ||
+                (n.tp_divisor > 0 && n.tp_divisor % tp == 0));
+  if (tp_ok) tps = {tp, 1};
+  std::vector<Strategy> out;
+  for (int d : dps)
+    for (int t : tps) out.push_back({d, t});
+  return out;
+}
+
+// segments of the topological order, cut after each bottleneck node
+static std::vector<std::vector<int>> segments(const Graph& g) {
+  auto order = g.topo_order();
+  auto bn = g.bottlenecks();
+  std::set<int> cut(bn.begin(), bn.end());
+  std::vector<std::vector<int>> segs(1);
+  for (int u : order) {
+    segs.back().push_back(u);
+    if (cut.count(u)) segs.emplace_back();
+  }
+  if (segs.back().empty()) segs.pop_back();
+  return segs;
+}
+
+struct Candidate {
+  double cost;
+  uint64_t order;
+  std::map<int64_t, Strategy> strategies;
+  bool operator>(const Candidate& o) const {
+    return cost != o.cost ? cost > o.cost : order > o.order;
+  }
+};
+
+static std::map<int64_t, Strategy> optimize_segment(
+    const Graph& g, const Simulator& sim, const std::vector<int>& seg,
+    int dp, int tp, const Options& o) {
+  std::map<int64_t, Strategy> best;
+  // greedy seed: per-op best in isolation (menu order breaks ties)
+  for (int i : seg) {
+    const NodeDesc& n = g.nodes[i];
+    auto m = menu(n, dp, tp, o);
+    Strategy pick = m[0];
+    double pc = sim.cost().op_step_us(n, pick);
+    for (const auto& s : m) {
+      double c = sim.cost().op_step_us(n, s);
+      if (c < pc) {
+        pc = c;
+        pick = s;
+      }
+    }
+    best[n.guid] = pick;
+  }
+  double best_cost = sim.simulate(best, &seg);
+  // best-first refinement over single-op strategy flips
+  std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
+  uint64_t counter = 0;
+  pq.push({best_cost, counter++, best});
+  int pops = 0;
+  while (!pq.empty() && pops < o.budget) {
+    Candidate cur = pq.top();
+    pq.pop();
+    pops++;
+    if (cur.cost > best_cost * o.alpha) continue;
+    for (int i : seg) {
+      const NodeDesc& n = g.nodes[i];
+      for (const auto& s : menu(n, dp, tp, o)) {
+        if (s == cur.strategies[n.guid]) continue;
+        auto cand = cur.strategies;
+        cand[n.guid] = s;
+        double c = sim.simulate(cand, &seg);
+        if (c < best_cost) {
+          best = cand;
+          best_cost = c;
+        }
+        if (c < cur.cost * o.alpha) pq.push({c, counter++, std::move(cand)});
+      }
+    }
+  }
+  return best;
+}
+
+// MCMC refinement (reference: mcmc_optimize model.cc:3286): random single-op
+// rewrites, Metropolis acceptance, annealed temperature.
+static void mcmc_refine(const Graph& g, const Simulator& sim, int dp, int tp,
+                        const Options& o,
+                        std::map<int64_t, Strategy>& strategies,
+                        double& cost) {
+  std::mt19937_64 rng(o.seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  auto cur = strategies;
+  double cur_cost = cost;
+  for (int it = 0; it < o.mcmc_iters; ++it) {
+    const NodeDesc& n = g.nodes[rng() % g.nodes.size()];
+    auto m = menu(n, dp, tp, o);
+    auto cand = cur;
+    cand[n.guid] = m[rng() % m.size()];
+    double c = sim.simulate(cand);
+    double temp = 1.0 - (double)it / std::max(1, o.mcmc_iters);
+    // alpha plays the reference's acceptance sharpness role
+    if (c < cur_cost ||
+        unif(rng) < std::exp(-(c - cur_cost) / (cur_cost * 0.05 * temp + 1e-9))) {
+      cur = std::move(cand);
+      cur_cost = c;
+    }
+    if (cur_cost < cost) {
+      strategies = cur;
+      cost = cur_cost;
+    }
+  }
+}
+
+SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
+  g.finalize();
+  Simulator sim(g, m, o);
+  auto segs = segments(g);
+
+  SearchResult best;
+  best.cost_us = -1;
+  std::ostringstream log;
+
+  std::vector<std::pair<int, int>> pairs;
+  if (o.only_dp) {
+    pairs = {{o.n_devices, 1}};
+  } else {
+    for (int dp = 1; dp <= o.n_devices; ++dp)
+      if (o.n_devices % dp == 0) pairs.push_back({dp, o.n_devices / dp});
+  }
+  for (auto [dp, tp] : pairs) {
+    if (o.batch % dp != 0) continue;
+    std::map<int64_t, Strategy> strategies;
+    for (const auto& seg : segs) {
+      auto part = optimize_segment(g, sim, seg, dp, tp, o);
+      strategies.insert(part.begin(), part.end());
+    }
+    double cost = sim.simulate(strategies);
+    if (o.mcmc_iters > 0) mcmc_refine(g, sim, dp, tp, o, strategies, cost);
+    double mem = sim.memory(strategies);
+    if (o.memory_search && o.memory_budget_bytes > 0 &&
+        mem > o.memory_budget_bytes) {
+      double overflow = (mem - o.memory_budget_bytes) / o.memory_budget_bytes;
+      cost *= (1.0 + 10.0 * overflow);
+    }
+    log << "dp=" << dp << " tp=" << tp << " cost=" << cost
+        << "us mem=" << mem / 1e9 << "GB\n";
+    if (best.cost_us < 0 || cost < best.cost_us) {
+      best.cost_us = cost;
+      best.memory_bytes = mem;
+      best.mesh_dp = dp;
+      best.mesh_tp = tp;
+      best.strategies = std::move(strategies);
+    }
+  }
+  best.log = log.str();
+  return best;
+}
+
+}  // namespace ffcore

@@ -1,0 +1,142 @@
+"""Native C++ core (libffcore) tests: graph algorithms and the Unity search
+must agree with the pure-Python implementations (reference test model:
+tests/unit/test_dominators.cc, test_machine_view.cc)."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import native
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.search.machine_model import TpuPodModel
+from flexflow_tpu.search.unity import GraphSearchHelper, unity_optimize
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="libffcore not buildable"
+)
+
+
+def build_mlp_model(n_dev=4, batch=32, tp_friendly=True):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.num_devices = n_dev
+    config.search_budget = 8
+    model = ff.FFModel(config)
+    t = model.create_tensor([batch, 64], ff.DataType.DT_FLOAT)
+    h = model.dense(t, 128 if tp_friendly else 126, ff.ActiMode.AC_MODE_RELU)
+    h = model.dense(h, 128, ff.ActiMode.AC_MODE_RELU)
+    out = model.dense(h, 10)
+    out = model.softmax(out)
+    return config, model
+
+
+def branching_model():
+    config = ff.FFConfig()
+    config.batch_size = 16
+    model = ff.FFModel(config)
+    t = model.create_tensor([16, 32], ff.DataType.DT_FLOAT)
+    a = model.dense(t, 32, name="branch_a")
+    b = model.dense(t, 32, name="branch_b")
+    m = model.add(a, b)
+    out = model.dense(m, 8, name="join")
+    return config, model
+
+
+def test_version():
+    assert native.version().startswith("ffcore")
+
+
+def test_topo_matches_python():
+    config, model = branching_model()
+    g = Graph(model.ops)
+    ours = native.topo_order(g)
+    theirs = [op.guid for op in g.topo_order()]
+    assert ours == theirs
+
+
+def test_bottlenecks_match_python():
+    config, model = branching_model()
+    g = Graph(model.ops)
+    ours = native.bottlenecks(g)
+    theirs = [op.guid for op in g.bottleneck_nodes()]
+    assert ours == theirs
+    # the join dense and the add must be bottlenecks; the branches must not
+    names = {g.ops[guid].name for guid in ours}
+    assert "join" in names
+    assert "branch_a" not in names
+
+
+def test_search_agrees_with_python():
+    config, model = build_mlp_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(4)
+
+    native_res = native.optimize_strategy(g, config, machine, 32, 4)
+
+    config.use_native_search = False
+    helper = GraphSearchHelper(g, config, machine)
+    py_res = helper.graph_optimize(32, 4)
+
+    # identical cost model -> near-identical optimal cost
+    assert native_res.cost_us == pytest.approx(py_res.cost_us, rel=1e-6)
+    assert native_res.mesh_axes == py_res.mesh_axes
+    # strategies agree per-op (same menu order, same tie-breaking)
+    for guid, s in py_res.strategies.items():
+        ns = native_res.strategies[guid]
+        assert (ns.dp, ns.tp) == (s.dp, s.tp), g.ops[guid].name
+
+
+def test_unity_optimize_dispatches_to_native():
+    config, model = build_mlp_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(4)
+    res = unity_optimize(g, config, machine, 32, 4)
+    assert any("native" in line for line in res.log)
+    assert res.cost_us > 0
+
+
+def test_native_memory_search_penalizes_overflow():
+    config, model = build_mlp_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(4)
+    base = native.optimize_strategy(g, config, machine, 32, 4)
+    config.memory_search = True
+    config.memory_budget_mb = 1e-3  # impossible budget -> penalty applies
+    res = native.optimize_strategy(g, config, machine, 32, 4)
+    assert res.cost_us > base.cost_us
+
+
+def test_native_mcmc_never_worse():
+    config, model = build_mlp_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(4)
+    base = native.optimize_strategy(g, config, machine, 32, 4)
+    refined = native.optimize_strategy(g, config, machine, 32, 4,
+                                       mcmc_iters=200)
+    assert refined.cost_us <= base.cost_us * (1 + 1e-9)
+
+
+def test_compile_uses_native_search_end_to_end():
+    config = ff.FFConfig()
+    config.batch_size = 32
+    config.num_devices = 1  # single real device; search still runs
+    config.search_budget = 4
+    model = ff.FFModel(config)
+    t = model.create_tensor([32, 64], ff.DataType.DT_FLOAT)
+    h = model.dense(t, 128, ff.ActiMode.AC_MODE_RELU)
+    out = model.softmax(model.dense(h, 10))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.05),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+    x = np.random.RandomState(0).randn(32, 64).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, (32, 1)).astype(np.int32)
+    hist = model.fit([x], y, epochs=1)
+    assert len(hist) == 1
+
+
+def test_native_infeasible_raises():
+    config, model = build_mlp_model(n_dev=4, batch=30)  # 30 % 4 != 0
+    config.only_data_parallel = True
+    g = Graph(model.ops)
+    machine = TpuPodModel(4)
+    with pytest.raises(ValueError, match="no feasible"):
+        native.optimize_strategy(g, config, machine, 30, 4)

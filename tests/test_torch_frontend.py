@@ -232,3 +232,38 @@ def test_multihead_attention_parity():
     with torch.no_grad():
         theirs = module(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_size_arithmetic_view():
+    class M(nn.Module):
+        def forward(self, x):
+            return x.view(x.size(0), x.size(1) * x.size(2))
+
+    x = np.random.RandomState(10).rand(4, 3, 5).astype(np.float32)
+    module = M().eval()
+    config = make_config(batch=4)
+    model = ff.FFModel(config)
+    t = model.create_tensor([4, 3, 5], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(module).apply(model, [t])
+    assert outs[0].dims == (4, 15)
+
+
+def test_squeeze_semantics():
+    class M(nn.Module):
+        def forward(self, x):
+            return x.unsqueeze(1).squeeze() + x.squeeze(1)  # squeeze(1) no-op
+
+    x = np.random.RandomState(11).rand(4, 6).astype(np.float32)
+    module = M().eval()
+    config = make_config(batch=4)
+    model = ff.FFModel(config)
+    t = model.create_tensor([4, 6], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(module).apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[])
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
