@@ -1,0 +1,49 @@
+"""Shared helpers for the native examples: CLI config, synthetic datasets,
+throughput printing (role of each reference example's parse_input_args +
+bespoke DataLoader)."""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def get_config(batch_size: int = 64, epochs: int = 1) -> ff.FFConfig:
+    """Example defaults first, then CLI flags override them."""
+    config = ff.FFConfig()
+    config.batch_size = batch_size
+    config.epochs = epochs
+    config.parse_args(sys.argv[1:])
+    return config
+
+
+def synthetic_images(n, chans, size, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, chans, size, size).astype(np.float32)
+    y = rng.randint(0, classes, size=(n, 1)).astype(np.int32)
+    return x, y
+
+
+def train_and_report(model, inputs, labels, config, name,
+                     optimizer=None, target_accuracy=None):
+    model.compile(
+        optimizer=optimizer or ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    n = labels.shape[0]
+    start = time.time()
+    hist = model.fit(inputs, labels, batch_size=config.batch_size,
+                     epochs=config.epochs)
+    elapsed = time.time() - start
+    thru = n * config.epochs / max(elapsed, 1e-9)
+    acc = hist[-1].get("accuracy", float("nan")) * 100.0
+    print(f"[{name}] time {elapsed:.2f}s, throughput {thru:.1f} samples/s, "
+          f"final accuracy {acc:.2f}%")
+    if target_accuracy is not None and acc < target_accuracy:
+        raise SystemExit(
+            f"{name}: accuracy {acc:.2f}% below gate {target_accuracy}%")
+    return hist

@@ -1,0 +1,12 @@
+"""Accuracy gates for the native examples (reference:
+examples/python/native/accuracy.py:19-24 — ModelAccuracy enum with a ≥90%
+CI threshold per model)."""
+import enum
+
+
+class ModelAccuracy(enum.Enum):
+    MNIST_MLP = 90.0
+    MNIST_CNN = 90.0
+    REUTERS_MLP = 90.0
+    CIFAR10_CNN = 90.0
+    CIFAR10_ALEXNET = 90.0

@@ -1,0 +1,25 @@
+"""AlexNet (reference: examples/python/native/alexnet.py,
+bootcamp_demo/ff_alexnet_cifar10.py — CIFAR-10 upsampled to 229x229)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_alexnet
+
+from _util import get_config, synthetic_images, train_and_report
+
+
+def main():
+    config = get_config(batch_size=64, epochs=1)
+    size = 229
+    x, y = synthetic_images(config.batch_size * 4, 3, size)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, size, size])
+    build_alexnet(model, inp)
+    train_and_report(model, [x], y, config, "alexnet")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""CIFAR-10 CNN (reference: examples/python/native/cifar10_cnn.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_cifar10_cnn
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=64, epochs=3)
+    from flexflow_tpu.keras.datasets import cifar10
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    if x_train.shape[-1] == 3:  # NHWC → NCHW
+        x_train = np.transpose(x_train, (0, 3, 1, 2))
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, 32, 32])
+    build_cifar10_cnn(model, inp)
+    train_and_report(model, [x_train], y_train, config, "cifar10_cnn",
+                     optimizer=ff.AdamOptimizer(model, alpha=1e-3))
+
+
+if __name__ == "__main__":
+    main()

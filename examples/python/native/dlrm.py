@@ -1,0 +1,39 @@
+"""DLRM (reference: examples/python/native/dlrm.py, examples/cpp/DLRM) —
+attribute-parallel embedding sharding benchmark config."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=64, epochs=1)
+    cfg = DLRMConfig(
+        sparse_feature_size=64,
+        embedding_size=[100000] * 4,
+        mlp_bot=[4, 64, 64],
+        mlp_top=[64 * 5, 64, 2],  # 4 embeddings + bottom output, concat
+    )
+    batch = config.batch_size
+    n = batch * 8
+    rng = np.random.RandomState(0)
+    dense_np = rng.randn(n, cfg.mlp_bot[0]).astype(np.float32)
+    sparse_np = [rng.randint(0, v, size=(n, cfg.embedding_bag_size)).astype(np.int32)
+                 for v in cfg.embedding_size]
+    y = rng.randint(0, 2, size=(n, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    dense = model.create_tensor([batch, cfg.mlp_bot[0]])
+    sparse = [model.create_tensor([batch, cfg.embedding_bag_size],
+                                  ff.DataType.DT_INT32)
+              for _ in cfg.embedding_size]
+    build_dlrm(model, dense, sparse, cfg)
+    train_and_report(model, [dense_np] + sparse_np, y, config, "dlrm")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""InceptionV3 (reference: examples/python/native/inception.py)."""
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_inception_v3
+
+from _util import get_config, synthetic_images, train_and_report
+
+
+def main():
+    config = get_config(batch_size=8, epochs=1)
+    x, y = synthetic_images(config.batch_size * 2, 3, 299)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, 299, 299])
+    build_inception_v3(model, inp)
+    train_and_report(model, [x], y, config, "inception_v3")
+
+
+if __name__ == "__main__":
+    main()

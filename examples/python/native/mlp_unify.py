@@ -1,0 +1,31 @@
+"""MLP_Unify (reference: examples/cpp/MLP_Unify/mlp.cc) — the minimal
+Unity-search demo: run with --search-budget > 0 to let the strategy search
+choose per-op parallelization over the mesh."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_mlp_unify
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=64, epochs=1)
+    batch, in_dim = config.batch_size, 1024
+    n = batch * 8
+    rng = np.random.RandomState(0)
+    x1 = rng.randn(n, in_dim).astype(np.float32)
+    x2 = rng.randn(n, in_dim).astype(np.float32)
+    y = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    in1 = model.create_tensor([batch, in_dim])
+    in2 = model.create_tensor([batch, in_dim])
+    build_mlp_unify(model, in1, in2, hidden_dims=(4096, 4096, 4096, 10))
+    train_and_report(model, [x1, x2], y, config, "mlp_unify")
+
+
+if __name__ == "__main__":
+    main()

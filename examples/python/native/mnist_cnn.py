@@ -1,0 +1,32 @@
+"""MNIST CNN (reference: examples/python/native/mnist_cnn.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_mnist_cnn
+
+from _util import get_config, train_and_report
+from accuracy import ModelAccuracy
+
+
+def main():
+    config = get_config(batch_size=64, epochs=3)
+    from flexflow_tpu.keras.datasets import mnist
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 1, 28, 28])
+    build_mnist_cnn(model, inp)
+    train_and_report(
+        model, [x_train], y_train, config, "mnist_cnn",
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        target_accuracy=ModelAccuracy.MNIST_CNN.value,
+    )
+
+
+if __name__ == "__main__":
+    main()

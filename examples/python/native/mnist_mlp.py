@@ -1,0 +1,34 @@
+"""MNIST MLP (reference: examples/python/native/mnist_mlp.py) with the ≥90%
+accuracy gate. Uses the keras-frontend mnist dataset (synthetic fallback
+when no dataset file is available)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_mnist_mlp
+
+from _util import get_config, train_and_report
+from accuracy import ModelAccuracy
+
+
+def main():
+    config = get_config(batch_size=64, epochs=5)
+    from flexflow_tpu.keras.datasets import mnist
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 784])
+    build_mnist_mlp(model, inp)
+    train_and_report(
+        model, [x_train], y_train, config, "mnist_mlp",
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        target_accuracy=ModelAccuracy.MNIST_MLP.value,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Mixture-of-experts encoder (reference: examples/cpp/mixture_of_experts/
+moe.cc) — expert parallelism via topk/group_by/aggregate."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import MoeConfig, build_moe_encoder
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=32, epochs=1)
+    cfg = MoeConfig()
+    batch, seq, d = config.batch_size, 16, cfg.hidden_size
+    n = batch * 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, seq, d).astype(np.float32)
+    y = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, seq, d])
+    out = build_moe_encoder(model, inp, cfg)
+    pooled = model.mean(out, [1])
+    model.softmax(model.dense(pooled, 10, name="head"))
+    train_and_report(model, [x], y, config, "moe")
+
+
+if __name__ == "__main__":
+    main()

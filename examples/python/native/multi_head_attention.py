@@ -1,0 +1,29 @@
+"""Standalone multi-head attention demo (reference:
+examples/python/native/multi_head_attention.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=16, epochs=1)
+    batch, seq, d = config.batch_size, 32, 64
+    n = batch * 4
+    rng = np.random.RandomState(0)
+    q = rng.randn(n, seq, d).astype(np.float32)
+    y = rng.randint(0, 2, size=(n, seq, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    qt = model.create_tensor([batch, seq, d])
+    t = model.multihead_attention(qt, qt, qt, d, 8)
+    t = model.dense(t, 2)
+    model.softmax(t)
+    train_and_report(model, [q], y, config, "multi_head_attention")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""LSTM NMT (reference capability: nmt/ legacy app) on synthetic copy task."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_lstm_nmt
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=32, epochs=2)
+    batch, seq, vocab = config.batch_size, 24, 1000
+    n = batch * 8
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, vocab, size=(n, seq)).astype(np.int32)
+    tgt = src.copy()  # copy task
+    y = src[..., None].astype(np.int32)
+
+    model = ff.FFModel(config)
+    src_t = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    tgt_t = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    build_lstm_nmt(model, src_t, tgt_t, src_vocab=vocab, tgt_vocab=vocab,
+                   embed_dim=128, hidden_size=256)
+    train_and_report(model, [src, tgt], y, config, "nmt_lstm",
+                     optimizer=ff.AdamOptimizer(model, alpha=1e-3))
+
+
+if __name__ == "__main__":
+    main()

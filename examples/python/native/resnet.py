@@ -1,0 +1,23 @@
+"""ResNet-50 (reference: examples/python/native/resnet.py,
+examples/cpp/ResNet)."""
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_resnet50
+
+from _util import get_config, synthetic_images, train_and_report
+
+
+def main():
+    config = get_config(batch_size=16, epochs=1)
+    size = 224
+    x, y = synthetic_images(config.batch_size * 2, 3, size)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, size, size])
+    build_resnet50(model, inp)
+    train_and_report(model, [x], y, config, "resnet50")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Split/concat round-trip (reference: examples/python/native/split.py,
+examples/cpp/split_test)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=32, epochs=1)
+    batch, d = config.batch_size, 64
+    n = batch * 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, d])
+    a, b = model.split(inp, [d // 2, d // 2], axis=1)
+    a = model.dense(a, 32, ff.ActiMode.AC_MODE_RELU)
+    b = model.dense(b, 32, ff.ActiMode.AC_MODE_RELU)
+    t = model.concat([a, b], axis=1)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    train_and_report(model, [x], y, config, "split")
+
+
+if __name__ == "__main__":
+    main()

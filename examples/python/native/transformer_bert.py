@@ -1,0 +1,32 @@
+"""BERT-style transformer (reference: examples/cpp/Transformer — the
+OSDI'22 bert.sh benchmark config: 12 layers, hidden 1024, 16 heads,
+seq 512)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import TransformerConfig, build_bert_encoder
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=8, epochs=1)
+    cfg = TransformerConfig(num_layers=2, hidden_size=256, num_heads=8,
+                            sequence_length=128)  # laptop-scale default
+    batch, seq = config.batch_size, cfg.sequence_length
+    n = batch * 4
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(n, seq)).astype(np.int32)
+    y = rng.randint(0, 2, size=(n, seq, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    build_bert_encoder(model, tokens, cfg)
+    train_and_report(model, [x], y, config, "bert",
+                     optimizer=ff.AdamOptimizer(model, alpha=1e-4))
+
+
+if __name__ == "__main__":
+    main()

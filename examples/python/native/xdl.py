@@ -1,0 +1,30 @@
+"""XDL CTR model (reference: examples/cpp/XDL)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import XDLConfig, build_xdl
+
+from _util import get_config, train_and_report
+
+
+def main():
+    config = get_config(batch_size=64, epochs=1)
+    cfg = XDLConfig(embedding_size=[100000] * 4)
+    batch = config.batch_size
+    n = batch * 8
+    rng = np.random.RandomState(0)
+    sparse_np = [rng.randint(0, v, size=(n, 1)).astype(np.int32)
+                 for v in cfg.embedding_size]
+    y = rng.randint(0, 2, size=(n, 1)).astype(np.int32)
+
+    model = ff.FFModel(config)
+    sparse = [model.create_tensor([batch, 1], ff.DataType.DT_INT32)
+              for _ in cfg.embedding_size]
+    build_xdl(model, sparse, cfg)
+    train_and_report(model, sparse_np, y, config, "xdl")
+
+
+if __name__ == "__main__":
+    main()

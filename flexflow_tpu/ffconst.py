@@ -138,6 +138,7 @@ class OpType(enum.Enum):
     TOPK = "topk"
     GROUP_BY = "group_by"
     FUSED = "fused"
+    LSTM = "lstm"
     # Parallel ops (reference: src/parallel_ops)
     REPARTITION = "repartition"
     COMBINE = "combine"

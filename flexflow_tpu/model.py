@@ -387,6 +387,14 @@ class FFModel:
         ).outputs[0]
 
     # -- MoE (reference model.h:509-514, src/ops/{topk,group_by,aggregate,cache}.cc)
+    def lstm(self, input: Tensor, hidden_size: int,
+             return_sequences: bool = True, name: str = "") -> Tensor:
+        """Scan-based LSTM layer (reference capability: nmt/lstm.cu)."""
+        return self._add_op(
+            OpType.LSTM, [input], name,
+            hidden_size=hidden_size, return_sequences=return_sequences,
+        ).outputs[0]
+
     def top_k(self, input: Tensor, k: int, sorted: bool = False, name: str = "") -> Tuple[Tensor, Tensor]:
         outs = self._add_op(OpType.TOPK, [input], name, k=k, sorted=sorted).outputs
         return outs[0], outs[1]
@@ -440,7 +448,17 @@ class FFModel:
         name: str = "",
     ) -> Tensor:
         """MoE block (reference: FFModel::moe, model.h:509-514 / moe.cc):
-        gating softmax → topk → group_by → per-expert dense → aggregate."""
+        gating softmax → topk → group_by → per-expert dense → aggregate.
+        Inputs of rank > 2 are flattened to [tokens, features] for dispatch
+        and restored afterwards (the capacity-factor dispatch is per-token)."""
+        orig_dims = None
+        if len(input.dims) > 2:
+            orig_dims = input.dims
+            tokens = 1
+            for d in input.dims[:-1]:
+                tokens *= d
+            input = self.reshape(input, [tokens, input.dims[-1]],
+                                 name=f"{name}_tokens")
         gate = self.dense(input, num_exp, ActiMode.AC_MODE_NONE, name=f"{name}_gate")
         gate = self.softmax(gate)
         topk_out, topk_idx = self.top_k(gate, num_select)
@@ -449,7 +467,13 @@ class FFModel:
             self.dense(g, expert_hidden_size, ActiMode.AC_MODE_RELU, name=f"{name}_exp{i}")
             for i, g in enumerate(grouped)
         ]
-        return self.aggregate(topk_out, topk_idx, topk_idx, gate, exp_preds, num_exp, lambda_bal)
+        out = self.aggregate(topk_out, topk_idx, topk_idx, gate, exp_preds,
+                             num_exp, lambda_bal)
+        if orig_dims is not None:
+            out = self.reshape(
+                out, list(orig_dims[:-1]) + [expert_hidden_size],
+                name=f"{name}_untokens")
+        return out
 
     # ------------------------------------------------------------------
     # compile / strategy

@@ -1,0 +1,45 @@
+"""Model zoo: the reference's example model families as reusable builders.
+
+The reference ships each model family as a standalone C++ driver under
+examples/cpp/ (AlexNet, ResNet, InceptionV3, resnext50, Transformer, DLRM,
+XDL, candle_uno, MLP_Unify, mixture_of_experts) plus python variants under
+examples/python/native. Here each family is a library function that builds
+the network through the FFModel layer API, so the same builder serves the
+examples/, the benchmark scripts, and tests.
+"""
+from .alexnet import build_alexnet
+from .cnn import build_cifar10_cnn, build_mnist_cnn, build_mnist_mlp
+from .resnet import build_resnet, build_resnet50
+from .inception import build_inception_v3
+from .resnext import build_resnext50
+from .dlrm import DLRMConfig, build_dlrm
+from .xdl import XDLConfig, build_xdl
+from .candle_uno import CandleUnoConfig, build_candle_uno
+from .mlp import build_mlp_unify
+from .transformer import TransformerConfig, build_bert_encoder, build_transformer
+from .moe import MoeConfig, build_moe_encoder
+from .rnn import build_lstm_nmt
+
+__all__ = [
+    "build_alexnet",
+    "build_mnist_mlp",
+    "build_mnist_cnn",
+    "build_cifar10_cnn",
+    "build_resnet",
+    "build_resnet50",
+    "build_inception_v3",
+    "build_resnext50",
+    "DLRMConfig",
+    "build_dlrm",
+    "XDLConfig",
+    "build_xdl",
+    "CandleUnoConfig",
+    "build_candle_uno",
+    "build_mlp_unify",
+    "TransformerConfig",
+    "build_transformer",
+    "build_bert_encoder",
+    "MoeConfig",
+    "build_moe_encoder",
+    "build_lstm_nmt",
+]

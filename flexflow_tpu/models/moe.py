@@ -1,0 +1,37 @@
+"""Mixture-of-experts encoder (reference: examples/cpp/mixture_of_experts/
+moe.cc:100-135) — attention + MoE blocks with layer norm, the
+expert-parallelism benchmark and the user of the recompile/cache machinery
+(moe.cc:40-98: moe_score/moe_trigger/moe_alter)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class MoeConfig:
+    """Defaults mirror MoeConfig (moe.h)."""
+    hidden_size: int = 64
+    num_attention_heads: int = 16
+    num_encoder_layers: int = 6
+    num_exp: int = 5
+    num_select: int = 2
+    alpha: float = 2.0       # group_by capacity factor
+    lambda_bal: float = 0.04  # load-balance aux loss weight
+
+
+def build_moe_encoder(model, input, cfg: MoeConfig = None):
+    """Per layer: x = LN(x + MHA(x)); x = LN(x + MoE(x)) (moe.cc:105-126).
+    `input` is [batch, seq, hidden_size]."""
+    cfg = cfg or MoeConfig()
+    ff = model
+    x = input
+    for i in range(cfg.num_encoder_layers):
+        attn = ff.multihead_attention(
+            x, x, x, cfg.hidden_size, cfg.num_attention_heads,
+            name=f"l{i}_attn")
+        x = ff.layer_norm(ff.add(x, attn), [-1], name=f"l{i}_ln1")
+        expert_out = ff.moe(x, cfg.num_exp, cfg.num_select,
+                            cfg.hidden_size, cfg.alpha, cfg.lambda_bal,
+                            name=f"l{i}_moe")
+        x = ff.layer_norm(ff.add(x, expert_out), [-1], name=f"l{i}_ln2")
+    return x

@@ -1,0 +1,82 @@
+"""Recurrent ops: LSTM (reference: nmt/lstm.cu, nmt/rnn.cu — the legacy NMT
+app's custom cuDNN RNN kernels).
+
+TPU-native design: the recurrence is a `lax.scan` over the time axis, so the
+whole-sequence layer is one XLA while-loop with a fused per-step body (two
+MXU matmuls + gate elementwise) instead of per-timestep kernel launches.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import Op, WeightSpec, register_op
+from ..ffconst import DataType, OpType
+from ..runtime.initializers import DefaultInitializer, ZeroInitializer
+from .common import matmul_dtype
+
+
+@register_op
+class LSTMOp(Op):
+    """Single-layer LSTM over [batch, seq, input_dim] → [batch, seq, hidden]
+    (return_sequences) or [batch, hidden]."""
+
+    op_type = OpType.LSTM
+
+    def output_shapes(self):
+        (x,) = self.inputs
+        b, s, _ = x.dims
+        h = self.params["hidden_size"]
+        if self.params.get("return_sequences", True):
+            return [(b, s, h)], [x.dtype]
+        return [(b, h)], [x.dtype]
+
+    def weight_specs(self) -> List[WeightSpec]:
+        (x,) = self.inputs
+        h = self.params["hidden_size"]
+        return [
+            WeightSpec("kernel", (x.dims[-1], 4 * h), x.dtype,
+                       DefaultInitializer()),
+            WeightSpec("recurrent_kernel", (h, 4 * h), x.dtype,
+                       DefaultInitializer()),
+            WeightSpec("bias", (4 * h,), x.dtype, ZeroInitializer()),
+        ]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        h_size = self.params["hidden_size"]
+        cdt = matmul_dtype(ctx.config, x.dtype)
+        wx, wh, b = weights["kernel"], weights["recurrent_kernel"], weights["bias"]
+
+        # hoist the input projection out of the scan: one big MXU matmul
+        # over [batch*seq, input_dim] instead of seq small ones
+        gates_x = jnp.dot(x.astype(cdt), wx.astype(cdt),
+                          preferred_element_type=jnp.float32) + b
+
+        def step(carry, gx):
+            h, c = carry
+            gates = gx + jnp.dot(h.astype(cdt), wh.astype(cdt),
+                                 preferred_element_type=jnp.float32)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        batch = x.shape[0]
+        h0 = jnp.zeros((batch, h_size), jnp.float32)
+        (h_last, _), hs = jax.lax.scan(
+            step, (h0, h0), jnp.swapaxes(gates_x, 0, 1)
+        )
+        out_dtype = self.outputs[0].dtype.jnp_dtype
+        if self.params.get("return_sequences", True):
+            return [jnp.swapaxes(hs, 0, 1).astype(out_dtype)]
+        return [h_last.astype(out_dtype)]
+
+    def flops(self) -> float:
+        x = self.inputs[0]
+        b, s, d = x.dims
+        h = self.params["hidden_size"]
+        return 2.0 * b * s * (d + h) * 4 * h

@@ -1,0 +1,257 @@
+"""Model-zoo coverage: every reference example family builds, compiles, and
+runs one training step (reference analog: examples/cpp/* drivers +
+tests/cpp_gpu_tests.sh running each example at small scale)."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import models as zoo
+
+
+def _fit_one(model, inputs, label, batch):
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    hist = model.fit(inputs, label, batch_size=batch, epochs=1)
+    assert len(hist) == 1
+    assert np.isfinite(hist[0]["loss"]) if "loss" in hist[0] else True
+    return hist
+
+
+def _image_model(builder, chans=3, size=32, batch=4, **kw):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, chans, size, size])
+    out = builder(model, inp, **kw)
+    x = np.random.RandomState(0).randn(batch, chans, size, size).astype(np.float32)
+    y = np.zeros((batch, 1), dtype=np.int32)
+    _fit_one(model, [x], y, batch)
+    return out
+
+
+def test_alexnet_builds_and_trains():
+    # AlexNet needs ≥ 65x65 input for its stride stack
+    _image_model(zoo.build_alexnet, size=128)
+
+
+def test_mnist_cnn():
+    _image_model(zoo.build_mnist_cnn, chans=1, size=28)
+
+
+def test_cifar10_cnn():
+    _image_model(zoo.build_cifar10_cnn, size=32)
+
+
+def test_resnet_small():
+    _image_model(zoo.build_resnet, size=64, stages=(1, 1))
+
+
+def test_resnext_small():
+    config = ff.FFConfig()
+    config.batch_size = 2
+    model = ff.FFModel(config)
+    inp = model.create_tensor([2, 3, 64, 64])
+    out = zoo.build_resnext50(model, inp, num_classes=10, groups=4)
+    assert out.dims[-1] == 10
+
+
+def test_inception_v3_builds():
+    config = ff.FFConfig()
+    config.batch_size = 2
+    model = ff.FFModel(config)
+    inp = model.create_tensor([2, 3, 299, 299])
+    out = zoo.build_inception_v3(model, inp)
+    # channel count after the E blocks is 2048, spatial collapsed
+    assert out.dims == (2, 10)
+
+
+def test_dlrm_trains():
+    batch = 8
+    cfg = zoo.DLRMConfig(
+        sparse_feature_size=8,
+        embedding_size=[100, 100],
+        mlp_bot=[4, 16, 8],
+        mlp_top=[8, 16, 2],
+    )
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    dense = model.create_tensor([batch, 4])
+    sparse = [
+        model.create_tensor([batch, cfg.embedding_bag_size], ff.DataType.DT_INT32)
+        for _ in cfg.embedding_size
+    ]
+    zoo.build_dlrm(model, dense, sparse, cfg)
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(batch, 4).astype(np.float32)] + [
+        rng.randint(0, 100, size=(batch, 1)).astype(np.int32)
+        for _ in cfg.embedding_size
+    ]
+    y = np.zeros((batch, 1), dtype=np.int32)
+    _fit_one(model, xs, y, batch)
+
+
+def test_dlrm_dot_interaction():
+    batch = 4
+    cfg = zoo.DLRMConfig(
+        sparse_feature_size=8,
+        embedding_size=[50],
+        mlp_bot=[4, 8],
+        mlp_top=[8, 2],
+        arch_interaction_op="dot",
+    )
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    dense = model.create_tensor([batch, 4])
+    sparse = [model.create_tensor([batch, 1], ff.DataType.DT_INT32)]
+    out = zoo.build_dlrm(model, dense, sparse, cfg)
+    assert out.dims[-1] == 2
+
+
+def test_xdl_builds():
+    batch = 8
+    cfg = zoo.XDLConfig(sparse_feature_size=8, embedding_size=[100, 100, 100])
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    sparse = [
+        model.create_tensor([batch, 1], ff.DataType.DT_INT32)
+        for _ in cfg.embedding_size
+    ]
+    out = zoo.build_xdl(model, sparse, cfg)
+    assert out.dims == (batch, 2)
+
+
+def test_candle_uno_builds():
+    batch = 4
+    cfg = zoo.CandleUnoConfig(
+        dense_layers=[32, 32], dense_feature_layers=[32, 32],
+    )
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    feats = {
+        "dose1": model.create_tensor([batch, 1]),
+        "cell.rnaseq": model.create_tensor([batch, 942]),
+        "drug1.descriptors": model.create_tensor([batch, 5270]),
+    }
+    out = zoo.build_candle_uno(model, feats, cfg)
+    assert out.dims == (batch, 1)
+
+
+def test_mlp_unify_trains():
+    batch = 8
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    in1 = model.create_tensor([batch, 16])
+    in2 = model.create_tensor([batch, 16])
+    zoo.build_mlp_unify(model, in1, in2, hidden_dims=(32, 32))
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(batch, 16).astype(np.float32) for _ in range(2)]
+    y = np.zeros((batch, 1), dtype=np.int32)
+    _fit_one(model, xs, y, batch)
+
+
+def test_transformer_builds():
+    cfg = zoo.TransformerConfig(hidden_size=32, embedding_size=32,
+                                num_heads=4, num_layers=2, sequence_length=8)
+    config = ff.FFConfig()
+    config.batch_size = 2
+    model = ff.FFModel(config)
+    inp = model.create_tensor([2, 8, 32])
+    out = zoo.build_transformer(model, inp, cfg)
+    assert out.dims == (2, 8, 2)
+
+
+def test_bert_encoder_trains():
+    batch, seq = 2, 8
+    cfg = zoo.TransformerConfig(hidden_size=32, num_heads=4, num_layers=1,
+                                vocab_size=100)
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    zoo.build_bert_encoder(model, tokens, cfg)
+    x = np.random.RandomState(0).randint(0, 100, size=(batch, seq)).astype(np.int32)
+    y = np.zeros((batch, seq, 1), dtype=np.int32)
+    _fit_one(model, [x], y, batch)
+
+
+def test_moe_encoder_trains():
+    cfg = zoo.MoeConfig(hidden_size=16, num_attention_heads=4,
+                        num_encoder_layers=1, num_exp=4, num_select=2)
+    batch, seq = 4, 8
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, seq, 16])
+    out = zoo.build_moe_encoder(model, inp, cfg)
+    assert out.dims == (batch, seq, 16)
+    pooled = model.mean(out, [1])
+    model.softmax(model.dense(pooled, 10))
+    x = np.random.RandomState(0).randn(batch, seq, 16).astype(np.float32)
+    y = np.zeros((batch, 1), dtype=np.int32)
+    _fit_one(model, [x], y, batch)
+
+
+def test_lstm_nmt_trains():
+    batch, seq = 2, 6
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    src = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    tgt = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    out = zoo.build_lstm_nmt(model, src, tgt, src_vocab=50, tgt_vocab=50,
+                             embed_dim=8, hidden_size=8, num_layers=1)
+    assert out.dims == (batch, seq, 50)
+    rng = np.random.RandomState(0)
+    xs = [rng.randint(0, 50, size=(batch, seq)).astype(np.int32) for _ in range(2)]
+    y = np.zeros((batch, seq, 1), dtype=np.int32)
+    _fit_one(model, xs, y, batch)
+
+
+def test_lstm_numerics_vs_reference():
+    """Scan LSTM matches a straightforward numpy step-by-step LSTM."""
+    import jax.numpy as jnp
+
+    batch, seq, dim, hidden = 2, 5, 3, 4
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, seq, dim])
+    out = model.lstm(inp, hidden)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.0),
+        loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+    )
+    x = np.random.RandomState(1).randn(batch, seq, dim).astype(np.float32)
+    pred = model.predict([x])
+
+    # extract weights and replay in numpy
+    lstm_op = next(op for op in model.graph.ops.values()
+                   if op.op_type == ff.OpType.LSTM)
+    w = model.params[lstm_op.name]
+    wx, wh, b = (np.asarray(w["kernel"]), np.asarray(w["recurrent_kernel"]),
+                 np.asarray(w["bias"]))
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((batch, hidden), np.float32)
+    c = np.zeros((batch, hidden), np.float32)
+    outs = []
+    for t in range(seq):
+        gates = x[:, t] @ wx + h @ wh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h)
+    ref = np.stack(outs, axis=1)
+    np.testing.assert_allclose(pred, ref, rtol=2e-4, atol=2e-4)
