@@ -6,9 +6,14 @@ examples/cpp/Transformer/transformer.cc:80-84: 12 layers, hidden 1024, seq 512,
   {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
 
 vs_baseline anchors to BASELINE.md's north star: v5e within 1.2x of A100 —
-the A100 per-GPU throughput for this config is estimated from its bf16 peak
-(312 TFLOP/s at 45% MFU) vs the measured chip; vs_baseline > 1.0 means we beat
-that anchor.
+the A100 per-GPU throughput for this config is estimated analytically from
+its bf16 peak (312 TFLOP/s) at 45% MFU over the model's 6*P*tokens
+train-step FLOPs; vs_baseline >= 1.0 means within-1.2x is met.
+
+Measurement notes (axon TPU tunnel): jax.block_until_ready returns
+immediately for tunneled buffers, and queuing many async steps can kill the
+backend — so each timed step fetches the scalar loss (device->host round
+trip ~0.1 ms, negligible vs the ~70 ms step).
 """
 from __future__ import annotations
 
@@ -26,9 +31,22 @@ LAYERS = int(os.environ.get("BENCH_LAYERS", 12))
 HEADS = int(os.environ.get("BENCH_HEADS", 16))
 VOCAB = int(os.environ.get("BENCH_VOCAB", 30522))
 
-# Estimated A100 samples/s for this config (3*2*P*tokens flops/sample at 45% MFU)
-A100_EST_SAMPLES_PER_SEC = 44.0
+A100_BF16_PEAK = 312e12
+A100_MFU = 0.45
 TARGET_RATIO = 1.0 / 1.2  # within 1.2x of A100 -> parity at vs_baseline == 1.0
+
+
+def train_step_flops() -> float:
+    """6 * matmul_params * tokens (fwd 2PT + bwd 4PT) + attention
+    score/context FLOPs, per sample. The vocab embedding is a gather (not a
+    matmul) on any hardware, so it is excluded — the same exclusion applies
+    to the A100 anchor, keeping the comparison fair."""
+    ffn = 2 * HIDDEN * 4 * HIDDEN
+    attn_proj = 4 * HIDDEN * HIDDEN
+    params = LAYERS * (ffn + attn_proj)
+    matmul = 6.0 * params * SEQ
+    attn_core = LAYERS * 6.0 * 2.0 * SEQ * SEQ * HIDDEN
+    return matmul + attn_core
 
 
 def main():
@@ -42,14 +60,12 @@ def main():
 
     model = ff.FFModel(config)
     tokens = model.create_tensor([BATCH, SEQ], ff.DataType.DT_INT32)
-    t = model.embedding(tokens, VOCAB, HIDDEN, ff.AggrMode.AGGR_MODE_NONE)
-    for i in range(LAYERS):
-        attn = model.multihead_attention(t, t, t, HIDDEN, HEADS, name=f"l{i}_attn")
-        t = model.layer_norm(model.add(t, attn), [-1], name=f"l{i}_ln1")
-        h = model.dense(t, HIDDEN * 4, ff.ActiMode.AC_MODE_GELU, name=f"l{i}_ff1")
-        h = model.dense(h, HIDDEN, name=f"l{i}_ff2")
-        t = model.layer_norm(model.add(t, h), [-1], name=f"l{i}_ln2")
-    t = model.dense(t, 2, name="cls")
+    from flexflow_tpu.models import TransformerConfig, build_bert_encoder
+
+    cfg = TransformerConfig(hidden_size=HIDDEN, embedding_size=HIDDEN,
+                            num_heads=HEADS, num_layers=LAYERS,
+                            sequence_length=SEQ, vocab_size=VOCAB)
+    build_bert_encoder(model, tokens, cfg)
     model.compile(
         optimizer=ff.AdamOptimizer(model, alpha=1e-4),
         loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
@@ -66,25 +82,34 @@ def main():
 
     label = jnp.asarray(y)
 
-    # warmup / compile
+    # warmup / compile; the rng key is hoisted — per-iter host PRNGKey
+    # creation costs a tunnel round trip
+    key = model._next_rng()
     params, opt_state, state = model.params, model.opt_state, model.state
     for _ in range(3):
         params, opt_state, state, mvals = step(
-            params, opt_state, state, inputs, label, model._next_rng()
+            params, opt_state, state, inputs, label, key
         )
-    jax.block_until_ready(mvals["loss"])
+    float(np.asarray(mvals["loss"]))  # force completion (see module docstring)
 
-    iters = 20
+    # sync every SYNC_EVERY steps: the scalar fetch forces completion of the
+    # whole chain (honest timing) while amortizing the tunnel round trip,
+    # and keeps the in-flight queue shallow (deep queues kill the backend)
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", 10))
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         params, opt_state, state, mvals = step(
-            params, opt_state, state, inputs, label, model._next_rng()
+            params, opt_state, state, inputs, label, key
         )
-    jax.block_until_ready(mvals["loss"])
+        if (i + 1) % sync_every == 0:
+            float(np.asarray(mvals["loss"]))
+    float(np.asarray(mvals["loss"]))
     dt = time.perf_counter() - t0
 
     samples_per_sec = iters * BATCH / dt
-    vs_baseline = samples_per_sec / (A100_EST_SAMPLES_PER_SEC * TARGET_RATIO)
+    a100_est = A100_BF16_PEAK * A100_MFU / train_step_flops()
+    vs_baseline = samples_per_sec / (a100_est * TARGET_RATIO)
     print(
         json.dumps(
             {
@@ -92,6 +117,9 @@ def main():
                 "value": round(samples_per_sec, 2),
                 "unit": "samples/sec/chip",
                 "vs_baseline": round(vs_baseline, 3),
+                "a100_anchor_samples_per_sec": round(a100_est, 1),
+                "mfu_vs_v5e_peak": round(
+                    samples_per_sec * train_step_flops() / 197e12, 3),
             }
         )
     )

@@ -1,0 +1,37 @@
+"""Callback usage (reference: examples/python/keras/callback.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.callbacks import EarlyStopping, LearningRateScheduler
+from flexflow_tpu.keras.layers import Activation, Dense
+from flexflow_tpu.keras.models import Sequential
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 20).astype(np.float32)
+    y = rng.randint(0, 4, size=(512, 1)).astype(np.int32)
+
+    model = Sequential()
+    model.add(Dense(64, activation="relu", input_shape=(20,)))
+    model.add(Dense(4))
+    model.add(Activation("softmax"))
+    model.compile(
+        optimizer=keras.optimizers.SGD(learning_rate=0.05),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    hist = model.fit(
+        x, y, epochs=10, batch_size=64,
+        callbacks=[
+            LearningRateScheduler(lambda epoch, lr: lr * 0.9),
+            EarlyStopping(monitor="loss", patience=3),
+        ],
+    )
+    print(f"[callback] epochs ran: {len(hist.history['loss'])}")
+
+
+if __name__ == "__main__":
+    main()

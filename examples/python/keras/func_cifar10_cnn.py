@@ -1,0 +1,46 @@
+"""Functional-API CIFAR-10 CNN (reference:
+examples/python/keras/func_cifar10_cnn.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (
+    Activation, Conv2D, Dense, Flatten, Input, MaxPooling2D,
+)
+from flexflow_tpu.keras.models import Model
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    if x_train.shape[-1] == 3:
+        x_train = np.transpose(x_train, (0, 3, 1, 2))
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    inp = Input(shape=(3, 32, 32))
+    t = Conv2D(32, (3, 3), padding="same", activation="relu")(inp)
+    t = Conv2D(32, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((2, 2))(t)
+    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(512, activation="relu")(t)
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+
+    model = Model(inputs=inp, outputs=out)
+    model.compile(
+        optimizer=keras.optimizers.Adam(learning_rate=1e-3),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    hist = model.fit(x_train, y_train, epochs=2, batch_size=64)
+    print(f"[func_cifar10_cnn] final accuracy "
+          f"{hist.history['accuracy'][-1] * 100:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

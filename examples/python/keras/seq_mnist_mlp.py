@@ -1,0 +1,37 @@
+"""Sequential MNIST MLP through the keras frontend (reference:
+examples/python/keras/seq_mnist_mlp.py — the python_interface_test.sh smoke
+model)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Activation, Dense
+from flexflow_tpu.keras.models import Sequential
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = Sequential()
+    model.add(Dense(512, activation="relu", input_shape=(784,)))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(10))
+    model.add(Activation("softmax"))
+    model.compile(
+        optimizer=keras.optimizers.SGD(learning_rate=0.01),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    hist = model.fit(x_train, y_train, epochs=4, batch_size=64)
+    acc = hist.history["accuracy"][-1] * 100
+    print(f"[seq_mnist_mlp] final accuracy {acc:.2f}%")
+    if acc < 90.0:
+        raise SystemExit("accuracy gate (90%) failed")
+
+
+if __name__ == "__main__":
+    main()

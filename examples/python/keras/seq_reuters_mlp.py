@@ -1,0 +1,37 @@
+"""Reuters topic-classification MLP (reference:
+examples/python/keras/seq_reuters_mlp.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import reuters
+from flexflow_tpu.keras.layers import Activation, Dense
+from flexflow_tpu.keras.models import Sequential
+from flexflow_tpu.keras.preprocessing.text import Tokenizer
+
+
+def main():
+    max_words = 1000
+    (x_train, y_train), _ = reuters.load_data(num_words=max_words)
+    tokenizer = Tokenizer(num_words=max_words)
+    x_train = tokenizer.sequences_to_matrix(x_train, mode="binary").astype(np.float32)
+    num_classes = int(np.max(y_train)) + 1
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = Sequential()
+    model.add(Dense(512, activation="relu", input_shape=(max_words,)))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+    model.compile(
+        optimizer=keras.optimizers.Adam(learning_rate=1e-3),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    hist = model.fit(x_train, y_train, epochs=4, batch_size=64)
+    print(f"[seq_reuters_mlp] final accuracy "
+          f"{hist.history['accuracy'][-1] * 100:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

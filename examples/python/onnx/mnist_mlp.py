@@ -1,0 +1,61 @@
+"""MNIST MLP imported from an ONNX file (reference:
+examples/python/onnx/mnist_mlp.py / mnist_mlp_pt.py — the .onnx is exported
+from torch, then replayed into FFModel)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.onnx.model import ONNXModel
+
+
+def export_onnx(path):
+    import torch
+    import torch.nn as nn
+
+    torch_model = nn.Sequential(
+        nn.Linear(784, 512), nn.ReLU(),
+        nn.Linear(512, 512), nn.ReLU(),
+        nn.Linear(512, 10),
+    )
+    torch.onnx.export(
+        torch_model, torch.randn(64, 784), path,
+        input_names=["input"], output_names=["output"], dynamo=False,
+    )
+    return path
+
+
+def main():
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        print("[onnx mnist_mlp] onnx not available; skipping")
+        return
+    path = export_onnx("/tmp/mnist_mlp.onnx")
+
+    config = ff.FFConfig()
+    config.batch_size = 64
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 784])
+    om = ONNXModel(path)
+    (out,) = om.apply(model, [inp])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    om.transfer_weights(model)
+
+    from flexflow_tpu.keras.datasets import mnist
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    hist = model.fit([x_train], y_train, batch_size=config.batch_size, epochs=4)
+    acc = hist[-1]["accuracy"] * 100
+    print(f"[onnx mnist_mlp] final accuracy {acc:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

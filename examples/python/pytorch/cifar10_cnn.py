@@ -1,0 +1,62 @@
+"""Torch CNN via fx import (reference: examples/python/pytorch/cifar10_cnn.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch import PyTorchModel
+
+
+def build_torch_cnn():
+    import torch
+    import torch.nn as nn
+
+    class CNN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+            self.conv2 = nn.Conv2d(32, 32, 3, padding=1)
+            self.conv3 = nn.Conv2d(32, 64, 3, padding=1)
+            self.conv4 = nn.Conv2d(64, 64, 3, padding=1)
+            self.pool = nn.MaxPool2d(2, 2)
+            self.fc1 = nn.Linear(64 * 8 * 8, 512)
+            self.fc2 = nn.Linear(512, 10)
+            self.relu = nn.ReLU()
+
+        def forward(self, x):
+            x = self.pool(self.relu(self.conv2(self.relu(self.conv1(x)))))
+            x = self.pool(self.relu(self.conv4(self.relu(self.conv3(x)))))
+            x = torch.flatten(x, 1)
+            return self.fc2(self.relu(self.fc1(x)))
+
+    return CNN()
+
+
+def main():
+    config = ff.FFConfig()
+    config.batch_size = 64
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, 32, 32])
+    pt = PyTorchModel(build_torch_cnn())
+    (out,) = pt.apply(model, [inp])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    pt.transfer_weights(model)  # start from the torch init
+
+    from flexflow_tpu.keras.datasets import cifar10
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    if x_train.shape[-1] == 3:
+        x_train = np.transpose(x_train, (0, 3, 1, 2))
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    hist = model.fit([x_train], y_train, batch_size=config.batch_size, epochs=2)
+    print(f"[pytorch cifar10_cnn] final accuracy {hist[-1]['accuracy']*100:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Train a torch.nn MLP through the torch.fx importer (reference:
+examples/python/pytorch/mnist_mlp.py + mnist_mlp_torch.py: the torch module
+is serialized with torch_to_flexflow and replayed into FFModel)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch import PyTorchModel, fx
+
+
+def build_torch_mlp():
+    import torch.nn as nn
+
+    return nn.Sequential(
+        nn.Linear(784, 512), nn.ReLU(),
+        nn.Linear(512, 512), nn.ReLU(),
+        nn.Linear(512, 10), nn.Softmax(dim=-1),
+    )
+
+
+def main():
+    torch_model = build_torch_mlp()
+    fx.torch_to_flexflow(torch_model, "/tmp/mnist_mlp.ff")
+
+    config = ff.FFConfig()
+    config.batch_size = 64
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 784])
+    pt = PyTorchModel("/tmp/mnist_mlp.ff")
+    (out,) = pt.apply(model, [inp])
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+
+    from flexflow_tpu.keras.datasets import mnist
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    hist = model.fit([x_train], y_train, batch_size=config.batch_size, epochs=4)
+    acc = hist[-1]["accuracy"] * 100
+    print(f"[pytorch mnist_mlp] final accuracy {acc:.2f}%")
+    if acc < 90.0:
+        raise SystemExit("accuracy gate (90%) failed")
+
+
+if __name__ == "__main__":
+    main()

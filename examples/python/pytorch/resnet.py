@@ -1,0 +1,40 @@
+"""torchvision ResNet-18 via fx import (reference:
+examples/python/pytorch/resnet.py, torch_vision.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch import PyTorchModel
+
+
+def main():
+    try:
+        from torchvision.models import resnet18
+        torch_model = resnet18(weights=None)
+    except ImportError:
+        print("[pytorch resnet] torchvision not available; skipping")
+        return
+
+    config = ff.FFConfig()
+    config.batch_size = 16
+    model = ff.FFModel(config)
+    inp = model.create_tensor([config.batch_size, 3, 224, 224])
+    pt = PyTorchModel(torch_model)
+    (out,) = pt.apply(model, [inp])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(config.batch_size * 2, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 1000, size=(config.batch_size * 2, 1)).astype(np.int32)
+    hist = model.fit([x], y, batch_size=config.batch_size, epochs=1)
+    print(f"[pytorch resnet18] 1 epoch done, loss finite: "
+          f"{np.isfinite(hist[-1].get('loss', np.nan))}")
+
+
+if __name__ == "__main__":
+    main()

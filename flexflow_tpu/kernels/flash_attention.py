@@ -1,0 +1,346 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd).
+
+Role in the framework: the reference wraps cuDNN's fused multi-head-attention
+kernels (src/ops/attention.cu); on TPU the softmax(QK^T)V core is the one op
+where manual fusion beats XLA *at long context* — materializing the L x L
+score matrix in HBM is what OOMs/slows the einsum path. This kernel keeps
+scores in VMEM with the standard online-softmax streaming:
+
+  forward:  grid (b, h, q_block, k_block), k innermost. The q block stays
+            resident (constant index map on the inner axis), k/v blocks
+            stream through VMEM; rowmax m / rowsum l / output accumulator
+            live in VMEM scratch that persists across the inner axis;
+            the final k step normalizes and emits O and logsumexp.
+  backward: recompute p = exp(qk - lse) per block pair (no stored probs).
+            dq kernel streams k blocks per resident q block; dkv kernel
+            streams q blocks per resident k/v block, using
+            D = rowsum(dO * O) for the softmax Jacobian.
+
+Nothing of size L x L ever touches HBM, and VMEM holds only
+O(block_q x block_k + block x d) — so sequence length is bounded by HBM
+(q/k/v themselves), not VMEM. All matmuls run on the MXU in f32
+(preferred_element_type), accumulators f32.
+
+Layout is [batch, heads, len, head_dim] internally; the public wrapper takes
+the attention op's [batch, len, heads, head_dim] and transposes.
+
+`interpret=True` runs the same kernels in the Pallas interpreter so CPU tests
+cover them (SURVEY.md §4's align-test strategy applied to kernels).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pad_to(x, multiple: int, axis: int):
+    size = x.shape[axis]
+    rem = size % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, multiple - rem)
+    return jnp.pad(x, pad)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, kv_len, q_offset):
+    """Grid = (b, h, n_q_blocks, n_k_blocks); the k axis is innermost."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    n_kb = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+    k = k_ref[0, 0]                                       # (bk, d)
+    v = v_ref[0, 0]
+    s = jnp.dot(q, k.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32)       # (bq, bk)
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < kv_len
+    if causal:
+        # cross-length semantics match tril(ones(lq, lk), lk - lq):
+        # query i attends keys j <= i + (lk - lq)
+        mask = mask & (k_pos <= q_pos + q_offset)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jnp.dot(
+        p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_kb - 1)
+    def _emit():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # lse carried as [.., lq, 1]: a lane dim of exactly 1 matches the
+        # array, satisfying the TPU (8k, 128)-or-full tiling rule
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [b, h, l, d] → (o [b,h,lq,d], lse [b,h,lq,1])."""
+    b, h, lq, d = q.shape
+    kv_len = k.shape[2]
+    block_q = min(block_q, max(lq, 1))
+    block_k = min(block_k, max(kv_len, 1))
+    qp = _pad_to(q, block_q, axis=2)
+    kp = _pad_to(k, block_k, axis=2)
+    vp = _pad_to(v, block_k, axis=2)
+    lq_pad, kv_pad = qp.shape[2], kp.shape[2]
+    grid = (b, h, lq_pad // block_q, kv_pad // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=kv_len, q_offset=kv_len - lq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, lq_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :, :lq], lse[:, :, :lq]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, kv_len,
+                   q_offset):
+    """Grid = (b, h, n_q_blocks, n_k_blocks); k innermost, dq accumulates in
+    scratch across the k axis."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    n_kb = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]        # (bq, 1)
+    delta = delta_ref[0, 0]    # (bq, 1)
+    kf = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0]
+
+    s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32) * scale
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < kv_len
+    if causal:
+        mask = mask & (k_pos <= q_pos + q_offset)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+    dp = jnp.dot(do, v.astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_acc[:] = dq_acc[:] + jnp.dot(ds, kf, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_kb - 1)
+    def _emit():
+        dq_ref[0, 0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, q_len, kv_len, q_offset):
+    """Grid = (b, h, n_k_blocks, n_q_blocks); q innermost, dk/dv accumulate
+    in scratch across the q axis."""
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    n_qb = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k = k_ref[0, 0].astype(jnp.float32)                 # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    qf = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
+    dof = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]        # (bq, 1)
+    delta = delta_ref[0, 0]    # (bq, 1)
+
+    s = jnp.dot(qf, k.T, preferred_element_type=jnp.float32) * scale
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = (k_pos < kv_len) & (q_pos < q_len)
+    if causal:
+        mask = mask & (k_pos <= q_pos + q_offset)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)          # (bq, bk)
+    dv_acc[:] = dv_acc[:] + jnp.dot(p.T, dof, preferred_element_type=jnp.float32)
+    dp = jnp.dot(dof, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_acc[:] = dk_acc[:] + jnp.dot(ds.T, qf, preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_qb - 1)
+    def _emit():
+        dk_ref[0, 0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    b, h, lq, d = q.shape
+    kv_len = k.shape[2]
+    block_q = min(block_q, max(lq, 1))
+    block_k = min(block_k, max(kv_len, 1))
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)                          # (b, h, lq, 1)
+
+    qp = _pad_to(q, block_q, axis=2)
+    dop = _pad_to(do, block_q, axis=2)
+    lsep = _pad_to(lse, block_q, axis=2)
+    deltap = _pad_to(delta, block_q, axis=2)
+    kp = _pad_to(k, block_k, axis=2)
+    vp = _pad_to(v, block_k, axis=2)
+    lq_pad, kv_pad = qp.shape[2], kp.shape[2]
+
+    # dq: q-block resident over the inner (k) axis
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
+    qvec_spec = pl.BlockSpec((1, 1, block_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=kv_len,
+                          q_offset=kv_len - lq),
+        grid=(b, h, lq_pad // block_q, kv_pad // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, qvec_spec, qvec_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, lq_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)[:, :, :lq]
+
+    # dkv: k/v-block resident over the inner (q) axis
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    k_spec2 = pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0))
+    qvec_spec2 = pl.BlockSpec((1, 1, block_q, 1), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_len=lq, kv_len=kv_len, q_offset=kv_len - lq),
+        grid=(b, h, kv_pad // block_k, lq_pad // block_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, qvec_spec2, qvec_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, kv_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, kv_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq, dk[:, :, :kv_len], dv[:, :, :kv_len]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_attention_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                              interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_bwd_rule(scale, causal, block_q, block_k, interpret,
+                              residuals, g):
+    return _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g)
+
+
+_flash_attention_bhld.defvjp(_flash_attention_fwd_rule,
+                             _flash_attention_bwd_rule)
+
+
+def flash_attention(q, k, v, *, scale: Optional[float] = None,
+                    causal: bool = False, block_q: int = 512,
+                    block_k: int = 512, interpret: bool = False):
+    """softmax(QK^T * scale)V with VMEM-tiled online softmax.
+
+    q: [batch, q_len, heads, d]; k, v: [batch, kv_len, heads, d] (the
+    attention op's layout). Returns [batch, q_len, heads, d].
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_attention_bhld(qt, kt, vt, float(scale), bool(causal),
+                              int(block_q), int(block_k), bool(interpret))
+    return jnp.swapaxes(o, 1, 2)
+
+
+def attention_reference(q, k, v, *, scale: Optional[float] = None,
+                        causal: bool = False):
+    """Naive jnp attention in the same [b, l, h, d] layout — the align-test
+    oracle for the kernel."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -320,6 +320,7 @@ class FFModel:
         add_zero_attn: bool = False,
         causal: bool = False,
         sequence_parallel: bool = False,
+        use_flash: Optional[bool] = None,
         kernel_initializer=None,
         name: str = "",
     ) -> Tensor:
@@ -337,6 +338,7 @@ class FFModel:
             add_zero_attn=add_zero_attn,
             causal=causal,
             sequence_parallel=sequence_parallel,
+            use_flash=use_flash,
             kernel_initializer=kernel_initializer,
         ).outputs[0]
 

@@ -50,14 +50,14 @@ def build_dlrm(model, dense_input, sparse_inputs, config: DLRMConfig = None):
     if cfg.arch_interaction_op == "cat":
         z = ff.concat(embedded + [x], axis=-1)
     elif cfg.arch_interaction_op == "dot":
-        feats = ff.concat(
-            [ff.reshape(e, [e.dims[0], 1, cfg.sparse_feature_size])
-             for e in embedded]
-            + [ff.reshape(x, [x.dims[0], 1, cfg.mlp_bot[-1]])],
-            axis=1,
-        )
-        inter = ff.batch_matmul(feats, ff.transpose(feats, [0, 2, 1]))
-        z = ff.concat([ff.flat(inter), x], axis=-1)
+        # distinct pairwise dot products only (the reference's
+        # interact_features emits the n(n-1)/2 off-diagonal entries)
+        feats = embedded + [x]
+        pairs = [
+            ff.reduce_sum(ff.multiply(feats[i], feats[j]), [-1], keepdims=True)
+            for i in range(len(feats)) for j in range(i)
+        ]
+        z = ff.concat(pairs + [x], axis=-1)
     else:
         raise ValueError(f"unknown interaction op {cfg.arch_interaction_op}")
 

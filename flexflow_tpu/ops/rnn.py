@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.op import Op, WeightSpec, register_op
-from ..ffconst import DataType, OpType
+from ..ffconst import OpType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
 from .common import matmul_dtype
 
