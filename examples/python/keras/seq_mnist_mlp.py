@@ -22,7 +22,7 @@ def main():
     model.add(Dense(10))
     model.add(Activation("softmax"))
     model.compile(
-        optimizer=keras.optimizers.SGD(learning_rate=0.01),
+        optimizer=keras.optimizers.Adam(learning_rate=1e-3),
         loss="sparse_categorical_crossentropy",
         metrics=["accuracy"],
     )

@@ -28,8 +28,9 @@ def export_onnx(path):
 def main():
     try:
         import onnx  # noqa: F401
-    except ImportError:
-        print("[onnx mnist_mlp] onnx not available; skipping")
+        import torch  # noqa: F401
+    except ImportError as e:
+        print(f"[onnx mnist_mlp] {e.name} not available; skipping")
         return
     path = export_onnx("/tmp/mnist_mlp.onnx")
 

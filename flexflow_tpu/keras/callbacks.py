@@ -63,13 +63,61 @@ class History(Callback):
 
 
 class LearningRateScheduler(Callback):
+    """schedule(epoch) or, tf.keras-style, schedule(epoch, lr)."""
+
     def __init__(self, schedule):
         super().__init__()
         self.schedule = schedule
 
     def on_epoch_begin(self, epoch, logs=None):
-        lr = self.schedule(epoch)
-        self.model.ffmodel.set_learning_rate(float(lr))
+        ffmodel = self.model.ffmodel
+        try:
+            current = float(ffmodel.opt_state["lr"])
+        except (KeyError, TypeError):
+            current = float(getattr(ffmodel.optimizer, "lr",
+                                    getattr(ffmodel.optimizer, "alpha", 0.0)))
+        try:
+            lr = self.schedule(epoch, current)
+        except TypeError:
+            lr = self.schedule(epoch)
+        ffmodel.set_learning_rate(float(lr))
+
+
+class EarlyStopping(Callback):
+    """Stop when the monitored metric stops improving (tf.keras semantics)."""
+
+    def __init__(self, monitor: str = "loss", min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "auto"):
+        super().__init__()
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        if mode == "auto":
+            mode = "max" if ("acc" in monitor) else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def on_train_begin(self, logs=None):
+        self.best = None
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        val = float(val)
+        if self.mode == "max":
+            improved = self.best is None or val > self.best + self.min_delta
+        else:
+            improved = self.best is None or val < self.best - self.min_delta
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
 
 
 class VerifyMetrics(Callback):

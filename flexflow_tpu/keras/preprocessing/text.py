@@ -50,3 +50,34 @@ class Tokenizer:
                 seq.append(i)
             out.append(seq)
         return out
+
+    def sequences_to_matrix(self, sequences, mode: str = "binary"):
+        """Vectorize integer sequences to a (n, num_words) matrix
+        (binary/count/freq/tfidf as in tf.keras)."""
+        import math
+
+        import numpy as np
+
+        if not self.num_words:
+            raise ValueError("sequences_to_matrix needs num_words")
+        n = len(sequences)
+        m = np.zeros((n, self.num_words), dtype=np.float64)
+        doc_freq: Counter = Counter()
+        if mode == "tfidf":  # precompute df once, not per (row, index)
+            for seq in sequences:
+                doc_freq.update({i for i in seq if 0 <= i < self.num_words})
+        for row, seq in enumerate(sequences):
+            counts = Counter(i for i in seq if 0 <= i < self.num_words)
+            for idx, c in counts.items():
+                if mode == "binary":
+                    m[row, idx] = 1.0
+                elif mode == "count":
+                    m[row, idx] = c
+                elif mode == "freq":
+                    m[row, idx] = c / max(len(seq), 1)
+                elif mode == "tfidf":
+                    tf = 1.0 + math.log(c)
+                    m[row, idx] = tf * math.log(1.0 + n / (1.0 + doc_freq[idx]))
+                else:
+                    raise ValueError(f"unknown mode {mode}")
+        return m

@@ -730,11 +730,18 @@ class FFModel:
                 "fit needs at least one full batch"
             )
         history = []
+        timer = None
+        if self.config.profiling:
+            from .runtime.profiling import IterationTimer
+
+            timer = IterationTimer(bs, print_freq=max(1, self.config.print_freq))
         for epoch in range(epochs):
             self.reset_metrics()
             t0 = time.time()
             mvals: Dict[str, float] = {}
             for it in range(n // bs):
+                if timer is not None:
+                    timer.tick()
                 lo, hi = it * bs, (it + 1) * bs
                 inputs = self._prep_inputs(x, lo, hi)
                 label = self.executor.shard_batch(

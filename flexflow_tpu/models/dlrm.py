@@ -50,13 +50,23 @@ def build_dlrm(model, dense_input, sparse_inputs, config: DLRMConfig = None):
     if cfg.arch_interaction_op == "cat":
         z = ff.concat(embedded + [x], axis=-1)
     elif cfg.arch_interaction_op == "dot":
-        # distinct pairwise dot products only (the reference's
-        # interact_features emits the n(n-1)/2 off-diagonal entries)
-        feats = embedded + [x]
-        pairs = [
-            ff.reduce_sum(ff.multiply(feats[i], feats[j]), [-1], keepdims=True)
-            for i in range(len(feats)) for j in range(i)
-        ]
+        # Capability extension: the reference's interact_features only
+        # implements "cat" (dlrm.cc:88-99, dot is a TODO/assert). DLRM-paper
+        # dot semantics: the n(n-1)/2 distinct pairwise dot products. One
+        # batched Gram matmul, then O(n) slices pick the strict lower
+        # triangle (not n^2 flatten — no duplicate/self-dot features).
+        d = cfg.sparse_feature_size
+        assert cfg.mlp_bot[-1] == d, "dot interaction needs bot-MLP out == sparse_feature_size"
+        feats = ff.concat(
+            [ff.reshape(t, [t.dims[0], 1, d]) for t in embedded + [x]], axis=1)
+        gram = ff.batch_matmul(feats, ff.transpose(feats, [0, 2, 1]))  # (b,n,n)
+        n_feat = len(embedded) + 1
+        rows = ff.split(gram, [1] * n_feat, axis=1)  # row i: (b, 1, n)
+        pairs = []
+        for i in range(1, n_feat):
+            row = ff.reshape(rows[i], [gram.dims[0], n_feat])
+            left = ff.split(row, [i, n_feat - i], axis=1)[0]  # cols 0..i-1
+            pairs.append(left)
         z = ff.concat(pairs + [x], axis=-1)
     else:
         raise ValueError(f"unknown interaction op {cfg.arch_interaction_op}")

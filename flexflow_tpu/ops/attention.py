@@ -43,6 +43,19 @@ class MultiHeadAttentionOp(Op):
                 "sequence_parallel attention does not support attention-prob "
                 "dropout; set dropout=0 or sequence_parallel=False"
             )
+        if self.params.get("use_flash"):
+            kdim = self.params.get("kdim")
+            vdim = self.params.get("vdim")
+            if self.params.get("dropout", 0.0) > 0:
+                raise ValueError(
+                    "use_flash=True attention has no attention-prob dropout; "
+                    "set dropout=0 or drop the explicit use_flash"
+                )
+            if kdim != vdim:
+                raise ValueError(
+                    "use_flash=True requires kdim == vdim (one head_dim in "
+                    "the kernel); got kdim={} vdim={}".format(kdim, vdim)
+                )
         return [q.dims[:-1] + (embed,)], [q.dtype]
 
     def weight_specs(self) -> List[WeightSpec]:

@@ -91,7 +91,11 @@ class Executor:
                 ws = w._weight_spec
                 if ws.name in weights:
                     weights[ws.name] = ctx.constrain(weights[ws.name], w)
-            outs = op.lower(ctx, ins, weights)
+            # named scope tags every HLO op with its PCG op, so device
+            # profiles (jax.profiler / xprof) group by framework op — the
+            # role of the reference's per-task profiling printfs
+            with jax.named_scope(f"{op.op_type.value}:{op.name}"):
+                outs = op.lower(ctx, ins, weights)
             for t, v in zip(op.outputs, outs):
                 ctx.values[t.guid] = ctx.constrain(v, t)
         new_state = {

@@ -1,0 +1,106 @@
+"""Profiling / tracing utilities.
+
+reference parity (SURVEY.md §5 "Tracing / profiling"):
+ 1. Legion iteration tracing (begin_trace/end_trace, flexflow_cffi.py:2097)
+    → free under jax: the whole train step is one compiled XLA program.
+ 2. `--profiling` per-op kernel timing printfs (operator.h:271)
+    → `profile_ops()` compiles and times each op's forward in isolation;
+      per-iteration wall timing lives in FFModel.fit (config.profiling).
+ 3. Simulator profiling machinery (cudaEvents, model.cu:38-75)
+    → search/simulator.py OpCostCache (shared by `profile_ops`).
+ 4. Legion -lg:prof / logger categories
+    → `trace()` wraps jax.profiler for TensorBoard/xprof device traces;
+      every op is tagged via jax.named_scope in the executor.
+ 5. dot exports (--export-strategy-…) → core/graph.py to_dot/export_dot.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace viewable in TensorBoard/xprof
+    (the -lg:prof equivalent)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_ops(model, warmup: int = 2, repeats: int = 5) -> List[Dict]:
+    """Per-op forward timing on the current backend, sorted slowest-first.
+
+    Uses the same on-device measurement the cost simulator profiles with
+    (search/simulator.py OpCostCache ≙ Simulator::measure_operator_cost,
+    simulator.cc:489): each op is compiled as a micro-function over its
+    actual input shapes.
+    """
+    from ..ffconst import OpType
+    from ..search.simulator import OpCostCache, OpStrategy
+
+    cache = OpCostCache(model.config, warmup=warmup, repeats=repeats)
+    rows = []
+    strategy = OpStrategy(dp=1, tp=1)
+    for op in model.graph.topo_order():
+        if op.op_type in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP):
+            continue
+        try:
+            us = cache.measure_forward_us(op, strategy)
+        except Exception as e:  # unmeasurable ops (e.g. multi-output glue)
+            rows.append({"op": op.name, "type": op.op_type.value,
+                         "forward_us": float("nan"),
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        rows.append({
+            "op": op.name,
+            "type": op.op_type.value,
+            "forward_us": us,
+            "gflops": op.flops() / 1e9,
+            "eff_tflops": (op.flops() / (us * 1e-6)) / 1e12 if us > 0 else 0.0,
+        })
+    rows.sort(key=lambda r: -(r["forward_us"] if np.isfinite(r["forward_us"]) else -1))
+    return rows
+
+
+def print_profile(rows: List[Dict], top: Optional[int] = 20) -> None:
+    print(f"{'op':<28} {'type':<20} {'fwd us':>10} {'eff TFLOP/s':>12}")
+    for r in rows[:top]:
+        if "error" in r:
+            print(f"{r['op']:<28} {r['type']:<20} {'--':>10}  {r['error']}")
+        else:
+            print(f"{r['op']:<28} {r['type']:<20} {r['forward_us']:>10.1f} "
+                  f"{r['eff_tflops']:>12.2f}")
+
+
+class IterationTimer:
+    """Rolling per-iteration wall timing (reference: per-`--print-freq`
+    samples/s prints in the examples)."""
+
+    def __init__(self, batch_size: int, print_freq: int = 10,
+                 sink=print):
+        self.batch_size = batch_size
+        self.print_freq = print_freq
+        self.sink = sink
+        self._t0 = None
+        self._count = 0
+
+    def tick(self):
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+            return
+        self._count += 1
+        if self._count % self.print_freq == 0:
+            dt = now - self._t0
+            self.sink(
+                f"iter {self._count}: {self.print_freq * self.batch_size / dt:.1f}"
+                f" samples/s ({dt / self.print_freq * 1e3:.1f} ms/iter)")
+            self._t0 = now

@@ -1,0 +1,20 @@
+"""Inference serving subsystem.
+
+Role parity: the reference ships a self-contained Triton backend prototype
+(triton/ — 16.7k LoC: its own model/instance/operator/strategy layers over
+Legion, triton/README.md:1-8). Here serving is a thin TPU-native layer over
+the same FFModel/PCG core instead of a parallel re-implementation:
+
+- InferenceModel (serving/model.py): compile-once inference executor with
+  static-shape batch buckets (XLA needs static shapes; Triton gets the same
+  effect from its max_batch_size config).
+- DynamicBatcher (serving/batcher.py): request queue + micro-batch
+  coalescing, the role of Triton's dynamic_batching scheduler.
+- InferenceServer (serving/server.py): multi-model registry + optional
+  stdlib HTTP JSON endpoint (the Triton server role).
+"""
+from .model import InferenceModel
+from .batcher import DynamicBatcher
+from .server import InferenceServer
+
+__all__ = ["InferenceModel", "DynamicBatcher", "InferenceServer"]

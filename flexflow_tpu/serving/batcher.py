@@ -1,0 +1,108 @@
+"""Dynamic request batching.
+
+reference parity: Triton's dynamic_batching scheduler (the triton/ prototype
+relies on Triton core for this; here it is part of the framework). Requests
+enqueue individually; a background thread coalesces whatever is queued (up
+to max_batch_size, waiting at most max_delay_ms for stragglers) into one
+device batch — amortizing dispatch overhead exactly the way GPU serving
+amortizes kernel launches.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Dict, List
+
+import numpy as np
+
+
+class DynamicBatcher:
+    def __init__(self, inference_model, max_batch_size: int = 64,
+                 max_delay_ms: float = 2.0):
+        self.model = inference_model
+        self.max_batch_size = max_batch_size
+        self.max_delay = max_delay_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: threading.Thread = None
+        self._running = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._queue.put(None)  # wake the loop
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client API ----------------------------------------------------
+    def submit(self, inputs: Dict[str, np.ndarray]) -> Future:
+        """inputs: one request (leading dim = that request's batch, usually
+        1). Returns a Future resolving to the output rows for this request."""
+        fut: Future = Future()
+        self._queue.put((inputs, fut))
+        return fut
+
+    def infer(self, inputs: Dict[str, np.ndarray], timeout=None) -> np.ndarray:
+        return self.submit(inputs).result(timeout)
+
+    # -- batching loop -------------------------------------------------
+    def _loop(self):
+        while self._running:
+            item = self._queue.get()
+            if item is None:
+                continue
+            batch: List = [item]
+            rows = next(iter(item[0].values())).shape[0]
+            deadline = _now() + self.max_delay
+            while rows < self.max_batch_size:
+                remaining = deadline - _now()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    continue
+                batch.append(nxt)
+                rows += next(iter(nxt[0].values())).shape[0]
+            self._run_batch(batch)
+
+    def _run_batch(self, batch):
+        names = self.model.input_names
+        counts = [next(iter(req.values())).shape[0] for req, _ in batch]
+        try:
+            merged = {
+                name: np.concatenate([np.asarray(req[name]) for req, _ in batch])
+                for name in names
+            }
+            out = self.model.predict(merged)
+            lo = 0
+            for (_, fut), n in zip(batch, counts):
+                fut.set_result(out[lo:lo + n])
+                lo += n
+        except Exception as e:  # propagate to every waiter
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def _now() -> float:
+    import time
+
+    return time.monotonic()

@@ -1,0 +1,81 @@
+"""Compile-once inference executor with batch buckets.
+
+reference parity: triton/src/model.cc + instance.cc (a loaded model plus
+per-device execution instances). TPU-native: one jitted forward per batch
+bucket; requests are padded up to the nearest bucket so every server-side
+shape is static and XLA-compiled exactly once.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ffconst import CompMode
+
+
+class InferenceModel:
+    """Wraps a compiled FFModel for serving.
+
+    The model must already be compiled (any comp_mode); serving always runs
+    the inference-mode lowering (dropout off, batchnorm in eval mode).
+    """
+
+    def __init__(self, model, batch_buckets: Sequence[int] = (1, 4, 16, 64)):
+        self.model = model
+        self.buckets = sorted(set(int(b) for b in batch_buckets))
+        self._fns: Dict[int, object] = {}  # bucket -> jitted forward
+
+    @property
+    def input_names(self) -> List[str]:
+        return [op.name for op in self.model.input_ops]
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _forward_fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+
+        model = self.model
+        executor = model.executor
+        final_guid = model.final_tensor.guid
+        state = model.state
+
+        def forward(params, inputs):
+            values, _, _ = executor.forward_values(
+                params, state, inputs, None, CompMode.COMP_MODE_INFERENCE
+            )
+            return values[final_guid]
+
+        fn = jax.jit(forward)
+        self._fns[bucket] = fn
+        return fn
+
+    def predict(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
+        """inputs: name -> array whose leading dim is the request batch.
+        Returns the final tensor's values for the un-padded batch."""
+        names = self.input_names
+        missing = [n for n in names if n not in inputs]
+        if missing:
+            raise KeyError(f"missing inputs {missing}; expected {names}")
+        n = next(iter(inputs.values())).shape[0]
+        bucket = self._bucket_for(n)
+        chunks = []
+        for lo in range(0, n, bucket):
+            hi = min(lo + bucket, n)
+            padded = {}
+            for name in names:
+                arr = np.asarray(inputs[name])[lo:hi]
+                if hi - lo < bucket:
+                    pad = [(0, bucket - (hi - lo))] + [(0, 0)] * (arr.ndim - 1)
+                    arr = np.pad(arr, pad)
+                padded[name] = arr
+            out = self._forward_fn(bucket)(self.model.params, padded)
+            chunks.append(np.asarray(out)[: hi - lo])
+        return np.concatenate(chunks, axis=0)

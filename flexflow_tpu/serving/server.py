@@ -1,0 +1,114 @@
+"""Multi-model inference server.
+
+reference parity: the Triton server role (triton/README.md:1-8) — a registry
+of named models with per-model batching policy, plus an optional stdlib HTTP
+JSON endpoint (POST /v2/models/<name>/infer with {"inputs": {name: nested
+lists}}) mirroring the KServe-style API Triton speaks. No external web
+framework; serving stays dependency-free.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .batcher import DynamicBatcher
+from .model import InferenceModel
+
+
+class InferenceServer:
+    def __init__(self):
+        self._models: Dict[str, DynamicBatcher] = {}
+
+    def register(self, name: str, model, max_batch_size: int = 64,
+                 max_delay_ms: float = 2.0,
+                 batch_buckets=(1, 4, 16, 64)) -> None:
+        """model: a compiled FFModel."""
+        im = InferenceModel(model, batch_buckets=batch_buckets)
+        batcher = DynamicBatcher(im, max_batch_size=max_batch_size,
+                                 max_delay_ms=max_delay_ms)
+        batcher.start()
+        self._models[name] = batcher
+
+    def unregister(self, name: str) -> None:
+        b = self._models.pop(name, None)
+        if b:
+            b.stop()
+
+    def models(self):
+        return sorted(self._models)
+
+    def infer(self, name: str, inputs: Dict[str, np.ndarray],
+              timeout: Optional[float] = None) -> np.ndarray:
+        if name not in self._models:
+            raise KeyError(f"model {name!r} not registered; have {self.models()}")
+        return self._models[name].infer(inputs, timeout=timeout)
+
+    def shutdown(self):
+        for name in list(self._models):
+            self.unregister(name)
+
+    # -- optional HTTP endpoint ---------------------------------------
+    def serve_http(self, host: str = "127.0.0.1", port: int = 8000,
+                   block: bool = False):
+        """Start a KServe-flavored HTTP endpoint. Returns the http.server
+        instance (call .shutdown() to stop) unless block=True."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v2/models":
+                    self._reply(200, {"models": server_ref.models()})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                # v2/models/<name>/infer
+                if len(parts) != 4 or parts[0] != "v2" or parts[3] != "infer":
+                    self._reply(404, {"error": "not found"})
+                    return
+                name = parts[2]
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    inputs = {
+                        k: np.asarray(v, dtype=np.float32)
+                        if not _is_int_list(v) else np.asarray(v, dtype=np.int32)
+                        for k, v in req.get("inputs", {}).items()
+                    }
+                    out = server_ref.infer(name, inputs, timeout=30.0)
+                    self._reply(200, {"outputs": np.asarray(out).tolist()})
+                except KeyError as e:
+                    self._reply(404, {"error": str(e)})
+                except Exception as e:
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        if block:
+            httpd.serve_forever()
+            return httpd
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd
+
+
+def _is_int_list(v) -> bool:
+    while isinstance(v, (list, tuple)) and v:
+        v = v[0]
+    return isinstance(v, int)

@@ -1,0 +1,34 @@
+"""BERT benchmark (reference: scripts/osdi22ae/bert.sh — batch 8, budget 30,
+12 layers hidden 1024 seq 512; scaled by env for smaller hosts)."""
+import os
+
+import numpy as np
+
+from common import compare, _ROOT  # noqa: F401
+
+LAYERS = int(os.environ.get("BERT_LAYERS", 12))
+HIDDEN = int(os.environ.get("BERT_HIDDEN", 1024))
+HEADS = int(os.environ.get("BERT_HEADS", 16))
+SEQ = int(os.environ.get("BERT_SEQ", 512))
+BATCH = int(os.environ.get("BERT_BATCH", 8))
+
+
+def build(model, config):
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    cfg = TransformerConfig(hidden_size=HIDDEN, num_heads=HEADS,
+                            num_layers=LAYERS, sequence_length=SEQ)
+    inp = model.create_tensor([config.batch_size, SEQ, HIDDEN])
+    build_transformer(model, inp, cfg)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, SEQ, HIDDEN).astype(np.float32)
+    y = rng.randint(0, 2, size=(n, SEQ, 1)).astype(np.int32)
+    return [x], y
+
+
+if __name__ == "__main__":
+    compare("bert", build, make_data, batch_size=BATCH, budget=30)

@@ -1,0 +1,34 @@
+"""CANDLE Uno benchmark (reference: scripts/osdi22ae/candle_uno.sh)."""
+import os
+
+import numpy as np
+
+from common import run_once
+
+BATCH = int(os.environ.get("CANDLE_BATCH", 32))
+FEATURE_DIMS = {"dose1": 1, "cell.rnaseq": 942, "drug1.descriptors": 5270}
+
+
+def build(model, config):
+    from flexflow_tpu.models import CandleUnoConfig, build_candle_uno
+
+    cfg = CandleUnoConfig(dense_layers=[1024] * 3,
+                          dense_feature_layers=[1024] * 3)
+    feats = {n: model.create_tensor([config.batch_size, d])
+             for n, d in FEATURE_DIMS.items()}
+    out = build_candle_uno(model, feats, cfg)
+    # benchmark harness drives a classification loss; put a 2-way softmax
+    # head over the regression trunk
+    model.softmax(model.dense(out, 2, name="bench_head"))
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(n, d).astype(np.float32) for d in FEATURE_DIMS.values()]
+    return xs, rng.randint(0, 1, size=(n, 1)).astype(np.int32)
+
+
+if __name__ == "__main__":
+    from common import compare
+
+    compare("candle_uno", build, make_data, batch_size=BATCH, budget=20)

@@ -1,0 +1,69 @@
+"""Shared runner for the OSDI'22-style artifact benchmarks (reference:
+scripts/osdi22ae/*.sh — each runs a model twice, Unity search vs
+--only-data-parallel, and compares throughput).
+
+On hardware with one chip the multi-device strategies execute on a virtual
+device mesh (host-platform device count), which still validates the searched
+strategy end-to-end; throughput ratios on a real v5e slice are the headline
+numbers.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# honor JAX_PLATFORMS=cpu even though the TPU plugin registers at interpreter
+# start (see tests/conftest.py): force it through jax.config before any
+# backend client exists
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def run_once(build_fn, make_data, batch_size: int, num_devices: int,
+             search_budget: int, only_data_parallel: bool, iters: int = 8):
+    """build_fn(model) -> None builds the net; make_data(n) -> (inputs, label)."""
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig.from_command_line()
+    config.batch_size = batch_size
+    config.num_devices = num_devices
+    config.search_budget = search_budget
+    config.only_data_parallel = only_data_parallel
+
+    model = ff.FFModel(config)
+    build_fn(model, config)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    inputs, label = make_data(batch_size)
+    model.set_iteration_batch(inputs, label)
+    # warmup (compile)
+    model.forward(); model.zero_gradients(); model.backward(); model.update()
+    t0 = time.time()
+    for _ in range(iters):
+        model.forward(); model.zero_gradients(); model.backward(); model.update()
+    model.get_perf_metrics()  # forces completion
+    dt = time.time() - t0
+    return iters * batch_size / dt
+
+
+def compare(name: str, build_fn, make_data, batch_size: int = 64,
+            num_devices: int = None, budget: int = 20):
+    n_dev = num_devices or int(os.environ.get("BENCH_DEVICES", 8))
+    dp = run_once(build_fn, make_data, batch_size, n_dev, 0, True)
+    unity = run_once(build_fn, make_data, batch_size, n_dev, budget, False)
+    print(f"[{name}] data-parallel: {dp:.1f} samples/s | "
+          f"unity(budget={budget}): {unity:.1f} samples/s | "
+          f"ratio {unity / dp:.2f}x")
+    return dp, unity

@@ -1,0 +1,33 @@
+"""DLRM benchmark (reference: scripts/osdi22ae/dlrm.sh — budget 20)."""
+import os
+
+import numpy as np
+
+from common import compare
+
+BATCH = int(os.environ.get("DLRM_BATCH", 64))
+EMB = int(os.environ.get("DLRM_EMBEDDINGS", 4))
+VOCAB = int(os.environ.get("DLRM_VOCAB", 100000))
+
+
+def build(model, config):
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+    cfg = DLRMConfig(embedding_size=[VOCAB] * EMB,
+                     mlp_top=[64 * (EMB + 1), 64, 2])
+    dense = model.create_tensor([config.batch_size, cfg.mlp_bot[0]])
+    sparse = [model.create_tensor([config.batch_size, 1], ff.DataType.DT_INT32)
+              for _ in range(EMB)]
+    build_dlrm(model, dense, sparse, cfg)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(n, 4).astype(np.float32)] + [
+        rng.randint(0, VOCAB, size=(n, 1)).astype(np.int32) for _ in range(EMB)]
+    return xs, rng.randint(0, 2, size=(n, 1)).astype(np.int32)
+
+
+if __name__ == "__main__":
+    compare("dlrm", build, make_data, batch_size=BATCH, budget=20)

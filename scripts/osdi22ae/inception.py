@@ -1,0 +1,26 @@
+"""InceptionV3 benchmark (reference: scripts/osdi22ae/inception.sh)."""
+import os
+
+import numpy as np
+
+from common import compare
+
+BATCH = int(os.environ.get("INCEPTION_BATCH", 16))
+SIZE = int(os.environ.get("INCEPTION_SIZE", 299))
+
+
+def build(model, config):
+    from flexflow_tpu.models import build_inception_v3
+
+    inp = model.create_tensor([config.batch_size, 3, SIZE, SIZE])
+    build_inception_v3(model, inp)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    return ([rng.randn(n, 3, SIZE, SIZE).astype(np.float32)],
+            rng.randint(0, 10, size=(n, 1)).astype(np.int32))
+
+
+if __name__ == "__main__":
+    compare("inception", build, make_data, batch_size=BATCH, budget=20)

@@ -1,0 +1,27 @@
+"""MLP benchmark (reference: scripts/osdi22ae/mlp.sh — MLP_Unify, budget 20)."""
+import os
+
+import numpy as np
+
+from common import compare
+
+DIM = int(os.environ.get("MLP_DIM", 4096))
+BATCH = int(os.environ.get("MLP_BATCH", 64))
+
+
+def build(model, config):
+    from flexflow_tpu.models import build_mlp_unify
+
+    in1 = model.create_tensor([config.batch_size, DIM])
+    in2 = model.create_tensor([config.batch_size, DIM])
+    build_mlp_unify(model, in1, in2, hidden_dims=(DIM, DIM, DIM, 10))
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    return ([rng.randn(n, DIM).astype(np.float32) for _ in range(2)],
+            rng.randint(0, 10, size=(n, 1)).astype(np.int32))
+
+
+if __name__ == "__main__":
+    compare("mlp", build, make_data, batch_size=BATCH, budget=20)

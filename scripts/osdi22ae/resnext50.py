@@ -1,0 +1,26 @@
+"""ResNeXt-50 benchmark (reference: scripts/osdi22ae/resnext-50.sh)."""
+import os
+
+import numpy as np
+
+from common import compare
+
+BATCH = int(os.environ.get("RESNEXT_BATCH", 16))
+SIZE = int(os.environ.get("RESNEXT_SIZE", 224))
+
+
+def build(model, config):
+    from flexflow_tpu.models import build_resnext50
+
+    inp = model.create_tensor([config.batch_size, 3, SIZE, SIZE])
+    build_resnext50(model, inp, num_classes=1000)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    return ([rng.randn(n, 3, SIZE, SIZE).astype(np.float32)],
+            rng.randint(0, 1000, size=(n, 1)).astype(np.int32))
+
+
+if __name__ == "__main__":
+    compare("resnext50", build, make_data, batch_size=BATCH, budget=20)

@@ -1,0 +1,31 @@
+"""XDL benchmark (reference: scripts/osdi22ae/xdl.sh)."""
+import os
+
+import numpy as np
+
+from common import compare
+
+BATCH = int(os.environ.get("XDL_BATCH", 64))
+EMB = int(os.environ.get("XDL_EMBEDDINGS", 4))
+VOCAB = int(os.environ.get("XDL_VOCAB", 100000))
+
+
+def build(model, config):
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import XDLConfig, build_xdl
+
+    cfg = XDLConfig(embedding_size=[VOCAB] * EMB)
+    sparse = [model.create_tensor([config.batch_size, 1], ff.DataType.DT_INT32)
+              for _ in range(EMB)]
+    build_xdl(model, sparse, cfg)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    xs = [rng.randint(0, VOCAB, size=(n, 1)).astype(np.int32)
+          for _ in range(EMB)]
+    return xs, rng.randint(0, 2, size=(n, 1)).astype(np.int32)
+
+
+if __name__ == "__main__":
+    compare("xdl", build, make_data, batch_size=BATCH, budget=20)

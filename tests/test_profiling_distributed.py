@@ -1,0 +1,98 @@
+"""Profiling utilities and multi-host helpers (single-process CPU mesh)."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.runtime import distributed, profiling
+
+
+def small_model():
+    config = ff.FFConfig()
+    config.batch_size = 8
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 16])
+    t = model.dense(inp, 32, ff.ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 4)
+    model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    return model
+
+
+def test_profile_ops_returns_timings():
+    model = small_model()
+    rows = profiling.profile_ops(model, warmup=1, repeats=2)
+    types = {r["type"] for r in rows}
+    assert "linear" in types and "softmax" in types
+    measured = [r for r in rows if "error" not in r]
+    assert measured and all(r["forward_us"] > 0 for r in measured)
+    profiling.print_profile(rows, top=5)
+
+
+def test_profiling_flag_prints_iteration_rate(capsys):
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.profiling = True
+    config.print_freq = 2
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 16])
+    model.softmax(model.dense(inp, 4))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    yl = np.zeros((64, 1), dtype=np.int32)
+    model.fit(x, yl, epochs=1)
+    out = capsys.readouterr().out
+    assert "samples/s" in out and "ms/iter" in out
+
+
+def test_host_info_single_process():
+    info = distributed.host_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] >= 1
+    assert not distributed.is_multi_host()
+
+
+def test_pod_mesh_axes():
+    mesh = distributed.pod_mesh({"data": 4, "model": 2})
+    assert mesh.axis_names == ("data", "model")
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_callbacks_early_stopping_and_lr_schedule():
+    from flexflow_tpu.keras.callbacks import EarlyStopping, LearningRateScheduler
+
+    class FakeFF:
+        def __init__(self):
+            self.opt_state = {"lr": 0.1}
+            self.set_calls = []
+
+        def set_learning_rate(self, lr):
+            self.set_calls.append(lr)
+            self.opt_state["lr"] = lr
+
+    class FakeModel:
+        def __init__(self):
+            self.ffmodel = FakeFF()
+            self.stop_training = False
+
+    m = FakeModel()
+    sched = LearningRateScheduler(lambda epoch, lr: lr * 0.5)
+    sched.set_model(m)
+    sched.on_epoch_begin(0)
+    sched.on_epoch_begin(1)
+    assert m.ffmodel.set_calls == [0.05, 0.025]
+
+    es = EarlyStopping(monitor="loss", patience=2)
+    es.set_model(m)
+    es.on_train_begin()
+    for epoch, loss in enumerate([1.0, 0.5, 0.6, 0.55]):
+        es.on_epoch_end(epoch, {"loss": loss})
+    assert m.stop_training  # no improvement for 2 epochs after 0.5

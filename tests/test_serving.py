@@ -1,0 +1,101 @@
+"""Serving subsystem tests (reference analog: triton/src/test gtests +
+triton/qa/L0_e2e — the only mocked-infra tests in the reference; here the
+real executor runs on the CPU mesh)."""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.serving import DynamicBatcher, InferenceModel, InferenceServer
+
+
+def make_model(dim=8, classes=4):
+    config = ff.FFConfig()
+    config.batch_size = 16
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([16, dim])
+    t = model.dense(inp, 16, ff.ActiMode.AC_MODE_RELU)
+    t = model.dense(t, classes)
+    model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.0),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    return model
+
+
+def test_inference_model_pads_to_buckets():
+    model = make_model()
+    im = InferenceModel(model, batch_buckets=(2, 8))
+    name = im.input_names[0]
+    x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+    out = im.predict({name: x})
+    assert out.shape == (5, 4)
+    # padding must not change the un-padded rows: compare against bucket=8 direct
+    out8 = im.predict({name: np.concatenate([x, np.zeros((3, 8), np.float32)])})
+    np.testing.assert_allclose(out, out8[:5], rtol=1e-5, atol=1e-6)
+    # batches over the largest bucket are chunked
+    x16 = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+    assert im.predict({name: x16}).shape == (16, 4)
+
+
+def test_dynamic_batcher_matches_direct_and_coalesces():
+    model = make_model()
+    im = InferenceModel(model, batch_buckets=(1, 4, 16))
+    name = im.input_names[0]
+    rng = np.random.RandomState(0)
+    reqs = [rng.randn(1, 8).astype(np.float32) for _ in range(12)]
+    with DynamicBatcher(im, max_batch_size=16, max_delay_ms=20.0) as b:
+        futs = [b.submit({name: r}) for r in reqs]
+        outs = [f.result(timeout=30) for f in futs]
+    direct = im.predict({name: np.concatenate(reqs)})
+    got = np.concatenate(outs)
+    np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-6)
+
+
+def test_batcher_propagates_errors():
+    model = make_model()
+    im = InferenceModel(model, batch_buckets=(4,))
+    with DynamicBatcher(im, max_delay_ms=1.0) as b:
+        fut = b.submit({"not_an_input": np.zeros((1, 8), np.float32)})
+        with pytest.raises(KeyError):
+            fut.result(timeout=30)
+
+
+def test_server_http_roundtrip():
+    model = make_model()
+    server = InferenceServer()
+    server.register("mlp", model, batch_buckets=(1, 4))
+    name = InferenceModel(model).input_names[0]
+    httpd = server.serve_http(port=0)  # ephemeral port
+    try:
+        port = httpd.server_address[1]
+        # model listing
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/v2/models") as r:
+            assert json.load(r)["models"] == ["mlp"]
+        # inference
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        req = json.dumps({"inputs": {name: x.tolist()}}).encode()
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v2/models/mlp/infer", data=req,
+                headers={"Content-Type": "application/json"}),
+        ) as r:
+            out = np.asarray(json.load(r)["outputs"], np.float32)
+        direct = InferenceModel(model).predict({name: x})
+        np.testing.assert_allclose(out, direct, rtol=1e-4, atol=1e-5)
+        # unknown model -> 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v2/models/nope/infer",
+                    data=b"{}"),
+            )
+    finally:
+        httpd.shutdown()
+        server.shutdown()
