@@ -184,12 +184,16 @@ class Graph:
         return pre, post
 
     # -- dot export (reference: --export-strategy-computation-graph-file) --
-    def to_dot(self, include_costs: bool = False, costs: Optional[Dict[int, float]] = None) -> str:
+    def to_dot(self, include_costs: bool = False,
+               costs: Optional[Dict[int, float]] = None,
+               labels: Optional[Dict[int, str]] = None) -> str:
         lines = ["digraph PCG {", "  rankdir=TB;"]
         for g, op in sorted(self.ops.items()):
             label = f"{op.name}\\n{op.op_type.value}"
             if op.machine_view:
                 label += f"\\n{op.machine_view}"
+            if labels and g in labels:
+                label += f"\\n{labels[g]}"
             if include_costs and costs and g in costs:
                 label += f"\\ncost={costs[g]:.3g}"
             lines.append(f'  n{g} [label="{label}", shape=box];')

@@ -586,6 +586,33 @@ class FFModel:
 
         if self.config.export_strategy_computation_graph_file:
             self.graph.export_dot(self.config.export_strategy_computation_graph_file)
+        if self.config.export_strategy_task_graph_file:
+            self._export_task_graph(self.config.export_strategy_task_graph_file)
+
+    def _export_task_graph(self, path: str) -> None:
+        """Cost-annotated task-graph dot (reference: --export-strategy-
+        task-graph-file + --include-costs-dot-graph, simulator.cc's task
+        graph dump). Nodes carry the chosen strategy and the cost model's
+        fwd/bwd estimates."""
+        from .search.machine_model import make_machine_model
+        from .search.simulator import CostModel, OpStrategy
+
+        n_dev = self.config.total_devices
+        cost = CostModel(make_machine_model(self.config, n_dev), self.config)
+        strategies = getattr(self, "_op_strategies", None) or {}
+        costs = {}
+        labels = {}
+        for op in self.graph.ops.values():
+            s = strategies.get(op.guid, OpStrategy(dp=1, tp=1))
+            try:
+                f = cost.forward_time_us(op, s)
+                b = cost.backward_time_us(op, s)
+            except Exception:
+                f = b = 0.0
+            costs[op.guid] = f + b
+            labels[op.guid] = f"dp={s.dp},tp={s.tp} fwd={f:.1f}us bwd={b:.1f}us"
+        self.graph.export_dot(path, include_costs=True, costs=costs,
+                              labels=labels)
 
     def _label_dims(self):
         from .ffconst import LossType as LT

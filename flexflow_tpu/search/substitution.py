@@ -184,20 +184,39 @@ ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
 }
 
 
-def load_rule_set(json_path: Optional[str]) -> Dict[str, Callable]:
-    """JSON rule file support (reference: --substitution-json,
-    substitution_loader.h): {"rules": ["fuse_linear_activation", ...]}.
-    Unknown names are ignored with a warning; no file -> all rules."""
+def load_rule_spec(json_path: Optional[str]):
+    """Parse a --substitution-json file ONCE. Returns (spec, is_taso):
+    is_taso is True for TASO rule files — a RuleCollection dict
+    ({"_t": "RuleCollection", "rule": [...]}, the reference's
+    substitutions/graph_subst_3_v2.json) or a bare top-level list of rule
+    dicts. False for the simple name-list format
+    {"rules": ["fuse_linear_activation", ...]} and for no file."""
     if not json_path:
-        return dict(ALL_RULES)
+        return None, False
     with open(json_path) as f:
         spec = json.load(f)
+    if isinstance(spec, dict) and "rule" in spec:
+        return spec, True
+    if isinstance(spec, list):
+        return spec, True
+    return spec, False
+
+
+def rule_set_from_spec(spec, is_taso: bool) -> Dict[str, Callable]:
+    """Select algebraic rules for a parsed spec. TASO files parameterize the
+    *parallelization* search (see unity._load_tp_candidates), so the
+    algebraic rule set stays complete for them; a name list selects among
+    the built-in rules."""
+    if spec is None or is_taso:
+        return dict(ALL_RULES)
     names = spec.get("rules", [])
-    out = {}
-    for n in names:
-        if n in ALL_RULES:
-            out[n] = ALL_RULES[n]
-    return out
+    return {n: ALL_RULES[n] for n in names if n in ALL_RULES}
+
+
+def load_rule_set(json_path: Optional[str]) -> Dict[str, Callable]:
+    """One-shot convenience wrapper (reference: --substitution-json)."""
+    spec, is_taso = load_rule_spec(json_path)
+    return rule_set_from_spec(spec, is_taso)
 
 
 def apply_substitutions(graph: Graph, rules: Optional[Dict[str, Callable]] = None,

@@ -97,6 +97,33 @@ class GraphSearchHelper:
         self.sim = simulator or Simulator(machine, config)
         self._memo: Dict[Tuple, Dict[int, OpStrategy]] = {}
         self.log: List[str] = []
+        # per-op-type TP degrees a loaded TASO rule file proposes
+        # (None = no file: every type may TP at any mesh degree)
+        self._tp_menu = None
+
+    def _load_tp_candidates(self, spec) -> None:
+        """Distill a parsed TASO RuleCollection (--substitution-json) into
+        per-op-type candidate TP degrees (reference role: create_xfers
+        building GraphXfers from loaded rules, substitution.h:119-121)."""
+        from .substitution_loader import (
+            rules_from_spec,
+            summarize,
+            tp_candidates_from_rules,
+        )
+
+        rules = rules_from_spec(spec)
+        self._tp_menu = {t: set(degs)
+                         for t, degs in tp_candidates_from_rules(rules).items()}
+        self.log.append(
+            f"substitution rules: {summarize(rules)}; TP proposed for "
+            + str({t.value: sorted(d) for t, d in self._tp_menu.items()}))
+
+    def _tp_ok(self, op: Op, s: OpStrategy) -> bool:
+        """A strategy honors the rule file iff it is TP-free or the file
+        proposes that op type at that degree."""
+        if s.tp <= 1 or self._tp_menu is None:
+            return True
+        return s.tp in self._tp_menu.get(op.op_type, ())
 
     # -- sequence split (reference: generic_sequence_optimize, memoized) --
     def _segments(self) -> List[List[Op]]:
@@ -121,7 +148,8 @@ class GraphSearchHelper:
         # seed: per-op greedy best in isolation
         strategies = {}
         for op in seg:
-            menu = valid_strategies(op, dp, tp, batch, self.config)
+            menu = [s for s in valid_strategies(op, dp, tp, batch, self.config)
+                    if self._tp_ok(op, s)]
             strategies[op.guid] = min(
                 menu, key=lambda s: self.sim.op_step_time_us(op, s)
             )
@@ -144,6 +172,8 @@ class GraphSearchHelper:
                 for s in valid_strategies(op, dp, tp, batch, self.config):
                     if s == cur[op.guid]:
                         continue
+                    if not self._tp_ok(op, s):
+                        continue  # rule file doesn't propose this TP
                     cand = dict(cur)
                     cand[op.guid] = s
                     c = self._segment_cost(seg_graph, cand)
@@ -157,13 +187,14 @@ class GraphSearchHelper:
     # -- top level --------------------------------------------------------
     def graph_optimize(self, batch_size: int, n_devices: int,
                        memory_budget_bytes: Optional[float] = None) -> SearchResult:
-        from .substitution import apply_substitutions, load_rule_set
+        from .substitution import load_rule_spec, rule_set_from_spec, apply_substitutions
 
-        applied = apply_substitutions(
-            self.graph, load_rule_set(self.config.substitution_json_path)
-        )
+        spec, is_taso = load_rule_spec(self.config.substitution_json_path)
+        applied = apply_substitutions(self.graph, rule_set_from_spec(spec, is_taso))
         if applied:
             self.log.append(f"substitutions: {applied}")
+        if is_taso:
+            self._load_tp_candidates(spec)
 
         candidates: List[SearchResult] = []
         pairs = _divisor_pairs(n_devices)
@@ -222,15 +253,21 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     Dispatches to the native C++ core (src/ffcore, loaded via ctypes) when
     available; the pure-Python path below is the fallback and the behavioral
     spec. A custom simulator (e.g. measured costs) forces the Python path."""
-    if simulator is None and getattr(config, "use_native_search", True):
+    from .substitution import (
+        apply_substitutions,
+        load_rule_spec,
+        rule_set_from_spec,
+    )
+
+    spec, is_taso = load_rule_spec(config.substitution_json_path)
+    # a TASO rule file constrains the TP menu — only the Python search
+    # implements that, so it owns the rule-file path
+    if (simulator is None and not is_taso
+            and getattr(config, "use_native_search", True)):
         from .. import native
 
         if native.available():
-            from .substitution import apply_substitutions, load_rule_set
-
-            applied = apply_substitutions(
-                graph, load_rule_set(config.substitution_json_path)
-            )
+            applied = apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
             result = native.optimize_strategy(
                 graph, config, machine, batch_size, n_devices
             )

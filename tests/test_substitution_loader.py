@@ -1,0 +1,138 @@
+"""Substitution rule-file loader (reference analog:
+tests/unit/test_substitution_loader.cc + the --substitution-json path)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.search.substitution_loader import (
+    load_substitution_file,
+    summarize,
+    tp_candidates_from_rules,
+)
+
+RULES_PATH = os.path.join(os.path.dirname(__file__), "..", "substitutions",
+                          "tp_rules.json")
+REFERENCE_RULES = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+def test_load_shipped_rules():
+    rules = load_substitution_file(RULES_PATH)
+    assert len(rules) == 5
+    s = summarize(rules)
+    assert s["supported"] == 5 and s["unsupported"] == 0
+    byname = {r.name: r for r in rules}
+    lin = byname["partition_linear_combine_d2"]
+    assert lin.src_ops[0].op_type == OpType.LINEAR
+    assert lin.dst_ops[0].op_type == OpType.REPLICATE
+    assert lin.dst_ops[0].parallel_degree == 2
+    assert lin.dst_ops[2].op_type == OpType.COMBINE
+    assert lin.mapped_outputs[0].dst_op_id == 2
+
+
+def test_tp_candidates_distillation():
+    rules = load_substitution_file(RULES_PATH)
+    cands = tp_candidates_from_rules(rules)
+    assert cands[OpType.LINEAR] == [2, 4]
+    assert cands[OpType.MULTIHEAD_ATTENTION] == [2]
+    assert cands[OpType.EMBEDDING] == [2]
+
+
+def test_malformed_rule_rejected(tmp_path):
+    bad = {
+        "_t": "RuleCollection",
+        "rule": [{
+            "name": "dangling",
+            "srcOp": [{"type": "OP_LINEAR",
+                       "input": [{"opId": 7, "tsId": 0}], "para": []}],
+            "dstOp": [],
+            "mappedOutput": [],
+        }],
+    }
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="outside the pattern"):
+        load_substitution_file(str(p))
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_RULES),
+                    reason="reference rule file not mounted")
+def test_load_reference_rule_file():
+    """The loader parses the reference's full 640-rule OSDI artifact file."""
+    rules = load_substitution_file(REFERENCE_RULES)
+    assert len(rules) == 640
+    s = summarize(rules)
+    assert s["supported"] == len(rules)  # all op types in the file are mapped
+    cands = tp_candidates_from_rules(rules)
+    assert OpType.LINEAR in cands
+
+
+def test_search_consumes_rule_file():
+    """compile() with --substitution-json restricts TP to rule-proposed op
+    types and logs the rule summary."""
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.num_devices = 8
+    config.search_budget = 10
+    config.substitution_json_path = RULES_PATH
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 32])
+    t = model.dense(inp, 64, ff.ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    x = np.random.RandomState(0).randn(16, 32).astype(np.int32).astype(np.float32)
+    y = np.zeros((16, 1), dtype=np.int32)
+    hist = model.fit(x, y, epochs=1)
+    assert len(hist) == 1
+    # the rule-file path must run the Python search (native core can't honor
+    # the TP menu) and log the rule summary
+    log = "\n".join(model.search_result.log)
+    assert "substitution rules:" in log
+    # chosen strategies honor the per-type degree menu (LINEAR: 2/4 only)
+    from flexflow_tpu.search.substitution_loader import (
+        load_substitution_file, tp_candidates_from_rules)
+    menu = tp_candidates_from_rules(load_substitution_file(RULES_PATH))
+    for guid, s in model.search_result.strategies.items():
+        op = model.graph.ops.get(guid)
+        if op is None or s.tp <= 1:
+            continue
+        assert op.op_type in menu and s.tp in menu[op.op_type], (
+            op.op_type, s.tp)
+
+
+def test_rule_file_restricts_tp_degrees():
+    """An op type outside the rule file never gets TP; degrees outside the
+    menu are rejected."""
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.num_devices = 8
+    config.search_budget = 5
+    config.substitution_json_path = RULES_PATH
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 4, 16])
+    t = model.batch_matmul(inp, model.transpose(inp, [0, 2, 1]))
+    t = model.flat(t)
+    t = model.dense(t, 8)
+    model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    result = model.search_result
+    for guid, s in result.strategies.items():
+        op = model.graph.ops.get(guid)
+        if op is None:
+            continue
+        if op.op_type == OpType.BATCHMATMUL:  # not in the rule file
+            assert s.tp == 1, s
+        if s.tp > 1 and op.op_type == OpType.LINEAR:
+            assert s.tp in (2, 4), s
