@@ -29,6 +29,7 @@ from .runtime.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .runtime.losses import Loss
 from .runtime.metrics import Metrics, PerfMetrics
 from .runtime.dataloader import SingleDataLoader
+from .runtime.recompile import RecompileState
 from .runtime.initializers import (
     ConstantInitializer,
     GlorotUniformInitializer,

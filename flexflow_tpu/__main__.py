@@ -1,0 +1,112 @@
+"""Command-line driver: `python -m flexflow_tpu [--model NAME] [flags...]`.
+
+reference parity: the C++ example drivers (src/runtime/cpp_driver.cc +
+examples/cpp/*/) and the `flexflow_python` interpreter — one entry point
+that takes the standard FFConfig flags, builds a named model from the zoo on
+synthetic data, and trains it under the chosen strategy. Run a user script
+instead with `python -m flexflow_tpu script.py [flags...]` (the script sees
+the remaining argv, like flexflow_python).
+"""
+from __future__ import annotations
+
+import runpy
+import sys
+import time
+
+import numpy as np
+
+
+def _synthetic(model_name, config):
+    """Build (model, inputs, label) for a zoo model on synthetic data."""
+    import flexflow_tpu as ff
+    from flexflow_tpu import models as zoo
+
+    b = config.batch_size
+    rng = np.random.RandomState(0)
+    m = ff.FFModel(config)
+
+    if model_name in ("alexnet", "resnet50", "inception", "resnext50",
+                      "cifar10_cnn", "mnist_cnn"):
+        size = {"alexnet": 229, "resnet50": 224, "inception": 299,
+                "resnext50": 224, "cifar10_cnn": 32, "mnist_cnn": 28}[model_name]
+        chans = 1 if model_name == "mnist_cnn" else 3
+        build = {"alexnet": zoo.build_alexnet, "resnet50": zoo.build_resnet50,
+                 "inception": zoo.build_inception_v3,
+                 "resnext50": zoo.build_resnext50,
+                 "cifar10_cnn": zoo.build_cifar10_cnn,
+                 "mnist_cnn": zoo.build_mnist_cnn}[model_name]
+        inp = m.create_tensor([b, chans, size, size])
+        build(m, inp)
+        x = rng.randn(b * 4, chans, size, size).astype(np.float32)
+        y = rng.randint(0, 10, size=(b * 4, 1)).astype(np.int32)
+        return m, [x], y
+    if model_name == "mnist_mlp":
+        inp = m.create_tensor([b, 784])
+        zoo.build_mnist_mlp(m, inp)
+        x = rng.randn(b * 4, 784).astype(np.float32)
+        y = rng.randint(0, 10, size=(b * 4, 1)).astype(np.int32)
+        return m, [x], y
+    if model_name == "bert":
+        cfg = zoo.TransformerConfig()
+        tokens = m.create_tensor([b, cfg.sequence_length],
+                                 ff.DataType.DT_INT32)
+        zoo.build_bert_encoder(m, tokens, cfg)
+        x = rng.randint(0, cfg.vocab_size,
+                        size=(b * 2, cfg.sequence_length)).astype(np.int32)
+        y = rng.randint(0, 2, size=(b * 2, cfg.sequence_length, 1)).astype(np.int32)
+        return m, [x], y
+    if model_name == "mlp_unify":
+        in1 = m.create_tensor([b, 4096])
+        in2 = m.create_tensor([b, 4096])
+        zoo.build_mlp_unify(m, in1, in2)
+        xs = [rng.randn(b * 4, 4096).astype(np.float32) for _ in range(2)]
+        y = rng.randint(0, 10, size=(b * 4, 1)).astype(np.int32)
+        return m, xs, y
+    raise SystemExit(
+        f"unknown --model {model_name!r}; choices: alexnet resnet50 inception "
+        f"resnext50 cifar10_cnn mnist_cnn mnist_mlp bert mlp_unify, or pass a "
+        f"script path")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # script mode: first non-flag arg ending in .py
+    script = next((a for a in argv if a.endswith(".py")), None)
+    if script is not None:
+        sys.argv = [script] + [a for a in argv if a != script]
+        runpy.run_path(script, run_name="__main__")
+        return
+
+    model_name = "mnist_mlp"
+    if "--model" in argv:
+        i = argv.index("--model")
+        model_name = argv[i + 1]
+        del argv[i:i + 2]
+
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig()
+    rest = config.parse_args(argv)
+    if rest:
+        print(f"warning: unrecognized flags {rest}", file=sys.stderr)
+
+    model, xs, y = _synthetic(model_name, config)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    n = y.shape[0]
+    t0 = time.time()
+    hist = model.fit(xs, y, batch_size=config.batch_size,
+                     epochs=config.epochs)
+    dt = time.time() - t0
+    thru = n * config.epochs / max(dt, 1e-9)
+    print(f"[{model_name}] {config.epochs} epoch(s) in {dt:.2f}s "
+          f"({thru:.1f} samples/s), final metrics: "
+          + ", ".join(f"{k}={v:.4f}" for k, v in hist[-1].items()
+                      if isinstance(v, float)))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,8 @@ class FFModel:
         self.perf_metrics = PerfMetrics()
         self._rng_seed = self.config.seed
         self._step_count = 0
+        self._name_counts: Dict[OpType, int] = {}
+        self._used_names: set = set()
         self._compiled = False
         self._recompile_state = None
         self._op_strategies = None
@@ -92,6 +94,20 @@ class FFModel:
 
     def _add_op(self, op_type: OpType, inputs: Sequence[Tensor], name: str = "", **params) -> Op:
         cls = OP_REGISTRY[op_type]
+        if not name:
+            # per-model sequential names: two identical model definitions get
+            # identical op names regardless of process history, so
+            # checkpoints key params stably (guids stay globally unique);
+            # skip names the user already took — params are keyed by name
+            while True:
+                idx = self._name_counts.get(op_type, 0)
+                self._name_counts[op_type] = idx + 1
+                name = f"{op_type.value}_{idx}"
+                if name not in self._used_names:
+                    break
+        elif name in self._used_names:
+            raise ValueError(f"duplicate op name {name!r}")
+        self._used_names.add(name)
         op = cls(self, list(inputs), name=name, **params)
         self.ops.append(op)
         for t in op.outputs:
@@ -558,7 +574,6 @@ class FFModel:
         self.params, self.state = self.executor.init_params(
             jax.random.PRNGKey(self.config.seed)
         )
-        input_names = [op.name for op in self.input_ops]
         reg_fn = None
         if self.weight_regularizers:
             regs = list(self.weight_regularizers)
@@ -570,16 +585,9 @@ class FFModel:
                         total = total + fn(params[op_name][w_name])
                 return total
 
-        self._train_step = self.executor.build_train_step(
-            self.optimizer, self.loss.fn, self.metrics, self.final_tensor, input_names,
-            reg_fn=reg_fn,
-        )
-        self._eval_step = self.executor.build_eval_step(
-            self.loss.fn, self.metrics, self.final_tensor
-        )
-        self._forward_fn = self.executor.build_forward(self.final_tensor, comp_mode)
-        self._infer_fn = self.executor.build_forward(self.final_tensor)
-        self._grad_step = self.executor.build_grad_step(self.loss.fn, self.final_tensor)
+        self._reg_fn = reg_fn
+        self._comp_mode_used = comp_mode
+        self._build_step_functions()
         self.opt_state = self.optimizer.init_state(self.params)
         self._compiled = True
         self._manual: Dict[str, Any] = {}
@@ -588,6 +596,28 @@ class FFModel:
             self.graph.export_dot(self.config.export_strategy_computation_graph_file)
         if self.config.export_strategy_task_graph_file:
             self._export_task_graph(self.config.export_strategy_task_graph_file)
+
+    def _build_step_functions(self) -> None:
+        input_names = [op.name for op in self.input_ops]
+        self._train_step = self.executor.build_train_step(
+            self.optimizer, self.loss.fn, self.metrics, self.final_tensor,
+            input_names, reg_fn=self._reg_fn,
+        )
+        self._eval_step = self.executor.build_eval_step(
+            self.loss.fn, self.metrics, self.final_tensor
+        )
+        self._forward_fn = self.executor.build_forward(
+            self.final_tensor, self._comp_mode_used)
+        self._infer_fn = self.executor.build_forward(self.final_tensor)
+        self._grad_step = self.executor.build_grad_step(
+            self.loss.fn, self.final_tensor)
+
+    def invalidate_compiled_steps(self) -> None:
+        """Rebuild the jitted step functions after a graph/op-param mutation
+        (the RecompileState alter path — reference: the 'recompile' in
+        recompile_on_condition). The next step re-traces with the new
+        dataflow; weights and optimizer state carry over."""
+        self._build_step_functions()
 
     def _export_task_graph(self, path: str) -> None:
         """Cost-annotated task-graph dot (reference: --export-strategy-
@@ -882,6 +912,10 @@ class FFModel:
 
     # -- recompile hook (reference: RecompileState, recompile.h:28-44) ----
     def recompile_on_condition(self, recompile_state) -> None:
+        """Install a per-iteration trigger/alter hook (reference:
+        FFModel::recompile_on_condition, model.cc:2422 — used by the MoE
+        example to swap to cached expert assignments mid-training,
+        moe.cc:64-98)."""
         self._recompile_state = recompile_state
 
     def get_cache_score(self, cache_tensor: Tensor) -> float:

@@ -1,0 +1,68 @@
+"""Dynamic recompilation / elasticity hook.
+
+reference parity: RecompileState (include/flexflow/recompile.h:28-44) — a
+user trigger function checked every training iteration plus an alter
+function that mutates the model when the trigger fires
+(FFModel::recompile_on_condition, model.cc:2422). The reference's user is
+the MoE example: once expert assignments stabilize it flips Cache ops to
+serve cached assignments (examples/cpp/mixture_of_experts/moe.cc:64-98).
+
+TPU-native note: "recompilation" is literal here — if alter() changes op
+params or graph structure, the next step triggers a fresh XLA trace/compile
+of the train step; weights and optimizer state carry over by op name.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    """trigger(model) -> bool; alter(model) -> None (called once when the
+    trigger first fires, like the reference's one-shot recompilations)."""
+
+    def __init__(self, trigger: Callable, alter: Callable,
+                 one_shot: bool = True):
+        self.trigger = trigger
+        self.alter = alter
+        self.one_shot = one_shot
+        self.fired = 0
+
+    def step(self, model) -> bool:
+        """Called by fit() each iteration (model.py fit loop)."""
+        if self.one_shot and self.fired:
+            return False
+        if not self.trigger(model):
+            return False
+        self.fired += 1
+        self.alter(model)
+        return True
+
+
+def moe_cache_trigger(threshold: float = 0.05, warmup_steps: int = 10):
+    """Reference moe_trigger analog (moe.cc:65-81): fire once every Cache
+    op's staleness score (mean L1 divergence between the current and cached
+    expert-assignment tensors) drops below the threshold."""
+    def trigger(model) -> bool:
+        if model._step_count < warmup_steps:
+            return False
+        from ..ffconst import OpType
+
+        scores = [
+            float(model.state[op.name]["score"])
+            for op in model.graph.ops.values()
+            if op.op_type == OpType.CACHE and op.name in model.state
+        ]
+        return bool(scores) and max(scores) < threshold
+
+    return trigger
+
+
+def moe_cache_alter(model) -> None:
+    """Reference moe_alter analog (moe.cc:83-98): switch Cache ops to serve
+    the cached tensor; the next step recompiles with the new dataflow."""
+    from ..ffconst import OpType
+
+    for op in model.graph.ops.values():
+        if op.op_type == OpType.CACHE:
+            op.params["use_cached"] = True
+    model.invalidate_compiled_steps()

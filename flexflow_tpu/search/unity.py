@@ -186,10 +186,14 @@ class GraphSearchHelper:
 
     # -- top level --------------------------------------------------------
     def graph_optimize(self, batch_size: int, n_devices: int,
-                       memory_budget_bytes: Optional[float] = None) -> SearchResult:
+                       memory_budget_bytes: Optional[float] = None,
+                       rule_spec=None) -> SearchResult:
         from .substitution import load_rule_spec, rule_set_from_spec, apply_substitutions
 
-        spec, is_taso = load_rule_spec(self.config.substitution_json_path)
+        # rule_spec: optional pre-parsed (spec, is_taso) from unity_optimize,
+        # avoiding a second read of a potentially multi-MB rule file
+        spec, is_taso = (rule_spec if rule_spec is not None
+                         else load_rule_spec(self.config.substitution_json_path))
         applied = apply_substitutions(self.graph, rule_set_from_spec(spec, is_taso))
         if applied:
             self.log.append(f"substitutions: {applied}")
@@ -278,7 +282,8 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     budget = None
     if config.memory_search:
         budget = config.memory_budget_mb * 1e6
-    return helper.graph_optimize(batch_size, n_devices, budget)
+    return helper.graph_optimize(batch_size, n_devices, budget,
+                                 rule_spec=(spec, is_taso))
 
 
 def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:

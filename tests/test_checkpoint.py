@@ -1,0 +1,49 @@
+"""Checkpoint/resume (new capability — the reference has no model
+checkpointing, SURVEY.md §5)."""
+import os
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.runtime.checkpoint import restore_checkpoint, save_checkpoint
+
+
+def build(seed_data):
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 16])
+    t = model.dense(inp, 32, ff.ActiMode.AC_MODE_RELU)
+    model.softmax(model.dense(t, 4))
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-2),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    return model
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(64, 1)).astype(np.int32)
+
+    m1 = build(0)
+    m1.fit(x, y, epochs=2)
+    pred1 = m1.predict(x)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, m1, step=7)
+
+    m2 = build(1)
+    # fresh model differs before restore
+    assert not np.allclose(m2.predict(x), pred1)
+    step = restore_checkpoint(path, m2)
+    assert step == 7
+    np.testing.assert_allclose(m2.predict(x), pred1, rtol=1e-5, atol=1e-6)
+
+    # resume training from the restored optimizer state: loss keeps falling
+    h1 = m1.fit(x, y, epochs=1)
+    h2 = m2.fit(x, y, epochs=1)
+    np.testing.assert_allclose(h1[-1]["sparse_cce"], h2[-1]["sparse_cce"],
+                               rtol=1e-4, atol=1e-5)

@@ -356,3 +356,155 @@ def test_moe_dispatch_roundtrip():
     gates = np.asarray(values[topk_v.guid])
     ref = x * gates.sum(1, keepdims=True)
     assert_close(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d_gradients_vs_torch():
+    """conv fwd + input/kernel grads vs torch (reference: conv_2d bwd kernels)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+
+    config = ff.FFConfig()
+    config.batch_size = 2
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    tin = model.create_tensor([2, 3, 8, 8])
+    out = model.conv2d(tin, 4, 3, 3, 1, 1, 1, 1, name="c")
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    model.params["c"]["kernel"] = jnp.asarray(w)
+    model.params["c"]["bias"] = jnp.asarray(b)
+
+    def loss(params, xv):
+        values, _, _ = model.executor.forward_values(
+            params, model.state, {"input_0": xv}, None,
+            CompMode.COMP_MODE_TRAINING)
+        return jnp.sum(values[out.guid] ** 2)
+
+    gw = jax.grad(loss)(model.params, jnp.asarray(x))
+    gx = jax.grad(loss, argnums=1)(model.params, jnp.asarray(x))
+
+    xt = torch.tensor(x, requires_grad=True)
+    conv = torch.nn.Conv2d(3, 4, 3, padding=1)
+    with torch.no_grad():
+        conv.weight.copy_(torch.tensor(w))
+        conv.bias.copy_(torch.tensor(b))
+    lt = (conv(xt) ** 2).sum()
+    lt.backward()
+    assert_close(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+    assert_close(gw["c"]["kernel"], conv.weight.grad.numpy(), rtol=1e-3, atol=1e-3)
+    assert_close(gw["c"]["bias"], conv.bias.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_grouped_conv_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 4, 6, 6).astype(np.float32)
+    w = rng.randn(8, 2, 3, 3).astype(np.float32)  # groups=2: in 4/2=2
+
+    out, model = run_forward(
+        lambda m, t: m.conv2d(t[0], 8, 3, 3, 1, 1, 1, 1, groups=2,
+                              use_bias=False, name="gc"),
+        [x], weights={"gc": {"kernel": w}},
+    )
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), padding=1, groups=2).numpy()
+    assert_close(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_layernorm_gradients_vs_torch():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 10).astype(np.float32)
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    tin = model.create_tensor([4, 10])
+    out = model.layer_norm(tin, [-1], name="ln")
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+
+    def loss(xv):
+        values, _, _ = model.executor.forward_values(
+            model.params, model.state, {"input_0": xv}, None,
+            CompMode.COMP_MODE_TRAINING)
+        return jnp.sum(jnp.sin(values[out.guid]))
+
+    gx = jax.grad(loss)(jnp.asarray(x))
+    xt = torch.tensor(x, requires_grad=True)
+    ln = torch.nn.LayerNorm(10)
+    torch.sin(ln(xt)).sum().backward()
+    assert_close(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_cast_reverse_reduce_mean():
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 5).astype(np.float32)
+
+    out, _ = run_forward(
+        lambda m, t: m.cast(t[0], ff.DataType.DT_INT32), [x])
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, x.astype(np.int32))
+
+    out, _ = run_forward(lambda m, t: m.reverse(t[0], axis=1), [x])
+    np.testing.assert_array_equal(out, x[:, ::-1])
+
+    out, _ = run_forward(lambda m, t: m.reduce_sum(t[0], [1]), [x])
+    assert_close(out, x.sum(axis=1))
+
+    out, _ = run_forward(lambda m, t: m.mean(t[0], [0]), [x])
+    assert_close(out, x.mean(axis=0))
+
+
+def test_batchnorm_training_updates_running_stats():
+    rng = np.random.RandomState(7)
+    x = (rng.randn(8, 3, 4, 4) * 2 + 1.5).astype(np.float32)
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    tin = model.create_tensor([8, 3, 4, 4])
+    out = model.batch_norm(tin, relu=False, name="bn")
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    before = {k: np.asarray(v) for k, v in model.state.get("bn", {}).items()}
+    _, new_state, _ = model.executor.forward_values(
+        model.params, model.state, {"input_0": x}, None,
+        CompMode.COMP_MODE_TRAINING)
+    after = {k: np.asarray(v) for k, v in new_state.get("bn", {}).items()}
+    assert before and after
+    changed = any(not np.allclose(before[k], after[k]) for k in before)
+    assert changed, "running stats did not update in training mode"
+
+
+def test_dropout_train_vs_inference():
+    rng = np.random.RandomState(8)
+    x = np.ones((64, 64), dtype=np.float32)
+
+    # inference: identity
+    out, model = run_forward(
+        lambda m, t: m.dropout(t[0], rate=0.5, name="do"), [x])
+    assert_close(out, x)
+
+    # training: ~half zeros, survivors scaled by 2
+    import jax
+
+    values, _, _ = model.executor.forward_values(
+        model.params, model.state, {"input_0": x},
+        jax.random.PRNGKey(0), CompMode.COMP_MODE_TRAINING)
+    tr = np.asarray(values[model.final_tensor.guid])
+    zero_frac = float((tr == 0).mean())
+    assert 0.35 < zero_frac < 0.65, zero_frac
+    nz = tr[tr != 0]
+    np.testing.assert_allclose(nz, 2.0, rtol=1e-5)

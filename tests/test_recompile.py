@@ -1,0 +1,66 @@
+"""Dynamic recompilation hook (reference: RecompileState recompile.h:28-44,
+MoE cache switch moe.cc:64-98)."""
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.runtime.recompile import (
+    RecompileState,
+    moe_cache_alter,
+    moe_cache_trigger,
+)
+
+
+def test_trigger_alter_fires_once():
+    config = ff.FFConfig()
+    config.batch_size = 8
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 16])
+    model.softmax(model.dense(inp, 4))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    fired = []
+    rs = RecompileState(
+        trigger=lambda m: m._step_count >= 0,
+        alter=lambda m: fired.append(m._step_count),
+    )
+    model.recompile_on_condition(rs)
+    x = np.random.RandomState(0).randn(32, 16).astype(np.float32)
+    y = np.zeros((32, 1), dtype=np.int32)
+    model.fit(x, y, epochs=2)
+    assert len(fired) == 1  # one-shot
+    assert rs.fired == 1
+
+
+def test_moe_cache_switch_end_to_end():
+    """Cache op serves live input until scores stabilize, then the alter
+    flips it to cached mode and the step recompiles."""
+    batch, d = 8, 16
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, d])
+    cached = model.cache(inp, name="assign_cache")
+    model.softmax(model.dense(cached, 4, name="head"))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.0),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    model.recompile_on_condition(
+        RecompileState(moe_cache_trigger(threshold=1e-6, warmup_steps=2),
+                       moe_cache_alter))
+    # constant input -> cache divergence score goes to 0 -> trigger fires
+    x = np.tile(np.random.RandomState(0).randn(1, d).astype(np.float32),
+                (64, 1))
+    y = np.zeros((64, 1), dtype=np.int32)
+    model.fit(x, y, epochs=1)
+    cache_op = next(op for op in model.graph.ops.values()
+                    if op.op_type == ff.OpType.CACHE)
+    assert cache_op.params.get("use_cached") is True
+    # training still runs after the recompile
+    hist = model.fit(x, y, epochs=1)
+    assert np.isfinite(hist[-1]["loss"])
