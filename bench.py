@@ -19,9 +19,61 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+# Backend-init retry (round-1 failure mode: first dispatch died with
+# "Unable to initialize backend 'axon': UNAVAILABLE", e.g. while another
+# process still held the chip). A failed init can leave poisoned state in
+# the jax process, so each retry re-execs a fresh interpreter.
+MAX_RETRIES = int(os.environ.get("BENCH_MAX_RETRIES", 5))
+RETRY_BACKOFF_S = float(os.environ.get("BENCH_RETRY_BACKOFF_S", 20.0))
+
+
+def _is_backend_init_error(exc: BaseException) -> bool:
+    # deliberately narrow: bare UNAVAILABLE/DEADLINE_EXCEEDED can also come
+    # from deterministic mid-run failures, which retrying only multiplies
+    msg = str(exc)
+    return (
+        "Unable to initialize backend" in msg
+        or "TPU backend setup" in msg
+        or "failed to connect" in msg.lower()
+    )
+
+
+def _retry_or_fail(exc: BaseException) -> None:
+    attempt = int(os.environ.get("_BENCH_ATTEMPT", 0))
+    if _is_backend_init_error(exc) and attempt < MAX_RETRIES:
+        wait = RETRY_BACKOFF_S * (1.5 ** attempt)
+        print(
+            f"bench: backend init failed, retry {attempt + 1}/{MAX_RETRIES}"
+            f" in {wait:.0f}s: {exc}",
+            file=sys.stderr,
+        )
+        time.sleep(wait)
+        env = dict(os.environ, _BENCH_ATTEMPT=str(attempt + 1))
+        # orig_argv preserves interpreter flags (e.g. -u) across the re-exec
+        os.execve(sys.executable, list(sys.orig_argv), env)
+    # exhausted (or a non-backend error): emit a parseable failure line,
+    # with the full traceback on stderr for diagnosis
+    import traceback
+
+    traceback.print_exc(file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_train_throughput",
+                "value": 0.0,
+                "unit": "samples/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"{type(exc).__name__}: {exc}",
+                "attempts": attempt + 1,
+            }
+        )
+    )
+    sys.exit(1)
 
 # env overrides let CI validate the script on small shapes / CPU
 BATCH = int(os.environ.get("BENCH_BATCH", 8))
@@ -50,6 +102,14 @@ def train_step_flops() -> float:
 
 
 def main():
+    # BENCH_PLATFORM=cpu lets CI validate the script off-TPU (the env var
+    # alone is ignored once the TPU site hook has registered — see
+    # flexflow_tpu.runtime.platform).
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        from flexflow_tpu.runtime.platform import force_platform
+
+        force_platform(platform)
     import jax
 
     import flexflow_tpu as ff
@@ -126,4 +186,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except (SystemExit, KeyboardInterrupt):
+        raise
+    except BaseException as exc:  # noqa: BLE001 — must always emit the JSON line
+        _retry_or_fail(exc)

@@ -1,0 +1,44 @@
+"""Platform selection helpers.
+
+The TPU platform plugin (axon) registers at interpreter start via a site
+hook, so setting JAX_PLATFORMS in os.environ alone is ignored once jax is
+imported — the platform must also be forced through jax.config, which takes
+effect any time before the first backend client is created.
+
+Used by tests/conftest.py, __graft_entry__.py, and bench.py (the three
+places that must steer backend choice).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_OPT = "--xla_force_host_platform_device_count"
+
+
+def force_platform(name: str, n_host_devices: int | None = None) -> None:
+    """Force the JAX platform (and optionally the virtual CPU device count).
+
+    Must be called before any jax backend client exists. Safe to call after
+    ``import jax`` / ``import flexflow_tpu`` (neither creates a client at
+    import time).
+    """
+    if n_host_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if _COUNT_OPT in flags:
+            # only raise an existing count, never lower it
+            m = re.search(rf"{_COUNT_OPT}=(\d+)", flags)
+            if m and int(m.group(1)) < n_host_devices:
+                flags = re.sub(
+                    rf"{_COUNT_OPT}=\d+", f"{_COUNT_OPT}={n_host_devices}", flags
+                )
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} {_COUNT_OPT}={n_host_devices}".strip()
+            )
+    os.environ["JAX_PLATFORMS"] = name
+
+    import jax
+
+    jax.config.update("jax_platforms", name)

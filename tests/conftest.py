@@ -8,15 +8,6 @@ Note: the TPU platform plugin may already be registered at interpreter start
 (site hook), so JAX_PLATFORMS in os.environ alone is not enough — we force the
 platform through jax.config, which takes effect before any backend client is
 created."""
-import os
+from flexflow_tpu.runtime.platform import force_platform
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_platform("cpu", n_host_devices=8)
