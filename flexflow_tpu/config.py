@@ -64,6 +64,11 @@ class FFConfig:
     memory_search: bool = False
     memory_budget_mb: float = 16 * 1024.0  # per-chip HBM budget for memory-aware search
     substitution_json_path: Optional[str] = None
+    # Measured op costs for the search (reference: the simulator profiles
+    # real kernels, simulator.cc:489). None = auto: measure when the default
+    # backend is a real accelerator, stay analytic on CPU (tests/dryruns).
+    measure_op_costs: Optional[bool] = None
+    op_cost_cache_file: Optional[str] = None
     # Prefer the native C++ search core (src/ffcore) when buildable; the
     # pure-Python search is the fallback and the reference semantics.
     use_native_search: bool = True
@@ -138,6 +143,12 @@ class FFConfig:
                 self.search_overlap_backward_update = True
             elif a == "--memory-search":
                 self.memory_search = True
+            elif a == "--measure-op-costs":
+                self.measure_op_costs = True
+            elif a == "--no-measure-op-costs":
+                self.measure_op_costs = False
+            elif a == "--op-cost-cache":
+                self.op_cost_cache_file = take()
             elif a == "--memory-budget":
                 self.memory_budget_mb = float(take())
             elif a == "--substitution-json":

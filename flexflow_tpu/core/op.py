@@ -22,6 +22,19 @@ from .tensor import Parameter, Tensor
 _op_guid = itertools.count(1)
 
 
+def _freeze(v):
+    """Hashable deep-freeze of op params (lists/dicts/arrays/callables)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.dtype.str, v.tobytes())
+    if callable(v):
+        return getattr(v, "__name__", repr(v))
+    return v
+
+
 @dataclasses.dataclass
 class WeightSpec:
     """Declaration of one weight tensor of an op."""
@@ -151,21 +164,21 @@ class Op:
 
     # -- identity/caching (reference: per-op Params structs + get_or_create_node)
     def param_key(self) -> Tuple:
-        def freeze(v):
-            if isinstance(v, (list, tuple)):
-                return tuple(freeze(x) for x in v)
-            if isinstance(v, dict):
-                return tuple(sorted((k, freeze(x)) for k, x in v.items()))
-            if isinstance(v, np.ndarray):
-                return (v.shape, v.dtype.str, v.tobytes())
-            if callable(v):
-                return getattr(v, "__name__", repr(v))
-            return v
-
         return (
             self.op_type,
             tuple(t.guid for t in self.inputs),
-            freeze(self.params),
+            _freeze(self.params),
+        )
+
+    def cost_key(self) -> Tuple:
+        """Shape-based identity for cost caching: unlike param_key (whose
+        input guids are unique per model), identical ops — the 12 identical
+        layers of a BERT stack, or the same op in a fresh compile — share one
+        key (reference: measured-cost hash cache, simulator.h:750-752)."""
+        return (
+            self.op_type,
+            tuple((t.dims, t.dtype) for t in self.inputs),
+            _freeze(self.params),
         )
 
     def __repr__(self):

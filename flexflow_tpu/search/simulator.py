@@ -23,10 +23,14 @@ TPU-native re-design:
 from __future__ import annotations
 
 import dataclasses
+import json
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger("flexflow_tpu.search")
 
 from ..core.graph import Graph
 from ..core.op import Op
@@ -132,60 +136,201 @@ class CostModel:
 class OpCostCache:
     """Measured per-op costs (reference: Simulator::measure_operator_cost +
     hash cache simulator.h:750-752): jit the single op at its sharded local
-    shape, time warm runs on the real device."""
+    shape, time warm fwd and bwd runs on the real device.
 
-    def __init__(self, config=None, warmup: int = 2, repeats: int = 5):
+    Cache keys are shape-based (Op.cost_key), so identical ops — e.g. the 12
+    identical layers of a BERT stack, or the same op across compiles — share
+    one measurement. Measurement failures are recorded and logged, never
+    silently degraded to the analytic model (the Simulator does the fallback
+    and the search logs the counts)."""
+
+    def __init__(self, config=None, warmup: int = 2, repeats: int = 5,
+                 path: Optional[str] = None):
         self.config = config
         self.warmup = warmup
         self.repeats = repeats
-        self.cache: Dict[Tuple, float] = {}
+        # cost_key -> (fwd_us, bwd_us); bwd_us < 0 when only fwd measured
+        self.cache: Dict[Tuple, Tuple[float, float]] = {}
+        self.failures: Dict[Tuple, str] = {}
+        self.hits = 0
+        self.misses = 0
+        self.failure_hits = 0
+        self.path = path
+        self._has_str_keys = False
+        if path:
+            self._load(path)
+            self._has_str_keys = any(isinstance(k, str) for k in self.cache)
 
+    # -- persistence (across processes; in-process sharing comes from the
+    # module-level singleton in get_op_cost_cache) ------------------------
+    def _load(self, path: str) -> None:
+        import os
+
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            for k, (fwd, bwd) in data.items():
+                self.cache[k] = (fwd, bwd)
+        except Exception as exc:  # corrupt cache: start fresh
+            _log.warning("op-cost cache %s unreadable (%s); ignoring", path, exc)
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        try:
+            data = {self._str_key(k): v for k, v in self.cache.items()}
+            with open(self.path, "w") as f:
+                json.dump(data, f)
+        except OSError as exc:  # never fail a successful search over the cache
+            _log.warning("op-cost cache not saved to %s: %s", self.path, exc)
+
+    @staticmethod
+    def _str_key(key) -> str:
+        return key if isinstance(key, str) else repr(key)
+
+    @staticmethod
+    def _op_config(op: Op, fallback):
+        return op.model.config if getattr(op, "model", None) is not None else fallback
+
+    def _key(self, op: Op, dp: int) -> Tuple:
+        # precision is part of the identity: the same op lowers to bf16 or
+        # f32 matmuls depending on allow_mixed_precision (ops/common.py)
+        cfg = self._op_config(op, self.config)
+        mixed = bool(cfg.allow_mixed_precision) if cfg is not None else True
+        return (op.cost_key(), dp, mixed)
+
+    def stats(self) -> str:
+        return (f"measured-cost cache: {self.hits} hits, {self.misses} misses, "
+                f"{len(self.failures)} failures"
+                + (f" ({self.failure_hits} failure-hits)" if self.failure_hits
+                   else ""))
+
+    # -- measurement ------------------------------------------------------
     def measure_forward_us(self, op: Op, s: OpStrategy) -> float:
-        key = (op.param_key(), s)
+        fwd, _ = self.measure_us(op, s)
+        return fwd
+
+    def measure_us(self, op: Op, s: OpStrategy) -> Tuple[float, float]:
+        """(fwd_us, bwd_us) for op under strategy s; (-1, -1) if unmeasurable.
+
+        The op is measured at its dp-sharded local shape (batch/dp). TP
+        sharding is applied analytically on top (time/tp for TP-capable ops,
+        whose matmul FLOPs scale with 1/tp) — measuring true tp-sharded
+        weight shapes would need per-op param rewriting; the measured dp
+        point anchors the absolute scale, which is what the analytic model
+        lacks."""
+        if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
+            return 0.0, 0.0
+        key = self._key(op, s.dp)
         if key in self.cache:
-            return self.cache[key]
+            self.hits += 1
+            fwd, bwd = self.cache[key]
+        elif key in self.failures:
+            self.failure_hits += 1
+            return -1.0, -1.0
+        else:
+            # promote a persisted (string-keyed) entry to the tuple key
+            skey = self._str_key(key) if self._has_str_keys else None
+            if skey is not None and skey in self.cache:
+                self.hits += 1
+                fwd, bwd = self.cache.pop(skey)
+                self.cache[key] = (fwd, bwd)
+            else:
+                self.misses += 1
+                try:
+                    fwd, bwd = self._measure(op, s.dp)
+                    self.cache[key] = (fwd, bwd)
+                except Exception as exc:
+                    self.failures[key] = f"{type(exc).__name__}: {exc}"
+                    _log.warning("op-cost measurement failed for %s: %s",
+                                 op.name, self.failures[key])
+                    return -1.0, -1.0
+        tp = s.tp if op.op_type in TP_CAPABLE else 1
+        return fwd / tp, (bwd / tp if bwd >= 0 else bwd)
+
+    def _measure(self, op: Op, dp: int) -> Tuple[float, float]:
         import jax
         import jax.numpy as jnp
 
         from ..core.op import LoweringContext
         from ..ffconst import CompMode
 
-        def local_shape(t, shard_batch):
+        def local_shape(t):
             dims = list(t.dims)
-            if dims and shard_batch and dims[0] % s.dp == 0:
-                dims[0] //= s.dp
+            if dims and dims[0] % max(dp, 1) == 0:
+                dims[0] //= max(dp, 1)
             return tuple(dims)
 
-        try:
-            key_rng = jax.random.PRNGKey(0)
-            ins = [
-                jnp.zeros(local_shape(t, True), t.dtype.jnp_dtype) for t in op.inputs
-            ]
-            weights = {}
-            for w in op.weights:
-                ws = w._weight_spec
-                weights[ws.name] = jnp.zeros(ws.dims, ws.dtype.jnp_dtype)
+        key_rng = jax.random.PRNGKey(0)
+        cfg = self._op_config(op, self.config)
+        ins = [jnp.zeros(local_shape(t), t.dtype.jnp_dtype) for t in op.inputs]
+        weights = {}
+        for w in op.weights:
+            ws = w._weight_spec
+            weights[ws.name] = jnp.zeros(ws.dims, ws.dtype.jnp_dtype)
 
-            def run(ins, weights):
-                ctx = LoweringContext(self.config, CompMode.COMP_MODE_INFERENCE,
-                                      None, key_rng)
-                return op.lower(ctx, list(ins), weights)
+        def run(ins, weights):
+            ctx = LoweringContext(cfg, CompMode.COMP_MODE_INFERENCE,
+                                  None, key_rng)
+            return op.lower(ctx, list(ins), weights)
 
-            fn = jax.jit(run)
+        fwd_us = self._time(jax.jit(run), ins, weights)
+
+        # backward: grad wrt float inputs + weights of a scalar reduction
+        # (jax.grad is the framework's real backward path — reference instead
+        # times hand-written backward kernels, model.cu:38-75). grad re-runs
+        # the forward internally, so subtract the measured fwd to isolate the
+        # backward cost.
+        float_in = any(jnp.issubdtype(x.dtype, jnp.floating) for x in ins)
+
+        def loss(ins, weights):
+            outs = run(ins, weights)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            return sum(
+                jnp.sum(o) for o in outs
+                if jnp.issubdtype(o.dtype, jnp.floating)
+            )
+
+        bwd_us = -1.0
+        if weights or float_in:
+            argnums = tuple(
+                n for n, ok in ((0, float_in), (1, bool(weights))) if ok
+            )
+            try:
+                bwd_fn = jax.jit(jax.grad(loss, argnums=argnums))
+                bwd_us = max(0.0, self._time(bwd_fn, ins, weights) - fwd_us)
+            except Exception:
+                bwd_us = -1.0  # non-differentiable op: fwd-only measurement
+        return fwd_us, bwd_us
+
+    def _time(self, fn, ins, weights) -> float:
+        import jax
+
+        out = fn(ins, weights)
+        jax.block_until_ready(out)
+        for _ in range(self.warmup):
             out = fn(ins, weights)
-            jax.block_until_ready(out)
-            for _ in range(self.warmup):
-                out = fn(ins, weights)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(self.repeats):
-                out = fn(ins, weights)
-            jax.block_until_ready(out)
-            us = (time.perf_counter() - t0) / self.repeats * 1e6
-        except Exception:
-            us = -1.0  # unmeasurable op (e.g. needs executor context)
-        self.cache[key] = us
-        return us
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(self.repeats):
+            out = fn(ins, weights)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / self.repeats * 1e6
+
+
+_GLOBAL_CACHE: Optional[OpCostCache] = None
+
+
+def get_op_cost_cache(config=None) -> OpCostCache:
+    """Process-wide measured-cost cache, shared across compiles (reference:
+    the Simulator outlives individual searches and keeps its hash cache)."""
+    global _GLOBAL_CACHE
+    path = getattr(config, "op_cost_cache_file", None) if config else None
+    if _GLOBAL_CACHE is None or (path and _GLOBAL_CACHE.path != path):
+        _GLOBAL_CACHE = OpCostCache(config, path=path)
+    return _GLOBAL_CACHE
 
 
 class Simulator:
@@ -198,27 +343,39 @@ class Simulator:
         self.config = config
         self.cost = CostModel(machine, config)
         self.measured = measured
+        self.analytic_fallbacks = 0
 
-    def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
-        fwd = -1.0
+    def fwd_bwd_time_us(self, op: Op, s: OpStrategy) -> Tuple[float, float]:
+        """(fwd, bwd) from the measured cache when available, analytic
+        otherwise — one consistent source for both numbers."""
+        fwd = bwd = -1.0
         if self.measured is not None:
-            fwd = self.measured.measure_forward_us(op, s)
+            fwd, bwd = self.measured.measure_us(op, s)
+            if fwd < 0:
+                self.analytic_fallbacks += 1
         if fwd < 0:
             fwd = self.cost.forward_time_us(op, s)
-        return (
-            fwd
-            + self.cost.backward_time_us(op, s)
-            + self.cost.tp_collective_time_us(op, s)
-        )
+        if bwd < 0:
+            # bwd unmeasured: scale the (possibly measured) fwd by the
+            # analytic fwd:bwd ratio
+            bwd = _MEMORY_BOUND_BWD_FACTOR * fwd
+        return fwd, bwd
+
+    def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
+        fwd, bwd = self.fwd_bwd_time_us(op, s)
+        return fwd + bwd + self.cost.tp_collective_time_us(op, s)
 
     def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         """Per-iteration time (us) of the graph under per-op strategies."""
         total = 0.0
         grad_sync = 0.0
+        bwd_total = 0.0
         default = OpStrategy()
         for op in graph.topo_order():
             s = strategies.get(op.guid, default)
-            total += self.op_step_time_us(op, s)
+            fwd, bwd = self.fwd_bwd_time_us(op, s)
+            total += fwd + bwd + self.cost.tp_collective_time_us(op, s)
+            bwd_total += bwd
             grad_sync += self.cost.grad_sync_time_us(op, s)
             for t in op.inputs:
                 src_op = t.owner_op
@@ -231,11 +388,7 @@ class Simulator:
             # gradient allreduce overlaps the backward pass (reference:
             # search_overlap_backward_update): only the non-overlapped tail
             # remains visible
-            bwd = sum(
-                self.cost.backward_time_us(op, strategies.get(op.guid, default))
-                for op in graph.ops.values()
-            )
-            grad_sync = max(0.0, grad_sync - 0.8 * bwd)
+            grad_sync = max(0.0, grad_sync - 0.8 * bwd_total)
         return total + grad_sync
 
     def memory_bytes(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:

@@ -26,7 +26,10 @@ import dataclasses
 import heapq
 import itertools
 import json
+import logging
 from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger("flexflow_tpu.search")
 
 from ..core.graph import Graph
 from ..core.op import Op
@@ -225,6 +228,13 @@ class GraphSearchHelper:
         best = min(candidates, key=lambda r: r.cost_us)
         self.log.extend(c.log[0] for c in candidates)
         self.log.append(f"selected: {best.log[0]}")
+        if self.sim.measured is not None:
+            self.log.append(
+                self.sim.measured.stats()
+                + f"; {self.sim.analytic_fallbacks} analytic fallbacks"
+            )
+            _log.info(self.log[-1])
+            self.sim.measured.save()
         best.log = self.log
         return best
 
@@ -249,6 +259,20 @@ class GraphSearchHelper:
         return axes
 
 
+def _want_measured(config) -> bool:
+    """Measured-cost mode: explicit config wins; auto = only on a real
+    accelerator (CPU search runs — tests, dryruns — stay analytic)."""
+    explicit = getattr(config, "measure_op_costs", None)
+    if explicit is not None:
+        return explicit
+    try:
+        import jax
+
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
 def unity_optimize(graph: Graph, config, machine: MachineModel,
                    batch_size: int, n_devices: int,
                    simulator: Optional[Simulator] = None) -> SearchResult:
@@ -262,6 +286,15 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
         load_rule_spec,
         rule_set_from_spec,
     )
+
+    # measured op costs (reference: the simulator profiles real kernels,
+    # simulator.cc:489,537): on by default when a real accelerator is the
+    # backend; the process-wide cache persists across compiles
+    if simulator is None and _want_measured(config):
+        from .simulator import get_op_cost_cache
+
+        simulator = Simulator(config=config, machine=machine,
+                              measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
     # a TASO rule file constrains the TP menu — only the Python search

@@ -268,3 +268,101 @@ def test_repartition_axis_validation():
     with pytest.raises(ValueError, match="no mesh axis"):
         model.compile(loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                       parallel_axes={"data": 8})
+
+
+# -- measured op costs (reference: simulator.cc:489 measures real kernels) --
+def _linear_op(model):
+    return next(op for op in model.ops if op.op_type == OpType.LINEAR)
+
+
+def test_op_cost_cache_measures_fwd_and_bwd():
+    from flexflow_tpu.search.simulator import OpCostCache
+
+    model = build_mlp(batch=8, din=16, hidden=32, classes=4)
+    cache = OpCostCache(model.config, warmup=1, repeats=2)
+    op = _linear_op(model)
+    fwd, bwd = cache.measure_us(op, OpStrategy(dp=1, tp=1))
+    # bwd is grad-time minus fwd-time (grad re-runs the forward); on tiny
+    # CPU shapes the difference can vanish in noise, so only require >= 0
+    assert fwd > 0 and bwd >= 0
+    assert cache.misses == 1 and cache.hits == 0
+    # identical op in a *fresh* model shares the cost_key -> cache hit
+    model2 = build_mlp(batch=8, din=16, hidden=32, classes=4)
+    fwd2, _ = cache.measure_us(_linear_op(model2), OpStrategy(dp=1, tp=1))
+    assert cache.hits == 1 and fwd2 == fwd
+    # tp sharding scales the measured time analytically
+    fwd_tp, _ = cache.measure_us(op, OpStrategy(dp=1, tp=2))
+    assert fwd_tp == pytest.approx(fwd / 2)
+
+
+def test_op_cost_cache_failure_is_recorded_and_fallback_counted():
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.simulator import OpCostCache
+
+    model = build_mlp(batch=8, din=16, hidden=32, classes=4)
+    op = _linear_op(model)
+
+    class BrokenCache(OpCostCache):
+        def _measure(self, op, dp):
+            raise RuntimeError("no device")
+
+    cache = BrokenCache(model.config)
+    sim = Simulator(TpuPodModel(4), model.config, measured=cache)
+    t = sim.op_step_time_us(op, OpStrategy(dp=1, tp=1))
+    assert t > 0  # analytic fallback
+    assert sim.analytic_fallbacks == 1
+    assert len(cache.failures) == 1  # loud, not silent
+
+
+def test_measured_costs_change_search_outcome():
+    """A measured cache whose numbers disagree >2x with the analytic model
+    must change the simulated cost (and can flip the chosen strategy)."""
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.simulator import OpCostCache
+
+    model = build_mlp(batch=64, din=256, hidden=1024, classes=10)
+    machine = TpuPodModel(4)
+    graph = Graph(model.ops)
+
+    class FakeMeasured(OpCostCache):
+        def _measure(self, op, dp):
+            return 5000.0 / dp, 10000.0 / dp  # much slower than analytic
+
+    analytic = Simulator(machine, model.config)
+    measured = Simulator(machine, model.config, measured=FakeMeasured(model.config))
+    strategies = {op.guid: OpStrategy(dp=4, tp=1) for op in model.ops}
+    c_a = analytic.simulate(graph, strategies)
+    c_m = measured.simulate(graph, strategies)
+    assert c_m > 2 * c_a
+
+
+def test_op_cost_cache_persists(tmp_path):
+    from flexflow_tpu.search.simulator import OpCostCache
+
+    path = str(tmp_path / "costs.json")
+    model = build_mlp(batch=8, din=16, hidden=32, classes=4)
+    op = _linear_op(model)
+    cache = OpCostCache(model.config, warmup=1, repeats=2, path=path)
+    fwd, bwd = cache.measure_us(op, OpStrategy(dp=1, tp=1))
+    cache.save()
+    fresh = OpCostCache(model.config, path=path)
+    fwd2, bwd2 = fresh.measure_us(op, OpStrategy(dp=1, tp=1))
+    assert fresh.misses == 0 and fresh.hits == 1
+    assert (fwd2, bwd2) == (fwd, bwd)
+
+
+def test_unity_optimize_uses_measured_when_configured():
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search import simulator as sim_mod
+
+    model = build_mlp(batch=64, din=64, hidden=128, classes=8)
+    model.config.num_devices = 4
+    model.config.search_budget = 4
+    model.config.measure_op_costs = True
+    sim_mod._GLOBAL_CACHE = None  # isolate from other tests
+    machine = make_machine_model(model.config, 4)
+    result = unity_optimize(Graph(model.ops), model.config, machine, 64, 4)
+    assert any("measured-cost cache" in line for line in result.log)
+    cache = sim_mod.get_op_cost_cache(model.config)
+    assert cache.misses > 0  # real measurements happened
+    sim_mod._GLOBAL_CACHE = None
