@@ -137,6 +137,7 @@ class OpType(enum.Enum):
     MULTIHEAD_ATTENTION = "multihead_attention"
     TOPK = "topk"
     GROUP_BY = "group_by"
+    EXPERTS = "experts"
     FUSED = "fused"
     LSTM = "lstm"
     # Parallel ops (reference: src/parallel_ops)

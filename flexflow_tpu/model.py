@@ -455,6 +455,32 @@ class FFModel:
     def allreduce(self, input: Tensor, axis_name: str = "data", name: str = "") -> Tensor:
         return self._add_op(OpType.ALLREDUCE, [input], name, axis_name=axis_name).outputs[0]
 
+    def experts(
+        self,
+        input: Tensor,
+        gate_preds: Tensor,
+        assign: Tensor,
+        num_exp: int,
+        out_dim: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.0,
+        full_gate: Optional[Tensor] = None,
+        activation: ActiMode = ActiMode.AC_MODE_RELU,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        """Fused expert block with device-level expert parallelism (see
+        ops/moe.py ExpertsOp; reference: search-placed expert ops,
+        src/ops/group_by.cc + examples/cpp/mixture_of_experts/moe.cc)."""
+        ins = [input, gate_preds, assign]
+        if full_gate is not None:
+            ins.append(full_gate)
+        return self._add_op(
+            OpType.EXPERTS, ins, name, n=num_exp, out_dim=out_dim,
+            alpha=alpha, lambda_bal=lambda_bal, activation=activation,
+            kernel_initializer=kernel_initializer,
+        ).outputs[0]
+
     def moe(
         self,
         input: Tensor,
@@ -463,6 +489,7 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float = 2.0,
         lambda_bal: float = 0.0,
+        fused: bool = False,
         name: str = "",
     ) -> Tensor:
         """MoE block (reference: FFModel::moe, model.h:509-514 / moe.cc):
@@ -480,13 +507,20 @@ class FFModel:
         gate = self.dense(input, num_exp, ActiMode.AC_MODE_NONE, name=f"{name}_gate")
         gate = self.softmax(gate)
         topk_out, topk_idx = self.top_k(gate, num_select)
-        grouped = self.group_by(input, topk_idx, num_exp, alpha)
-        exp_preds = [
-            self.dense(g, expert_hidden_size, ActiMode.AC_MODE_RELU, name=f"{name}_exp{i}")
-            for i, g in enumerate(grouped)
-        ]
-        out = self.aggregate(topk_out, topk_idx, topk_idx, gate, exp_preds,
-                             num_exp, lambda_bal)
+        if fused:
+            # fused dispatch->batched FFN->combine; expert-parallel capable
+            out = self.experts(input, topk_out, topk_idx, num_exp,
+                               expert_hidden_size, alpha, lambda_bal,
+                               full_gate=gate, name=f"{name}_experts")
+        else:
+            grouped = self.group_by(input, topk_idx, num_exp, alpha)
+            exp_preds = [
+                self.dense(g, expert_hidden_size, ActiMode.AC_MODE_RELU,
+                           name=f"{name}_exp{i}")
+                for i, g in enumerate(grouped)
+            ]
+            out = self.aggregate(topk_out, topk_idx, topk_idx, gate, exp_preds,
+                                 num_exp, lambda_bal)
         if orig_dims is not None:
             out = self.reshape(
                 out, list(orig_dims[:-1]) + [expert_hidden_size],
@@ -680,7 +714,19 @@ class FFModel:
                     else:
                         dims.append(ParallelDim(size, 1, None))
                 t.parallel_shape = ParallelTensorShape(dims, t.dtype)
-            if op_tp > 1:
+            if op.op_type == OpType.EXPERTS and axes.get("expert", 1) > 1:
+                # expert-parallel: stacked expert weights shard dim 0 over
+                # the 'expert' mesh axis (device-level expert parallelism)
+                ep = axes["expert"]
+                for w in op.weights:
+                    dims = [ParallelDim(sz, 1, None) for sz in w.dims]
+                    if w.dims[0] % ep == 0:
+                        dims[0] = ParallelDim(
+                            w.dims[0], ep, "expert",
+                            kind=ParallelDimKind.EXPERT,
+                        )
+                    w.parallel_shape = ParallelTensorShape(dims, w.dtype)
+            elif op_tp > 1:
                 self._assign_tp_weights(op, op_tp)
             elif tp > 1:
                 # non-TP op under a TP mesh: weights replicated

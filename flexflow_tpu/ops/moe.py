@@ -40,6 +40,32 @@ def _dispatch_plan(assign, n: int, capacity: int):
     return flat, slot, valid
 
 
+def _load_balance_loss(full_gate, assign, n: int, lambda_bal: float):
+    """Switch-Transformer-style load-balance loss (functional stand-in for
+    the reference's lambda_bal gradient shaping in aggregate.cu's backward
+    kernel): lambda_bal * n * sum_e(importance_e * load_e)."""
+    full = full_gate.astype(jnp.float32)  # (B, n) gate distribution
+    importance = jnp.mean(full, axis=0)
+    load = jnp.mean(
+        jax.nn.one_hot(assign.reshape(-1), n, dtype=jnp.float32), axis=0
+    )
+    return lambda_bal * n * jnp.sum(importance * load)
+
+
+def _dispatch_masks(assign, n: int, capacity: int, dtype):
+    """One-hot dispatch factors (GShard-style): sel (T, n) expert selector
+    masked by capacity validity, slot_oh (T, cap) slot selector. The full
+    (T, n, cap) dispatch mask is their outer product; keeping the factors
+    separate lets the dispatch/combine einsums contract without ever
+    materializing it (XLA picks the pairing)."""
+    expert, slot, valid = _dispatch_plan(assign, n, capacity)
+    sel = jax.nn.one_hot(expert, n, dtype=dtype) * valid[:, None].astype(dtype)
+    slot_oh = jax.nn.one_hot(
+        jnp.minimum(slot, capacity - 1), capacity, dtype=dtype
+    )
+    return sel, slot_oh
+
+
 @register_op
 class GroupByOp(Op):
     """inputs: (features (B, F), assign (B, k)); outputs: n buffers (cap, F)."""
@@ -60,17 +86,13 @@ class GroupByOp(Op):
         b, f = x.shape
         k = assign.shape[1]
         cap = moe_capacity(b, k, n, alpha)
-        expert, slot, valid = _dispatch_plan(assign.astype(jnp.int32), n, cap)
-        tokens = jnp.repeat(x, k, axis=0)  # (B*k, F) token features per assignment
-        outs = []
-        for e in range(n):
-            sel = (expert == e) & valid
-            # scatter: buffer[slot[t]] = tokens[t] where sel
-            buf = jnp.zeros((cap, f), x.dtype)
-            idx = jnp.where(sel, slot, cap)  # invalid -> out-of-range (dropped)
-            buf = buf.at[idx].set(jnp.where(sel[:, None], tokens, 0.0), mode="drop")
-            outs.append(buf)
-        return outs
+        # one-hot-einsum dispatch: one (n*cap, T) x (T, F) MXU contraction
+        # instead of n scatter passes over all B*k tokens
+        dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+        sel, slot_oh = _dispatch_masks(assign.astype(jnp.int32), n, cap, dt)
+        bufs = jnp.einsum("bkn,bkc,bf->ncf", sel.reshape(b, k, n),
+                          slot_oh.reshape(b, k, cap), x.astype(dt))
+        return [bufs[e].astype(x.dtype) for e in range(n)]
 
 
 @register_op
@@ -99,22 +121,130 @@ class AggregateOp(Op):
         cap = exp_preds[0].shape[0]
         lambda_bal = self.params.get("lambda_bal", 0.0)
         if lambda_bal:
-            # Switch-Transformer-style load-balance loss (functional stand-in
-            # for the reference's lambda_bal gradient shaping in
-            # aggregate.cu's backward kernel): n * sum_e(importance_e * load_e)
-            full_gate = inputs[3].astype(jnp.float32)  # (B, n) gate distribution
-            importance = jnp.mean(full_gate, axis=0)
-            load = jnp.mean(
-                jax.nn.one_hot(gate_assign.reshape(-1), n, dtype=jnp.float32), axis=0
+            ctx.aux_losses.append(
+                _load_balance_loss(inputs[3], gate_assign, n, lambda_bal)
             )
-            ctx.aux_losses.append(lambda_bal * n * jnp.sum(importance * load))
-        expert, slot, valid = _dispatch_plan(gate_assign.astype(jnp.int32), n, cap)
         stacked = jnp.stack(exp_preds)  # (n, cap, out_dim)
-        # gather each token-assignment's expert output (invalid -> zeros)
-        tok_out = stacked[expert, jnp.minimum(slot, cap - 1)]  # (B*k, out_dim)
-        tok_out = jnp.where(valid[:, None], tok_out, 0.0)
+        dt = stacked.dtype if jnp.issubdtype(stacked.dtype, jnp.floating) else jnp.float32
+        sel, slot_oh = _dispatch_masks(gate_assign.astype(jnp.int32), n, cap, dt)
+        # combine: one (T, n*cap) x (n*cap, out_dim) contraction gathers each
+        # token-assignment's expert output (invalid rows -> zeros via sel)
+        tok_out = jnp.einsum("tn,tc,nch->th", sel, slot_oh, stacked.astype(dt))
         tok_out = tok_out.reshape(b, k, -1)
         return [jnp.sum(tok_out * gate_preds[..., None].astype(tok_out.dtype), axis=1)]
+
+
+@register_op
+class ExpertsOp(Op):
+    """Fused MoE expert block: dispatch -> batched per-expert FFN -> combine,
+    with device-level expert parallelism.
+
+    inputs: x (B, F), gate_preds (B, k) top-k gate weights, assign (B, k)
+    expert ids, and optionally full_gate (B, n) for the load-balance loss.
+    weights: kernel (n, F, H) and bias (n, H), stacked with a leading expert
+    dim that shards over the 'expert' mesh axis.
+
+    This is the TPU-native form of the reference's device-placed experts
+    (src/ops/group_by.cc + aggregate.cc scatter/gather between expert ops the
+    search puts on different devices, examples/cpp/mixture_of_experts/moe.cc):
+    the experts live as one batched einsum whose expert dim is sharded, and
+    GSPMD lowers the dispatch/combine contractions between the data-sharded
+    token dim and the expert-sharded buffers to all_to_all-style collectives
+    over ICI.
+    """
+
+    op_type = OpType.EXPERTS
+
+    def _shape(self):
+        x, gate_preds, assign = self.inputs[:3]
+        n = self.params["n"]
+        alpha = self.params.get("alpha", 1.0)
+        cap = moe_capacity(x.dims[0], assign.dims[1], n, alpha)
+        return x, n, cap, self.params["out_dim"]
+
+    def output_shapes(self):
+        x, n, cap, out_dim = self._shape()
+        return [(x.dims[0], out_dim)], [x.dtype]
+
+    def weight_specs(self):
+        from ..core.op import WeightSpec
+        from ..runtime.initializers import DefaultInitializer, ZeroInitializer
+
+        x, n, cap, out_dim = self._shape()
+        f = x.dims[1]
+        init = self.params.get("kernel_initializer") or DefaultInitializer(
+            fan_in=f, fan_out=out_dim
+        )
+        return [
+            WeightSpec("kernel", (n, f, out_dim), x.dtype, init),
+            WeightSpec("bias", (n, out_dim), x.dtype, ZeroInitializer()),
+        ]
+
+    def _constrain_expert(self, ctx, val):
+        """Pin the expert dim to the 'expert' mesh axis so the batched FFN
+        runs expert-parallel and XLA routes tokens with all_to_all."""
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is not None and "expert" in getattr(mesh, "axis_names", ()):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            spec = PartitionSpec("expert", *([None] * (val.ndim - 1)))
+            return jax.lax.with_sharding_constraint(
+                val, NamedSharding(mesh, spec)
+            )
+        return val
+
+    def lower(self, ctx, inputs, weights):
+        from .common import apply_activation, matmul_dtype
+        from ..ffconst import ActiMode
+
+        x, gate_preds, assign = inputs[:3]
+        n = self.params["n"]
+        alpha = self.params.get("alpha", 1.0)
+        lambda_bal = self.params.get("lambda_bal", 0.0)
+        b, f = x.shape
+        k = assign.shape[1]
+        cap = moe_capacity(b, k, n, alpha)
+        cdt = matmul_dtype(getattr(ctx, "config", None), jnp.float32)
+
+        if lambda_bal:
+            if len(inputs) <= 3:
+                raise ValueError(
+                    f"experts op {self.name}: lambda_bal={lambda_bal} needs "
+                    "the full gate distribution (pass full_gate=)"
+                )
+            ctx.aux_losses.append(
+                _load_balance_loss(inputs[3], assign, n, lambda_bal)
+            )
+
+        sel, slot_oh = _dispatch_masks(assign.astype(jnp.int32), n, cap, cdt)
+        # (b, k, ...) mask views contract directly against x — no k-fold
+        # jnp.repeat copy of the token features
+        disp = jnp.einsum("bkn,bkc,bf->ncf", sel.reshape(b, k, n),
+                          slot_oh.reshape(b, k, cap), x.astype(cdt))
+        disp = self._constrain_expert(ctx, disp)
+        kernel = weights["kernel"].astype(cdt)
+        h = jnp.einsum("ncf,nfh->nch", disp, kernel,
+                       preferred_element_type=jnp.float32)
+        h = h + weights["bias"].astype(jnp.float32)[:, None, :]
+        h = apply_activation(
+            h, self.params.get("activation", ActiMode.AC_MODE_RELU)
+        ).astype(cdt)
+        h = self._constrain_expert(ctx, h)
+        # combine, gate-weighted, summing the k assignments per sample
+        gate_flat = gate_preds.reshape(-1).astype(cdt)  # (T,)
+        sel_g = (sel * gate_flat[:, None]).reshape(b, k, n)
+        slot_bk = slot_oh.reshape(b, k, cap)
+        out = jnp.einsum("bkn,bkc,nch->bh", sel_g, slot_bk, h)
+        return [out.astype(self.outputs[0].dtype.jnp_dtype)]
+
+    def flops(self) -> float:
+        x, n, cap, out_dim = self._shape()
+        t = x.dims[0] * self.inputs[2].dims[1]
+        f = x.dims[1]
+        dispatch = 2.0 * t * n * cap * f
+        ffn = 2.0 * n * cap * f * out_dim
+        combine = 2.0 * t * n * cap * out_dim
+        return dispatch + ffn + combine
 
 
 @register_op
