@@ -714,10 +714,12 @@ class FFModel:
                     else:
                         dims.append(ParallelDim(size, 1, None))
                 t.parallel_shape = ParallelTensorShape(dims, t.dtype)
-            if op.op_type == OpType.EXPERTS and axes.get("expert", 1) > 1:
+            op_ep = min(s.ep, axes.get("expert", 1)) if s else axes.get("expert", 1)
+            if op.op_type == OpType.EXPERTS and op_ep > 1:
                 # expert-parallel: stacked expert weights shard dim 0 over
-                # the 'expert' mesh axis (device-level expert parallelism)
-                ep = axes["expert"]
+                # the 'expert' mesh axis (device-level expert parallelism);
+                # per-op searched ep overrides the mesh-wide default
+                ep = op_ep
                 for w in op.weights:
                     dims = [ParallelDim(sz, 1, None) for sz in w.dims]
                     if w.dims[0] % ep == 0:

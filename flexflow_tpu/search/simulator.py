@@ -40,16 +40,18 @@ from .machine_model import MachineModel
 
 @dataclasses.dataclass(frozen=True)
 class OpStrategy:
-    """Parallelization of one op: batch-dim degree (dp) and channel/heads
-    degree (tp). The reference expresses the same thing as a MachineView +
-    per-dim degrees on the op's ParallelTensors."""
+    """Parallelization of one op: batch-dim degree (dp), channel/heads degree
+    (tp), and expert degree (ep, EXPERTS ops only). The reference expresses
+    the same thing as a MachineView + per-dim degrees on the op's
+    ParallelTensors."""
 
     dp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def degree(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.tp * self.ep
 
 
 # ops whose weights/channels can shard over the model axis (reference:
@@ -83,6 +85,8 @@ class CostModel:
         if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
             return 0.0
         shards = s.dp * (s.tp if op.op_type in TP_CAPABLE else 1)
+        if op.op_type == OpType.EXPERTS:
+            shards *= s.ep
         flops = op.flops() / max(1, shards)
         bytes_ = op.bytes_accessed() / max(1, shards)
         return self.machine.compute_time_us(flops, bytes_, self.op_dtype_bytes(op))
@@ -103,6 +107,25 @@ class CostModel:
         return self.machine.allgather_time_us(bytes_ / s.tp, s.tp) + \
             self.machine.reduce_scatter_time_us(bytes_, s.tp)
 
+    def ep_collective_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Token routing cost of expert parallelism: all_to_all of the
+        dispatched capacity buffers to resident experts and back (fwd), and
+        the mirrored pair in bwd."""
+        if s.ep <= 1 or op.op_type != OpType.EXPERTS:
+            return 0.0
+        x = op.inputs[0]
+        from ..ops.moe import moe_capacity
+
+        n = op.params["n"]
+        cap = moe_capacity(x.dims[0], op.inputs[2].dims[1], n,
+                           op.params.get("alpha", 1.0))
+        # per-chip share of the dispatched capacity buffers (each chip holds
+        # n/ep experts' buffers for its dp slice of the batch)
+        buf_bytes = (n * cap * x.dims[1] * self.op_dtype_bytes(op)
+                     / max(1, s.dp * s.ep))
+        # dispatch + combine, each fwd and bwd
+        return 4.0 * self.machine.all_to_all_time_us(buf_bytes, s.ep)
+
     def xfer_time_us(self, tensor_bytes: float, src: OpStrategy, dst: OpStrategy) -> float:
         """Reshard cost on an edge when producer/consumer batch degrees differ
         (reference: parallel-op region copies priced by get_comm_path)."""
@@ -119,17 +142,23 @@ class CostModel:
         allreduce inside the optimizer update task, optimizer_kernel.cu:88)."""
         if s.dp <= 1 or not op.weights:
             return 0.0
+        wshard = s.ep if op.op_type == OpType.EXPERTS else s.tp
         wb = sum(
             w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights
-        ) / max(1, s.tp)
+        ) / max(1, wshard)
         return self.machine.allreduce_time_us(wb, s.dp)
 
     def op_memory_bytes(self, op: Op, s: OpStrategy) -> float:
         """Per-chip memory: sharded weights (x3 for Adam m,v) + activations."""
         wb = sum(w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights)
-        wb /= max(1, s.tp if op.op_type in TP_CAPABLE else 1)
+        wshard = s.tp if op.op_type in TP_CAPABLE else 1
+        if op.op_type == OpType.EXPERTS:
+            wshard = s.ep
+        wb /= max(1, wshard)
         ab = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs)
-        ab /= max(1, s.degree)
+        # activations shard over dp (and tp for TP ops); EXPERTS outputs are
+        # data-sharded only — the expert axis shards weights/buffers, not them
+        ab /= max(1, s.dp * (s.tp if op.op_type in TP_CAPABLE else 1))
         return 3.0 * wb + ab
 
 
@@ -248,6 +277,8 @@ class OpCostCache:
                                  op.name, self.failures[key])
                     return -1.0, -1.0
         tp = s.tp if op.op_type in TP_CAPABLE else 1
+        if op.op_type == OpType.EXPERTS:
+            tp = s.ep
         return fwd / tp, (bwd / tp if bwd >= 0 else bwd)
 
     def _measure(self, op: Op, dp: int) -> Tuple[float, float]:
@@ -363,7 +394,8 @@ class Simulator:
 
     def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
         fwd, bwd = self.fwd_bwd_time_us(op, s)
-        return fwd + bwd + self.cost.tp_collective_time_us(op, s)
+        return (fwd + bwd + self.cost.tp_collective_time_us(op, s)
+                + self.cost.ep_collective_time_us(op, s))
 
     def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         """Per-iteration time (us) of the graph under per-op strategies."""
@@ -374,7 +406,8 @@ class Simulator:
         for op in graph.topo_order():
             s = strategies.get(op.guid, default)
             fwd, bwd = self.fwd_bwd_time_us(op, s)
-            total += fwd + bwd + self.cost.tp_collective_time_us(op, s)
+            total += (fwd + bwd + self.cost.tp_collective_time_us(op, s)
+                      + self.cost.ep_collective_time_us(op, s))
             bwd_total += bwd
             grad_sync += self.cost.grad_sync_time_us(op, s)
             for t in op.inputs:

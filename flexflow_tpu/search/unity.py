@@ -47,8 +47,8 @@ def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
 
 
 def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
-                     config) -> List[OpStrategy]:
-    """Strategy menu for one op under a (dp, tp) mesh (reference:
+                     config, ep: int = 1) -> List[OpStrategy]:
+    """Strategy menu for one op under a (dp, tp[, ep]) mesh (reference:
     get_valid_machine_views, graph.h:205-210)."""
     menu = []
     dps = [d for d in (dp, 1) if batch_size % max(d, 1) == 0]
@@ -62,9 +62,18 @@ def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
     ):
         if _tp_divides(op, tp):
             tps = [tp, 1]
+    eps = [1]
+    if (
+        ep > 1
+        and op.op_type == OpType.EXPERTS
+        and op.params["n"] % ep == 0
+        and not config.only_data_parallel
+    ):
+        eps = [ep, 1]
     for d in dps:
         for t in tps:
-            menu.append(OpStrategy(dp=d, tp=t))
+            for e in eps:
+                menu.append(OpStrategy(dp=d, tp=t, ep=e))
     return menu
 
 
@@ -143,15 +152,16 @@ class GraphSearchHelper:
         return self.sim.simulate(seg_graph, strategies)
 
     def _optimize_segment(self, seg: List[Op], dp: int, tp: int,
-                          batch: int) -> Dict[int, OpStrategy]:
-        key = (tuple(op.guid for op in seg), dp, tp)
+                          batch: int, ep: int = 1) -> Dict[int, OpStrategy]:
+        key = (tuple(op.guid for op in seg), dp, tp, ep)
         if key in self._memo:
             return self._memo[key]
         seg_graph = Graph(seg)
         # seed: per-op greedy best in isolation
         strategies = {}
         for op in seg:
-            menu = [s for s in valid_strategies(op, dp, tp, batch, self.config)
+            menu = [s for s in valid_strategies(op, dp, tp, batch, self.config,
+                                                ep=ep)
                     if self._tp_ok(op, s)]
             strategies[op.guid] = min(
                 menu, key=lambda s: self.sim.op_step_time_us(op, s)
@@ -172,7 +182,8 @@ class GraphSearchHelper:
             if cost > best_cost * alpha:
                 continue  # prune (reference: substitution.cc:2278)
             for op in seg:
-                for s in valid_strategies(op, dp, tp, batch, self.config):
+                for s in valid_strategies(op, dp, tp, batch, self.config,
+                                          ep=ep):
                     if s == cur[op.guid]:
                         continue
                     if not self._tp_ok(op, s):
@@ -204,15 +215,27 @@ class GraphSearchHelper:
             self._load_tp_candidates(spec)
 
         candidates: List[SearchResult] = []
-        pairs = _divisor_pairs(n_devices)
+        # expert axis only enumerated when the graph has EXPERTS ops (the ep
+        # factor must divide every op's expert count to be proposable)
+        expert_counts = {op.params["n"] for op in self.graph.ops.values()
+                         if op.op_type == OpType.EXPERTS}
+        triples = []
+        for dp, rest in _divisor_pairs(n_devices):
+            if expert_counts:
+                for tp, ep in _divisor_pairs(rest):
+                    if ep == 1 or all(n % ep == 0 for n in expert_counts):
+                        triples.append((dp, tp, ep))
+            else:
+                triples.append((dp, rest, 1))
         if self.config.only_data_parallel:
-            pairs = [(n_devices, 1)]
-        for dp, tp in pairs:
+            triples = [(n_devices, 1, 1)]
+        for dp, tp, ep in triples:
             if batch_size % dp != 0:
                 continue
             strategies: Dict[int, OpStrategy] = {}
             for seg in self._segments():
-                strategies.update(self._optimize_segment(seg, dp, tp, batch_size))
+                strategies.update(
+                    self._optimize_segment(seg, dp, tp, batch_size, ep=ep))
             cost = self.sim.simulate(self.graph, strategies)
             mem = self.sim.memory_bytes(self.graph, strategies)
             if memory_budget_bytes is not None:
@@ -220,8 +243,10 @@ class GraphSearchHelper:
                     cost, mem, memory_budget_bytes, strategies
                 )
             candidates.append(
-                SearchResult(strategies, self._axes(dp, tp, strategies), cost, mem,
-                             [f"dp={dp} tp={tp} cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
+                SearchResult(strategies, self._axes(dp, tp, strategies, ep),
+                             cost, mem,
+                             [f"dp={dp} tp={tp} ep={ep} cost={cost:.1f}us "
+                              f"mem={mem/1e9:.2f}GB"])
             )
         if not candidates:
             raise ValueError("no feasible mesh factorization")
@@ -250,12 +275,15 @@ class GraphSearchHelper:
         overflow = (mem - budget) / budget
         return cost * (1.0 + 10.0 * overflow)
 
-    def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy]) -> Dict[str, int]:
+    def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],
+              ep: int = 1) -> Dict[str, int]:
         axes = {}
         if dp > 1 and any(s.dp > 1 for s in strategies.values()):
             axes["data"] = dp
         if tp > 1 and any(s.tp > 1 for s in strategies.values()):
             axes["model"] = tp
+        if ep > 1 and any(s.ep > 1 for s in strategies.values()):
+            axes["expert"] = ep
         return axes
 
 
@@ -297,9 +325,10 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                               measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
-    # a TASO rule file constrains the TP menu — only the Python search
-    # implements that, so it owns the rule-file path
-    if (simulator is None and not is_taso
+    # a TASO rule file constrains the TP menu, and expert parallelism is a
+    # Python-search capability — only the Python search implements those
+    has_experts = any(op.op_type == OpType.EXPERTS for op in graph.ops.values())
+    if (simulator is None and not is_taso and not has_experts
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -326,7 +355,7 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         "cost_us": result.cost_us,
         "memory_bytes": result.memory_bytes,
         "ops": {
-            graph.ops[guid].name: {"dp": s.dp, "tp": s.tp}
+            graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep}
             for guid, s in result.strategies.items()
             if guid in graph.ops
         },
@@ -343,5 +372,6 @@ def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dic
     strategies = {}
     for name, s in data["ops"].items():
         if name in by_name:
-            strategies[by_name[name].guid] = OpStrategy(dp=s["dp"], tp=s["tp"])
+            strategies[by_name[name].guid] = OpStrategy(
+                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1))
     return strategies, data.get("mesh_axes", {})

@@ -116,6 +116,10 @@ def main():
         results[tag] = {"ms": round(dt * 1e3, 2),
                         "samples_per_sec": round(batch / dt, 1)}
         print(tag, results[tag], flush=True)
+        # the jitted step donates its (params, opt_state, state) arguments —
+        # re-point the model at the live output buffers so later variants
+        # (trace/grad/fwd) don't touch donated arrays
+        model.params, model.opt_state, model.state = holder[:3]
         return model, inputs, label, key
 
     if "full" in variants:
@@ -126,6 +130,7 @@ def main():
                 for _ in range(3):
                     p, o, s, mv = model._train_step(p, o, s, inputs, label, key)
                 float(np.asarray(mv["loss"]))
+            model.params, model.opt_state, model.state = p, o, s  # donated
             print("trace written to", args.trace, flush=True)
 
         if "grad" in variants:
@@ -146,8 +151,9 @@ def main():
             print("grad", results["grad"], flush=True)
 
         if "fwd" in variants:
-            fstep = model.executor.build_forward(model._final_tensor)
             holder = [None]
+
+            fstep = model.executor.build_forward(model.final_tensor)
 
             def ffn():
                 holder[0] = fstep(model.params, model.state, inputs, key)

@@ -91,6 +91,32 @@ def test_expert_parallel_matches_replicated():
     assert spec[0] == "expert"
 
 
+def test_search_proposes_expert_parallelism():
+    """The Unity search enumerates the expert mesh axis for EXPERTS graphs
+    and — with expert FFN FLOPs dominating — selects an ep>1 strategy."""
+    B, F, n, k, H = 512, 1024, 8, 2, 4096
+    config = ff.FFConfig()
+    config.batch_size = B
+    config.search_budget = 4
+    model = ff.FFModel(config)
+    inp = model.create_tensor([B, F])
+    out = model.moe(inp, n, k, H, alpha=float(n), fused=True, name="moe")
+    model.dense(out, 3)
+
+    from flexflow_tpu.core.graph import Graph
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.unity import unity_optimize
+
+    machine = make_machine_model(config, 8)
+    result = unity_optimize(Graph(model.ops), config, machine, B, 8)
+    # the candidate list must include ep>1 factorizations
+    assert any("ep=2" in line or "ep=4" in line or "ep=8" in line
+               for line in result.log), result.log
+    # expert compute dominates this graph: the winning strategy shards it
+    assert result.mesh_axes.get("expert", 1) > 1, result.log
+    assert any(s.ep > 1 for s in result.strategies.values())
+
+
 def test_expert_parallel_trains():
     """One training step with dp x ep sharding runs and yields finite loss."""
     B, F, n, k, H = 8, 6, 4, 2, 6
