@@ -56,6 +56,11 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 1.2
     base_optimize_threshold: int = 10
+    # Joint substitution x parallelization search: graph rewrites are
+    # best-first search actions costed by their optimal parallelization
+    # (reference: base_optimize over candidate graphs, substitution.cc:2229).
+    # False = rewrites applied greedily before the strategy search.
+    joint_search: bool = True
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False

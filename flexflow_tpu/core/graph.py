@@ -165,6 +165,36 @@ class Graph:
         src_guids = {s.guid for s in sources}
         return [op for op in order if op.guid in common and op.guid not in src_guids]
 
+    # -- cloning (for search over candidate rewritten graphs) --------------
+    def clone(self) -> "Graph":
+        """Structural copy for substitution search: new Op shells (shared
+        weights/model refs — rewrites never mutate those) with copied params
+        and rewired cloned output tensors, so rule applications on the clone
+        leave this graph untouched. Tensor guids are preserved, keeping the
+        segment-DP memo (keyed by op guids) valid across candidates
+        (reference: candidate graphs in base_optimize share the same
+        simulator cache, substitution.cc:2229-2311)."""
+        import copy
+
+        new_ops: Dict[int, Op] = {}
+        tensor_map: Dict[int, Tensor] = {}
+        for g, op in self.ops.items():
+            new_op = copy.copy(op)
+            new_op.params = dict(op.params)
+            new_op.outputs = []
+            for t in op.outputs:
+                nt = copy.copy(t)
+                nt.owner_op = new_op
+                tensor_map[t.guid] = nt
+                new_op.outputs.append(nt)
+            new_ops[g] = new_op
+        for op in new_ops.values():
+            op.inputs = [tensor_map.get(t.guid, t) for t in op.inputs]
+        g2 = Graph.__new__(Graph)
+        g2.ops = new_ops
+        g2.tensor_aliases = {}
+        return g2
+
     # -- hashing (reference: graph.h:149 dp_state_hash) --------------------
     def hash(self) -> int:
         h = 0

@@ -592,11 +592,11 @@ class FFModel:
                 parallel_axes = {"data": n_dev} if n_dev > 1 else {}
         if self.config.only_data_parallel:
             parallel_axes = {"data": n_dev} if n_dev > 1 else {}
-        # substitutions may have removed/fused ops: follow tensor aliases and
-        # drop removed ops from the model so a re-compile() sees the rewritten
-        # graph, not the original op list
+        # substitutions may have removed/fused/created ops: follow tensor
+        # aliases and rebuild the op list from the (rewritten) graph so a
+        # re-compile() sees the rewritten graph, not the original op list
         self.final_tensor = self.graph.resolve_tensor(self.final_tensor)
-        self.ops = [op for op in self.ops if op.guid in self.graph.ops]
+        self.ops = list(self.graph.topo_order())
         self.parallel_axes = dict(parallel_axes)
         self._assign_strategy(self.parallel_axes)
 

@@ -119,12 +119,16 @@ class CostModel:
         n = op.params["n"]
         cap = moe_capacity(x.dims[0], op.inputs[2].dims[1], n,
                            op.params.get("alpha", 1.0))
-        # per-chip share of the dispatched capacity buffers (each chip holds
-        # n/ep experts' buffers for its dp slice of the batch)
-        buf_bytes = (n * cap * x.dims[1] * self.op_dtype_bytes(op)
-                     / max(1, s.dp * s.ep))
-        # dispatch + combine, each fwd and bwd
-        return 4.0 * self.machine.all_to_all_time_us(buf_bytes, s.ep)
+        # per-chip share of the capacity buffers (each chip holds n/ep
+        # experts' buffers for its dp slice of the batch): dispatch moves
+        # (n, cap, F) features in, combine moves (n, cap, out_dim) out
+        shard = max(1, s.dp * s.ep)
+        db = self.op_dtype_bytes(op)
+        disp_bytes = n * cap * x.dims[1] * db / shard
+        comb_bytes = n * cap * op.params["out_dim"] * db / shard
+        # each direction fwd + mirrored bwd
+        return 2.0 * (self.machine.all_to_all_time_us(disp_bytes, s.ep)
+                      + self.machine.all_to_all_time_us(comb_bytes, s.ep))
 
     def xfer_time_us(self, tensor_bytes: float, src: OpStrategy, dst: OpStrategy) -> float:
         """Reshard cost on an edge when producer/consumer batch degrees differ

@@ -175,6 +175,65 @@ def rule_drop_identity(graph: Graph) -> List[Application]:
     return apps
 
 
+def rule_merge_parallel_linears(graph: Graph) -> List[Application]:
+    """Two LINEAR ops sharing the same input tensor ==> one wider linear +
+    split (the TASO matmul-fusion pattern; reference: the fuse_
+    two-matmuls-into-concat rules in substitutions/graph_subst_3_v2.json and
+    create_xfers around OP_LINEAR/OP_CONCAT/OP_SPLIT).
+
+    NOT always beneficial: one wide GEMM tiles the MXU better, but the merged
+    out_dim constrains tensor parallelism to strategies that divide the SUM
+    of the two widths — so this is a *search action* explored jointly with
+    parallelization (unity._joint_optimize), never applied greedily."""
+    apps = []
+    by_input: Dict[int, List[Op]] = {}
+    for op in graph.topo_order():
+        if op.op_type != OpType.LINEAR:
+            continue
+        if op.params.get("activation", ActiMode.AC_MODE_NONE) != ActiMode.AC_MODE_NONE:
+            continue
+        if op.params.get("kernel_initializer") or op.params.get("bias_initializer"):
+            continue  # user-pinned init: widths are load-bearing
+        by_input.setdefault(op.inputs[0].guid, []).append(op)
+    for ops in by_input.values():
+        for i in range(len(ops)):
+            for j in range(i + 1, len(ops)):
+                a, b = ops[i], ops[j]
+                if a.params.get("use_bias", True) != b.params.get("use_bias", True):
+                    continue
+                if a.params.get("dtype") != b.params.get("dtype"):
+                    continue
+
+                def apply(a=a, b=b):
+                    from ..core.op import OP_REGISTRY
+                    from ..ffconst import OpType as OT
+
+                    da, db = a.params["out_dim"], b.params["out_dim"]
+                    merged = OP_REGISTRY[OT.LINEAR](
+                        a.model, [a.inputs[0]], f"{a.name}+{b.name}",
+                        out_dim=da + db,
+                        activation=ActiMode.AC_MODE_NONE,
+                        use_bias=a.params.get("use_bias", True),
+                        dtype=a.params.get("dtype"),
+                        kernel_initializer=None, bias_initializer=None,
+                    )
+                    split = OP_REGISTRY[OT.SPLIT](
+                        a.model, [merged.outputs[0]],
+                        f"{a.name}+{b.name}_split",
+                        sizes=[da, db], axis=-1,
+                    )
+                    graph.add_op(merged)
+                    graph.add_op(split)
+                    _rewire(graph, a.outputs[0], split.outputs[0])
+                    _rewire(graph, b.outputs[0], split.outputs[1])
+                    graph.remove_op(a)
+                    graph.remove_op(b)
+
+                apps.append(Application("merge_parallel_linears", apply,
+                                        f"{a.name}+{b.name}"))
+    return apps
+
+
 ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "fuse_linear_activation": rule_fuse_linear_activation,
     "merge_adjacent_reshape": rule_merge_adjacent_reshape,
@@ -182,6 +241,32 @@ ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "merge_scalar_chain": rule_merge_scalar_chain,
     "drop_identity": rule_drop_identity,
 }
+
+# Trade-off rewrites: benefit depends on the parallelization chosen, so they
+# are *search actions* explored by unity._joint_optimize (reference:
+# candidate graphs in base_optimize, substitution.cc:2229-2311), never part
+# of the greedy fixed-point pass above.
+SEARCH_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
+    "merge_parallel_linears": rule_merge_parallel_linears,
+}
+
+
+def search_rules_from_spec(spec, is_taso: bool, parsed=None) -> Dict[str, Callable]:
+    """Joint-search rewrite actions for a parsed --substitution-json spec.
+    No file: all built-in trade-off rules. TASO file: the templates its rules
+    activate (substitution_loader.xfer_templates_from_rules; pass the
+    pre-parsed Rule list via `parsed` to avoid re-parsing a multi-MB file).
+    Name-list file: the named subset."""
+    if spec is None:
+        return dict(SEARCH_RULES)
+    if is_taso:
+        from .substitution_loader import rules_from_spec, xfer_templates_from_rules
+
+        names = xfer_templates_from_rules(
+            parsed if parsed is not None else rules_from_spec(spec))
+        return {n: SEARCH_RULES[n] for n in names if n in SEARCH_RULES}
+    names = spec.get("rules", [])
+    return {n: SEARCH_RULES[n] for n in names if n in SEARCH_RULES}
 
 
 def load_rule_spec(json_path: Optional[str]):

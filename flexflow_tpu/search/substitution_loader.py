@@ -185,6 +185,30 @@ def summarize(rules: List[Rule]) -> Dict[str, int]:
     }
 
 
+def xfer_templates_from_rules(rules: List[Rule]) -> List[str]:
+    """Map loaded TASO rules onto the implemented algebraic rewrite templates
+    (search/substitution.py SEARCH_RULES). The reference interprets each rule
+    as a GraphXfer; here rules are distilled: a rule family whose source
+    pattern matches one of our rewrite templates activates that template as a
+    joint-search action. Currently recognized:
+
+    - merge_parallel_linears: rules fusing two OP_LINEARs through an
+      OP_CONCAT (38 such rules in graph_subst_3_v2.json — the TASO
+      matmul-fusion family).
+    """
+    templates: List[str] = []
+    for r in rules:
+        if not r.is_supported:
+            continue
+        src_types = [o.op_type for o in r.src_ops]
+        all_types = src_types + [o.op_type for o in r.dst_ops]
+        if (src_types.count(OpType.LINEAR) >= 2
+                and OpType.CONCAT in all_types
+                and "merge_parallel_linears" not in templates):
+            templates.append("merge_parallel_linears")
+    return templates
+
+
 def tp_candidates_from_rules(rules: List[Rule]) -> Dict[OpType, List[int]]:
     """Distill loaded rules into per-op-type candidate parallel degrees for
     the Unity search (the role GraphXfer candidates play in base_optimize:

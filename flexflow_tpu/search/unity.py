@@ -113,7 +113,7 @@ class GraphSearchHelper:
         # (None = no file: every type may TP at any mesh degree)
         self._tp_menu = None
 
-    def _load_tp_candidates(self, spec) -> None:
+    def _load_tp_candidates(self, spec, parsed=None) -> None:
         """Distill a parsed TASO RuleCollection (--substitution-json) into
         per-op-type candidate TP degrees (reference role: create_xfers
         building GraphXfers from loaded rules, substitution.h:119-121)."""
@@ -123,7 +123,7 @@ class GraphSearchHelper:
             tp_candidates_from_rules,
         )
 
-        rules = rules_from_spec(spec)
+        rules = parsed if parsed is not None else rules_from_spec(spec)
         self._tp_menu = {t: set(degs)
                          for t, degs in tp_candidates_from_rules(rules).items()}
         self.log.append(
@@ -138,9 +138,10 @@ class GraphSearchHelper:
         return s.tp in self._tp_menu.get(op.op_type, ())
 
     # -- sequence split (reference: generic_sequence_optimize, memoized) --
-    def _segments(self) -> List[List[Op]]:
-        order = self.graph.topo_order()
-        bottlenecks = {op.guid for op in self.graph.bottleneck_nodes()}
+    def _segments(self, graph: Optional[Graph] = None) -> List[List[Op]]:
+        graph = graph if graph is not None else self.graph
+        order = graph.topo_order()
+        bottlenecks = {op.guid for op in graph.bottleneck_nodes()}
         segments: List[List[Op]] = [[]]
         for op in order:
             segments[-1].append(op)
@@ -202,22 +203,64 @@ class GraphSearchHelper:
     def graph_optimize(self, batch_size: int, n_devices: int,
                        memory_budget_bytes: Optional[float] = None,
                        rule_spec=None) -> SearchResult:
-        from .substitution import load_rule_spec, rule_set_from_spec, apply_substitutions
+        from .substitution import (
+            apply_substitutions,
+            load_rule_spec,
+            rule_set_from_spec,
+            search_rules_from_spec,
+        )
 
-        # rule_spec: optional pre-parsed (spec, is_taso) from unity_optimize,
-        # avoiding a second read of a potentially multi-MB rule file
-        spec, is_taso = (rule_spec if rule_spec is not None
-                         else load_rule_spec(self.config.substitution_json_path))
+        # rule_spec: optional pre-parsed (spec, is_taso[, taso_rules]) from
+        # unity_optimize, avoiding re-reads/re-parses of a multi-MB rule file
+        if rule_spec is None:
+            rule_spec = load_rule_spec(self.config.substitution_json_path)
+        spec, is_taso = rule_spec[0], rule_spec[1]
+        taso_rules = rule_spec[2] if len(rule_spec) > 2 else None
+        # strictly-shrinking rewrites (every application removes ops under
+        # any strategy) are applied greedily to fixed point; trade-off
+        # rewrites are joint-search actions below
         applied = apply_substitutions(self.graph, rule_set_from_spec(spec, is_taso))
         if applied:
             self.log.append(f"substitutions: {applied}")
         if is_taso:
-            self._load_tp_candidates(spec)
+            self._load_tp_candidates(spec, parsed=taso_rules)
 
+        search_rules = search_rules_from_spec(spec, is_taso, parsed=taso_rules)
+        if (getattr(self.config, "joint_search", True) and search_rules
+                and self.config.search_budget > 0):
+            best = self._joint_optimize(search_rules, batch_size, n_devices,
+                                        memory_budget_bytes)
+        else:
+            # joint search off: trade-off rewrites degrade to the greedy
+            # fixed-point pass (the pre-round-3 behavior, kept as the
+            # comparison baseline)
+            if search_rules:
+                applied2 = apply_substitutions(self.graph, search_rules)
+                if applied2:
+                    self.log.append(f"greedy substitutions: {applied2}")
+            best = self._parallelize(self.graph, batch_size, n_devices,
+                                     memory_budget_bytes)
+        self.log.append(f"selected: {best.log[-1] if best.log else ''}")
+        if self.sim.measured is not None:
+            self.log.append(
+                self.sim.measured.stats()
+                + f"; {self.sim.analytic_fallbacks} analytic fallbacks"
+            )
+            _log.info(self.log[-1])
+            self.sim.measured.save()
+        best.log = self.log
+        return best
+
+    def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
+                     memory_budget_bytes: Optional[float] = None,
+                     quiet: bool = False) -> SearchResult:
+        """Best parallelization of a fixed graph: enumerate mesh
+        factorizations, segment-DP each (reference: Graph::optimal_cost via
+        the DP in graph.cc:1586)."""
         candidates: List[SearchResult] = []
         # expert axis only enumerated when the graph has EXPERTS ops (the ep
         # factor must divide every op's expert count to be proposable)
-        expert_counts = {op.params["n"] for op in self.graph.ops.values()
+        expert_counts = {op.params["n"] for op in graph.ops.values()
                          if op.op_type == OpType.EXPERTS}
         triples = []
         for dp, rest in _divisor_pairs(n_devices):
@@ -233,11 +276,11 @@ class GraphSearchHelper:
             if batch_size % dp != 0:
                 continue
             strategies: Dict[int, OpStrategy] = {}
-            for seg in self._segments():
+            for seg in self._segments(graph):
                 strategies.update(
                     self._optimize_segment(seg, dp, tp, batch_size, ep=ep))
-            cost = self.sim.simulate(self.graph, strategies)
-            mem = self.sim.memory_bytes(self.graph, strategies)
+            cost = self.sim.simulate(graph, strategies)
+            mem = self.sim.memory_bytes(graph, strategies)
             if memory_budget_bytes is not None:
                 cost = self._memory_adjusted_cost(
                     cost, mem, memory_budget_bytes, strategies
@@ -251,17 +294,88 @@ class GraphSearchHelper:
         if not candidates:
             raise ValueError("no feasible mesh factorization")
         best = min(candidates, key=lambda r: r.cost_us)
-        self.log.extend(c.log[0] for c in candidates)
-        self.log.append(f"selected: {best.log[0]}")
-        if self.sim.measured is not None:
-            self.log.append(
-                self.sim.measured.stats()
-                + f"; {self.sim.analytic_fallbacks} analytic fallbacks"
-            )
-            _log.info(self.log[-1])
-            self.sim.measured.save()
-        best.log = self.log
+        if not quiet:
+            self.log.extend(c.log[0] for c in candidates)
         return best
+
+    def _joint_optimize(self, rules, batch_size: int, n_devices: int,
+                        memory_budget_bytes: Optional[float] = None
+                        ) -> SearchResult:
+        """Joint substitution x parallelization search (reference:
+        GraphSearchHelper::base_optimize, substitution.cc:2229-2311):
+        best-first over candidate *graphs* — each neighbor is one rewrite
+        application — where a candidate's cost is its optimal parallelization
+        (_parallelize). Candidates are deduplicated by graph hash; the
+        segment-DP memo is shared across candidates because clones preserve
+        op guids, so only rewritten segments re-cost."""
+        base = self.graph
+        best_res = self._parallelize(base, batch_size, n_devices,
+                                     memory_budget_bytes)
+        best_cost = best_res.cost_us
+        best_seq: List[Tuple[str, str]] = []
+        self.log.append(f"joint: base cost={best_cost:.1f}us")
+        visited = {base.hash()}
+        counter = itertools.count()
+        pq = [(best_cost, next(counter), base, [])]
+        pops = 0
+        budget = max(0, self.config.search_budget)
+        alpha = self.config.search_alpha
+        while pq and pops < budget:
+            cost, _, g, seq = heapq.heappop(pq)
+            pops += 1
+            if cost > best_cost * alpha:
+                continue  # prune (reference: substitution.cc:2278)
+            apps = []
+            for fn in rules.values():
+                apps.extend(fn(g))
+            for app in apps:
+                g2 = g.clone()
+                match = self._find_app(g2, rules, app.rule, app.description)
+                if match is None:
+                    continue
+                match.apply()
+                h = g2.hash()
+                if h in visited:
+                    continue
+                visited.add(h)
+                try:
+                    r2 = self._parallelize(g2, batch_size, n_devices,
+                                           memory_budget_bytes, quiet=True)
+                except Exception as exc:  # infeasible rewrite: skip, log
+                    self.log.append(
+                        f"joint: {app.rule}({app.description}) infeasible: {exc}")
+                    continue
+                seq2 = seq + [(app.rule, app.description)]
+                self.log.append(
+                    f"joint: {app.rule}({app.description}) -> "
+                    f"{r2.cost_us:.1f}us")
+                if r2.cost_us < best_cost:
+                    best_cost, best_res, best_seq = r2.cost_us, r2, seq2
+                if r2.cost_us < cost * alpha:
+                    heapq.heappush(pq, (r2.cost_us, next(counter), g2, seq2))
+        if best_seq:
+            # materialize the winning rewrites on the real graph, then
+            # re-cost it so strategies key to the real (fresh) op guids
+            for rule_name, desc in best_seq:
+                match = self._find_app(self.graph, rules, rule_name, desc)
+                if match is None:
+                    raise RuntimeError(
+                        f"joint search: rewrite {rule_name}({desc}) did not "
+                        "re-match on the original graph")
+                match.apply()
+            self.log.append(f"joint: applied {best_seq}")
+            best_res = self._parallelize(self.graph, batch_size, n_devices,
+                                         memory_budget_bytes, quiet=True)
+            self.log.append(
+                f"joint: post-rewrite {best_res.log[0] if best_res.log else ''}")
+        return best_res
+
+    @staticmethod
+    def _find_app(graph: Graph, rules, rule_name: str, description: str):
+        for a in rules[rule_name](graph):
+            if a.description == description:
+                return a
+        return None
 
     def _memory_adjusted_cost(self, cost, mem, budget, strategies) -> float:
         """Memory-aware objective (reference role: the lambda-weighted
@@ -325,10 +439,30 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                               measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
-    # a TASO rule file constrains the TP menu, and expert parallelism is a
-    # Python-search capability — only the Python search implements those
+    # a TASO rule file constrains the TP menu; expert parallelism and the
+    # joint substitution search are Python-search capabilities — only the
+    # Python search implements those
+    from .substitution import search_rules_from_spec
+
     has_experts = any(op.op_type == OpType.EXPERTS for op in graph.ops.values())
+    # parse TASO Rule objects once; threaded to every consumer below
+    taso_rules = None
+    if is_taso:
+        from .substitution_loader import rules_from_spec
+
+        taso_rules = rules_from_spec(spec)
+    # trade-off rewrites (joint-search actions, or the greedy fallback when
+    # joint_search=False) only exist on the Python path — route there
+    # whenever any rewrite matches, so native availability never changes
+    # which graph a config compiles
+    rewrites_applicable = (
+        config.search_budget > 0
+        and any(fn(graph)
+                for fn in search_rules_from_spec(
+                    spec, is_taso, parsed=taso_rules).values())
+    )
     if (simulator is None and not is_taso and not has_experts
+            and not rewrites_applicable
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -345,7 +479,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     if config.memory_search:
         budget = config.memory_budget_mb * 1e6
     return helper.graph_optimize(batch_size, n_devices, budget,
-                                 rule_spec=(spec, is_taso))
+                                 rule_spec=(spec, is_taso, taso_rules))
 
 
 def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
