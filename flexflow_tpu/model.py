@@ -698,11 +698,16 @@ class FFModel:
         dp = axes.get("data", 1)
         tp = axes.get("model", 1)
         view = MachineView(axes=tuple(axes.items()))
+        ap_axis = axes.get("attr", 1)
+        from .search.simulator import AP_CAPABLE
+
         for op in self.graph.topo_order():
             # per-op search result overrides the mesh-wide default
             s = (self._op_strategies or {}).get(op.guid)
             op_dp = min(s.dp, dp) if s else dp
             op_tp = min(s.tp, tp) if s else tp
+            op_ap = min(s.ap, ap_axis) if s else ap_axis
+            spatial = (op_ap > 1 and op.op_type in AP_CAPABLE)
             op.machine_view = view
             for t in list(op.outputs):
                 dims = []
@@ -710,6 +715,14 @@ class FFModel:
                     if i == 0 and op_dp > 1 and size == batch and size % op_dp == 0:
                         dims.append(
                             ParallelDim(size, op_dp, "data", kind=ParallelDimKind.SAMPLE)
+                        )
+                    elif (i == 2 and spatial and len(t.dims) == 4
+                          and size % op_ap == 0):
+                        # attribute/spatial parallelism: H over 'attr'
+                        # (GSPMD inserts the conv halo exchanges)
+                        dims.append(
+                            ParallelDim(size, op_ap, "attr",
+                                        kind=ParallelDimKind.ATTRIBUTE)
                         )
                     else:
                         dims.append(ParallelDim(size, 1, None))

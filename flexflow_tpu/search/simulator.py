@@ -41,17 +41,19 @@ from .machine_model import MachineModel
 @dataclasses.dataclass(frozen=True)
 class OpStrategy:
     """Parallelization of one op: batch-dim degree (dp), channel/heads degree
-    (tp), and expert degree (ep, EXPERTS ops only). The reference expresses
-    the same thing as a MachineView + per-dim degrees on the op's
-    ParallelTensors."""
+    (tp), expert degree (ep, EXPERTS ops only), and attribute/spatial degree
+    (ap: conv/pool H sharding, reference create_mapping_xfers<Conv2D/Pool2D>,
+    substitution.cc:1795-1797). The reference expresses the same thing as a
+    MachineView + per-dim degrees on the op's ParallelTensors."""
 
     dp: int = 1
     tp: int = 1
     ep: int = 1
+    ap: int = 1
 
     @property
     def degree(self) -> int:
-        return self.dp * self.tp * self.ep
+        return self.dp * self.tp * self.ep * self.ap
 
 
 # ops whose weights/channels can shard over the model axis (reference:
@@ -62,6 +64,15 @@ TP_CAPABLE = {
     OpType.MULTIHEAD_ATTENTION,
     OpType.EMBEDDING,
     OpType.BATCHMATMUL,
+}
+
+# ops whose spatial (H) dim can shard over the 'attr' mesh axis — GSPMD
+# inserts the halo exchanges (reference: attribute parallelism via
+# create_mapping_xfers<Conv2D/Pool2D/Flat>, substitution.cc:1795-1797,
+# gated by --enable-attribute-parallel, config.h:136)
+AP_CAPABLE = {
+    OpType.CONV2D,
+    OpType.POOL2D,
 }
 
 _MEMORY_BOUND_BWD_FACTOR = 2.0  # bwd ≈ 2x fwd cost (two grad GEMMs per GEMM)
@@ -87,6 +98,8 @@ class CostModel:
         shards = s.dp * (s.tp if op.op_type in TP_CAPABLE else 1)
         if op.op_type == OpType.EXPERTS:
             shards *= s.ep
+        if op.op_type in AP_CAPABLE:
+            shards *= s.ap
         flops = op.flops() / max(1, shards)
         bytes_ = op.bytes_accessed() / max(1, shards)
         return self.machine.compute_time_us(flops, bytes_, self.op_dtype_bytes(op))
@@ -106,6 +119,27 @@ class CostModel:
         # fwd allgather + bwd reduce_scatter of the same bytes
         return self.machine.allgather_time_us(bytes_ / s.tp, s.tp) + \
             self.machine.reduce_scatter_time_us(bytes_, s.tp)
+
+    def ap_halo_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Halo exchange cost of spatial (H) sharding: each chip swaps the
+        kernel-overlap boundary rows with its neighbors per step (GSPMD
+        emits collective-permutes for the sharded conv). kernel_h == stride_h
+        (1x1 convs, non-overlapping pools) needs no halo and costs none."""
+        if s.ap <= 1 or op.op_type not in AP_CAPABLE or not op.inputs:
+            return 0.0
+        x = op.inputs[0]  # NCHW
+        if len(x.dims) != 4:
+            return 0.0
+        kh = op.params.get("kernel_h", 1)
+        stride = max(1, op.params.get("stride_h", 1))
+        halo_rows = max(0, kh - stride)
+        if halo_rows == 0:
+            return 0.0
+        b, c, _, w = x.dims
+        halo_bytes = (b / max(1, s.dp)) * c * halo_rows * w * \
+            self.op_dtype_bytes(op)
+        # exchanged once fwd + mirrored bwd
+        return 2.0 * self.machine.p2p_time_us(halo_bytes)
 
     def ep_collective_time_us(self, op: Op, s: OpStrategy) -> float:
         """Token routing cost of expert parallelism: all_to_all of the
@@ -144,13 +178,16 @@ class CostModel:
     def grad_sync_time_us(self, op: Op, s: OpStrategy) -> float:
         """Weight-gradient allreduce over the data axis (reference: NCCL
         allreduce inside the optimizer update task, optimizer_kernel.cu:88)."""
-        if s.dp <= 1 or not op.weights:
+        # weights are replicated across attr shards too: their grads
+        # all-reduce over the dp x ap group
+        sync = s.dp * (s.ap if op.op_type in AP_CAPABLE else 1)
+        if sync <= 1 or not op.weights:
             return 0.0
         wshard = s.ep if op.op_type == OpType.EXPERTS else s.tp
         wb = sum(
             w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights
         ) / max(1, wshard)
-        return self.machine.allreduce_time_us(wb, s.dp)
+        return self.machine.allreduce_time_us(wb, sync)
 
     def op_memory_bytes(self, op: Op, s: OpStrategy) -> float:
         """Per-chip memory: sharded weights (x3 for Adam m,v) + activations."""
@@ -160,9 +197,13 @@ class CostModel:
             wshard = s.ep
         wb /= max(1, wshard)
         ab = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs)
-        # activations shard over dp (and tp for TP ops); EXPERTS outputs are
-        # data-sharded only — the expert axis shards weights/buffers, not them
-        ab /= max(1, s.dp * (s.tp if op.op_type in TP_CAPABLE else 1))
+        # activations shard over dp (tp for TP ops, ap for spatial ops);
+        # EXPERTS outputs are data-sharded only — the expert axis shards
+        # weights/buffers, not them
+        ashard = s.dp * (s.tp if op.op_type in TP_CAPABLE else 1)
+        if op.op_type in AP_CAPABLE:
+            ashard *= s.ap
+        ab /= max(1, ashard)
         return 3.0 * wb + ab
 
 
@@ -283,6 +324,8 @@ class OpCostCache:
         tp = s.tp if op.op_type in TP_CAPABLE else 1
         if op.op_type == OpType.EXPERTS:
             tp = s.ep
+        elif op.op_type in AP_CAPABLE:
+            tp = s.ap
         return fwd / tp, (bwd / tp if bwd >= 0 else bwd)
 
     def _measure(self, op: Op, dp: int) -> Tuple[float, float]:
@@ -399,7 +442,8 @@ class Simulator:
     def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
         fwd, bwd = self.fwd_bwd_time_us(op, s)
         return (fwd + bwd + self.cost.tp_collective_time_us(op, s)
-                + self.cost.ep_collective_time_us(op, s))
+                + self.cost.ep_collective_time_us(op, s)
+                + self.cost.ap_halo_time_us(op, s))
 
     def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         """Per-iteration time (us) of the graph under per-op strategies."""
@@ -411,7 +455,8 @@ class Simulator:
             s = strategies.get(op.guid, default)
             fwd, bwd = self.fwd_bwd_time_us(op, s)
             total += (fwd + bwd + self.cost.tp_collective_time_us(op, s)
-                      + self.cost.ep_collective_time_us(op, s))
+                      + self.cost.ep_collective_time_us(op, s)
+                      + self.cost.ap_halo_time_us(op, s))
             bwd_total += bwd
             grad_sync += self.cost.grad_sync_time_us(op, s)
             for t in op.inputs:

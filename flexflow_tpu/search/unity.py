@@ -35,7 +35,7 @@ from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
 from .machine_model import MachineModel
-from .simulator import OpStrategy, Simulator, TP_CAPABLE
+from .simulator import AP_CAPABLE, OpStrategy, Simulator, TP_CAPABLE
 
 
 def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
@@ -47,7 +47,7 @@ def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
 
 
 def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
-                     config, ep: int = 1) -> List[OpStrategy]:
+                     config, ep: int = 1, ap: int = 1) -> List[OpStrategy]:
     """Strategy menu for one op under a (dp, tp[, ep]) mesh (reference:
     get_valid_machine_views, graph.h:205-210)."""
     menu = []
@@ -70,11 +70,34 @@ def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
         and not config.only_data_parallel
     ):
         eps = [ep, 1]
+    aps = [1]
+    if (
+        ap > 1
+        and op.op_type in AP_CAPABLE
+        and config.enable_attribute_parallel
+        and not config.only_data_parallel
+        and _ap_divides(op, ap)
+    ):
+        aps = [ap, 1]
     for d in dps:
         for t in tps:
             for e in eps:
-                menu.append(OpStrategy(dp=d, tp=t, ep=e))
+                for a in aps:
+                    menu.append(OpStrategy(dp=d, tp=t, ep=e, ap=a))
     return menu
+
+
+def _ap_divides(op: Op, ap: int) -> bool:
+    """Spatial split: input AND output H must divide evenly (the annotation
+    in _assign_strategy shards the output H) and shards must stride-align."""
+    x = op.inputs[0]
+    if len(x.dims) != 4 or not op.outputs or len(op.outputs[0].dims) != 4:
+        return False
+    h = x.dims[2]
+    out_h = op.outputs[0].dims[2]
+    stride = op.params.get("stride_h", 1)
+    return (h % ap == 0 and out_h % ap == 0
+            and (h // ap) % max(1, stride) == 0)
 
 
 def _tp_divides(op: Op, tp: int) -> bool:
@@ -153,8 +176,9 @@ class GraphSearchHelper:
         return self.sim.simulate(seg_graph, strategies)
 
     def _optimize_segment(self, seg: List[Op], dp: int, tp: int,
-                          batch: int, ep: int = 1) -> Dict[int, OpStrategy]:
-        key = (tuple(op.guid for op in seg), dp, tp, ep)
+                          batch: int, ep: int = 1, ap: int = 1
+                          ) -> Dict[int, OpStrategy]:
+        key = (tuple(op.guid for op in seg), dp, tp, ep, ap)
         if key in self._memo:
             return self._memo[key]
         seg_graph = Graph(seg)
@@ -162,7 +186,7 @@ class GraphSearchHelper:
         strategies = {}
         for op in seg:
             menu = [s for s in valid_strategies(op, dp, tp, batch, self.config,
-                                                ep=ep)
+                                                ep=ep, ap=ap)
                     if self._tp_ok(op, s)]
             strategies[op.guid] = min(
                 menu, key=lambda s: self.sim.op_step_time_us(op, s)
@@ -184,7 +208,7 @@ class GraphSearchHelper:
                 continue  # prune (reference: substitution.cc:2278)
             for op in seg:
                 for s in valid_strategies(op, dp, tp, batch, self.config,
-                                          ep=ep):
+                                          ep=ep, ap=ap):
                     if s == cur[op.guid]:
                         continue
                     if not self._tp_ok(op, s):
@@ -231,10 +255,12 @@ class GraphSearchHelper:
             best = self._joint_optimize(search_rules, batch_size, n_devices,
                                         memory_budget_bytes)
         else:
-            # joint search off: trade-off rewrites degrade to the greedy
-            # fixed-point pass (the pre-round-3 behavior, kept as the
-            # comparison baseline)
-            if search_rules:
+            # joint_search=False: trade-off rewrites degrade to the greedy
+            # fixed-point pass (the comparison baseline). joint_search=True
+            # with no budget applies none — matching the native-path gate so
+            # native availability never changes the compiled graph.
+            if (search_rules and self.config.search_budget > 0
+                    and not getattr(self.config, "joint_search", True)):
                 applied2 = apply_substitutions(self.graph, search_rules)
                 if applied2:
                     self.log.append(f"greedy substitutions: {applied2}")
@@ -258,27 +284,34 @@ class GraphSearchHelper:
         factorizations, segment-DP each (reference: Graph::optimal_cost via
         the DP in graph.cc:1586)."""
         candidates: List[SearchResult] = []
-        # expert axis only enumerated when the graph has EXPERTS ops (the ep
-        # factor must divide every op's expert count to be proposable)
+        # extra axes only enumerated when usable: 'expert' when the graph has
+        # EXPERTS ops (ep must divide every expert count), 'attr' when
+        # --enable-attribute-parallel and the graph has spatial ops
         expert_counts = {op.params["n"] for op in graph.ops.values()
                          if op.op_type == OpType.EXPERTS}
-        triples = []
+        has_spatial = (self.config.enable_attribute_parallel
+                       and any(op.op_type in AP_CAPABLE
+                               for op in graph.ops.values()))
+        quads = []
         for dp, rest in _divisor_pairs(n_devices):
-            if expert_counts:
-                for tp, ep in _divisor_pairs(rest):
-                    if ep == 1 or all(n % ep == 0 for n in expert_counts):
-                        triples.append((dp, tp, ep))
-            else:
-                triples.append((dp, rest, 1))
+            for tp, rest2 in _divisor_pairs(rest):
+                for ep, ap in _divisor_pairs(rest2):
+                    if ep > 1 and not (expert_counts and all(
+                            n % ep == 0 for n in expert_counts)):
+                        continue
+                    if ap > 1 and not has_spatial:
+                        continue
+                    quads.append((dp, tp, ep, ap))
         if self.config.only_data_parallel:
-            triples = [(n_devices, 1, 1)]
-        for dp, tp, ep in triples:
+            quads = [(n_devices, 1, 1, 1)]
+        for dp, tp, ep, ap in quads:
             if batch_size % dp != 0:
                 continue
             strategies: Dict[int, OpStrategy] = {}
             for seg in self._segments(graph):
                 strategies.update(
-                    self._optimize_segment(seg, dp, tp, batch_size, ep=ep))
+                    self._optimize_segment(seg, dp, tp, batch_size,
+                                           ep=ep, ap=ap))
             cost = self.sim.simulate(graph, strategies)
             mem = self.sim.memory_bytes(graph, strategies)
             if memory_budget_bytes is not None:
@@ -286,10 +319,11 @@ class GraphSearchHelper:
                     cost, mem, memory_budget_bytes, strategies
                 )
             candidates.append(
-                SearchResult(strategies, self._axes(dp, tp, strategies, ep),
+                SearchResult(strategies,
+                             self._axes(dp, tp, strategies, ep, ap),
                              cost, mem,
-                             [f"dp={dp} tp={tp} ep={ep} cost={cost:.1f}us "
-                              f"mem={mem/1e9:.2f}GB"])
+                             [f"dp={dp} tp={tp} ep={ep} ap={ap} "
+                              f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
             )
         if not candidates:
             raise ValueError("no feasible mesh factorization")
@@ -390,7 +424,7 @@ class GraphSearchHelper:
         return cost * (1.0 + 10.0 * overflow)
 
     def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],
-              ep: int = 1) -> Dict[str, int]:
+              ep: int = 1, ap: int = 1) -> Dict[str, int]:
         axes = {}
         if dp > 1 and any(s.dp > 1 for s in strategies.values()):
             axes["data"] = dp
@@ -398,6 +432,8 @@ class GraphSearchHelper:
             axes["model"] = tp
         if ep > 1 and any(s.ep > 1 for s in strategies.values()):
             axes["expert"] = ep
+        if ap > 1 and any(s.ap > 1 for s in strategies.values()):
+            axes["attr"] = ap
         return axes
 
 
@@ -445,6 +481,9 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     from .substitution import search_rules_from_spec
 
     has_experts = any(op.op_type == OpType.EXPERTS for op in graph.ops.values())
+    wants_attr = (config.enable_attribute_parallel
+                  and any(op.op_type in AP_CAPABLE
+                          for op in graph.ops.values()))
     # parse TASO Rule objects once; threaded to every consumer below
     taso_rules = None
     if is_taso:
@@ -462,7 +501,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                     spec, is_taso, parsed=taso_rules).values())
     )
     if (simulator is None and not is_taso and not has_experts
-            and not rewrites_applicable
+            and not wants_attr and not rewrites_applicable
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -489,7 +528,8 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         "cost_us": result.cost_us,
         "memory_bytes": result.memory_bytes,
         "ops": {
-            graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep}
+            graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
+                                   "ap": s.ap}
             for guid, s in result.strategies.items()
             if guid in graph.ops
         },
@@ -507,5 +547,5 @@ def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dic
     for name, s in data["ops"].items():
         if name in by_name:
             strategies[by_name[name].guid] = OpStrategy(
-                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1))
+                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1))
     return strategies, data.get("mesh_axes", {})
