@@ -21,10 +21,11 @@ class TransformerConfig:
 
 
 def _encoder_layer(ff, t, cfg: TransformerConfig, name: str,
-                   sequence_parallel: bool = False):
+                   sequence_parallel: bool = False, use_flash=None):
     attn = ff.multihead_attention(
         t, t, t, cfg.hidden_size, cfg.num_heads,
-        sequence_parallel=sequence_parallel, name=f"{name}_attn")
+        sequence_parallel=sequence_parallel, use_flash=use_flash,
+        name=f"{name}_attn")
     t = ff.layer_norm(ff.add(t, attn), [-1], name=f"{name}_ln1")
     h = ff.dense(t, cfg.hidden_size * cfg.ffn_mult, ActiMode.AC_MODE_GELU,
                  name=f"{name}_ff1")
@@ -47,15 +48,18 @@ def build_transformer(model, input, cfg: TransformerConfig = None,
 
 
 def build_bert_encoder(model, token_input, cfg: TransformerConfig = None,
-                       num_classes: int = 2, sequence_parallel: bool = False):
+                       num_classes: int = 2, sequence_parallel: bool = False,
+                       use_flash=None):
     """Token ids → embedding → encoder stack → classifier. The flagship
-    model for bench.py / __graft_entry__.py."""
+    model for bench.py / __graft_entry__.py. use_flash: None = measured auto
+    policy, True/False forces the attention path (bench probes both)."""
     cfg = cfg or TransformerConfig()
     ff = model
     t = ff.embedding(token_input, cfg.vocab_size, cfg.hidden_size,
                      AggrMode.AGGR_MODE_NONE, name="tok_emb")
     for i in range(cfg.num_layers):
         t = _encoder_layer(ff, t, cfg, f"layer{i}",
-                           sequence_parallel=sequence_parallel)
+                           sequence_parallel=sequence_parallel,
+                           use_flash=use_flash)
     t = ff.dense(t, num_classes, name="cls")
     return ff.softmax(t)

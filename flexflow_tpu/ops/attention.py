@@ -139,16 +139,15 @@ class MultiHeadAttentionOp(Op):
             if dropout_active:
                 keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - rate, probs.shape)
                 probs = jnp.where(keep, probs / (1.0 - rate), 0.0)
-            ctxv = jnp.einsum(
-                "bhqk,bkhd->bqhd", probs.astype(cdt), v,
-                preferred_element_type=jnp.float32,
-            )
+            # scores/softmax stay f32 (stability); the context matmul emits
+            # the compute dtype — the MXU accumulates f32 internally either
+            # way, and a bf16 output halves the HBM write
+            ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cdt), v)
 
         out = jnp.einsum(
             "bqhd,hde->bqe",
             ctxv.astype(cdt),
             weights["wo"].astype(cdt),
-            preferred_element_type=jnp.float32,
         ).astype(self.outputs[0].dtype.jnp_dtype)
         if "bo" in weights:
             out = out + weights["bo"]
