@@ -65,9 +65,15 @@ class FFConfig:
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
     enable_inplace_optimizations: bool = False
-    search_overlap_backward_update: bool = False
+    # collectives overlap compute in the simulator's two-stream schedule
+    # (XLA's latency-hiding scheduler does this on TPU); False = collectives
+    # serialize onto the compute stream
+    search_overlap_backward_update: bool = True
     memory_search: bool = False
     memory_budget_mb: float = 16 * 1024.0  # per-chip HBM budget for memory-aware search
+    # per-param optimizer-state factor for the search's memory model
+    # (compile() sets it from the real optimizer: Adam 3, momentum 2, SGD 1)
+    optimizer_state_factor: float = 3.0
     substitution_json_path: Optional[str] = None
     # Measured op costs for the search (reference: the simulator profiles
     # real kernels, simulator.cc:489). None = auto: measure when the default

@@ -543,6 +543,14 @@ class FFModel:
         the PCG, pick a strategy (data-parallel default; Unity search when
         search_budget > 0), build the mesh and compile the step functions."""
         self.optimizer = optimizer or SGDOptimizer(self, lr=self.config.learning_rate)
+        # memory model input for the search: per-param optimizer state factor
+        # (Adam: param+m+v, momentum-SGD: param+v, SGD: param)
+        from .runtime.optimizers import AdamOptimizer as _Adam
+
+        self.config.optimizer_state_factor = (
+            3.0 if isinstance(self.optimizer, _Adam)
+            else 2.0 if getattr(self.optimizer, "momentum", 0.0) else 1.0
+        )
         self.loss = Loss(loss_type) if not isinstance(loss_type, Loss) else loss_type
         self.metrics = Metrics(self.loss.loss_type, list(metrics))
         self.comp_mode = comp_mode
@@ -784,14 +792,9 @@ class FFModel:
 
     def _assign_tp_weights(self, op: Op, tp: int) -> None:
         """Shard weight dims over the 'model' axis where the op supports TP."""
-        shard_dim = {
-            OpType.LINEAR: {"kernel": -1, "bias": 0},
-            OpType.EMBEDDING: {"weight": -1},
-            OpType.MULTIHEAD_ATTENTION: {
-                "wq": 1, "wk": 1, "wv": 1, "wo": 0,
-                "bq": 0, "bk": 0, "bv": 0,
-            },
-        }.get(op.op_type)
+        from .search.simulator import TP_WEIGHT_SHARD_DIMS
+
+        shard_dim = TP_WEIGHT_SHARD_DIMS.get(op.op_type)
         for w in op.weights:
             ws = w._weight_spec
             dims = [ParallelDim(s, 1, None) for s in w.dims]

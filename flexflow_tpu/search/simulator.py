@@ -26,7 +26,7 @@ import dataclasses
 import json
 import logging
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -75,15 +75,24 @@ AP_CAPABLE = {
     OpType.POOL2D,
 }
 
+# weight dims that shard over 'model' per op type — the single source of
+# truth used both to ASSIGN tp shardings (FFModel._assign_tp_weights) and to
+# MEASURE tp-sharded op costs (OpCostCache), so measured shapes always match
+# executed shapes
+TP_WEIGHT_SHARD_DIMS = {
+    OpType.LINEAR: {"kernel": -1, "bias": 0},
+    OpType.EMBEDDING: {"weight": -1},
+    OpType.MULTIHEAD_ATTENTION: {
+        "wq": 1, "wk": 1, "wv": 1, "wo": 0,
+        "bq": 0, "bk": 0, "bv": 0,
+    },
+}
+
 _MEMORY_BOUND_BWD_FACTOR = 2.0  # bwd ≈ 2x fwd cost (two grad GEMMs per GEMM)
 
 
 class CostModel:
     """Analytic per-op + per-edge costs under a strategy."""
-
-    def __init__(self, machine: MachineModel, config=None):
-        self.machine = machine
-        self.config = config
 
     def op_dtype_bytes(self, op: Op) -> int:
         if self.config is not None and self.config.allow_mixed_precision:
@@ -175,6 +184,27 @@ class CostModel:
         # finer -> coarser: all_gather of the missing shards
         return self.machine.allgather_time_us(tensor_bytes / n, n)
 
+    def tp_boundary_time_us(self, tensor_bytes: float, src_op: Op,
+                            src: OpStrategy, dst: OpStrategy,
+                            backward: bool = False) -> float:
+        """TP reshard on an edge: a TP op's output is sharded over 'model';
+        a consumer at a *different* tp degree needs an allgather in fwd and
+        the mirrored reduce_scatter of the gradient in bwd (charged by the
+        pass that incurs it). Consumers at the SAME degree keep the
+        activation sharded (the Megatron column->row pairing GSPMD also
+        finds), so interior same-tp edges are free — per-edge costing
+        replaces the old unconditional per-op collective, fixing both the
+        free-mismatch-edge hole and the interior-edge overcharge."""
+        if src_op.op_type not in TP_CAPABLE or src.tp <= 1:
+            return 0.0
+        if dst.tp == src.tp:
+            return 0.0
+        if backward:
+            return self.machine.reduce_scatter_time_us(
+                tensor_bytes / max(1, src.dp), src.tp)
+        shard = tensor_bytes / max(1, src.dp * src.tp)
+        return self.machine.allgather_time_us(shard, src.tp)
+
     def grad_sync_time_us(self, op: Op, s: OpStrategy) -> float:
         """Weight-gradient allreduce over the data axis (reference: NCCL
         allreduce inside the optimizer update task, optimizer_kernel.cu:88)."""
@@ -189,13 +219,45 @@ class CostModel:
         ) / max(1, wshard)
         return self.machine.allreduce_time_us(wb, sync)
 
+    # outputs of these op types never materialize as saved-for-backward
+    # buffers on TPU: XLA fuses elementwise chains into the surrounding
+    # GEMMs and rematerializes them in the backward, and reshape-like ops
+    # alias their input (the liveness model the reference computes
+    # per-region, expressed op-type-wise for the XLA execution model)
+    FUSION_TRANSIENT = {
+        OpType.RELU, OpType.SIGMOID, OpType.TANH, OpType.ELU, OpType.GELU,
+        OpType.IDENTITY, OpType.NOOP, OpType.EXP, OpType.SIN, OpType.COS,
+        OpType.RSQRT, OpType.POW, OpType.SCALAR_MULTIPLY, OpType.SCALAR_ADD,
+        OpType.SCALAR_SUB, OpType.SCALAR_TRUE_DIV, OpType.EW_ADD,
+        OpType.EW_MUL, OpType.EW_SUB, OpType.EW_DIV, OpType.EW_MAX,
+        OpType.EW_MIN, OpType.CAST, OpType.RESHAPE, OpType.TRANSPOSE,
+        OpType.FLAT, OpType.SPLIT, OpType.DROPOUT,
+    }
+
+    def __init__(self, machine: MachineModel, config=None,
+                 optimizer_state_factor: float = 3.0):
+        self.machine = machine
+        self.config = config
+        # 3.0 = Adam (param + m + v); 2.0 = SGD momentum; 1.0 = plain SGD.
+        # FFModel.compile sets config.optimizer_state_factor from the real
+        # optimizer before running the search.
+        self.opt_state_factor = float(
+            getattr(config, "optimizer_state_factor", None)
+            or optimizer_state_factor
+        )
+
     def op_memory_bytes(self, op: Op, s: OpStrategy) -> float:
-        """Per-chip memory: sharded weights (x3 for Adam m,v) + activations."""
+        """Per-chip memory: sharded weights (x optimizer-state factor) +
+        activations saved for the backward pass. Liveness: fusion-transient
+        outputs (elementwise/reshape) are excluded — XLA never materializes
+        them as saved buffers."""
         wb = sum(w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights)
         wshard = s.tp if op.op_type in TP_CAPABLE else 1
         if op.op_type == OpType.EXPERTS:
             wshard = s.ep
         wb /= max(1, wshard)
+        if op.op_type in self.FUSION_TRANSIENT:
+            return self.opt_state_factor * wb
         ab = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs)
         # activations shard over dp (tp for TP ops, ap for spatial ops);
         # EXPERTS outputs are data-sharded only — the expert axis shards
@@ -204,7 +266,7 @@ class CostModel:
         if op.op_type in AP_CAPABLE:
             ashard *= s.ap
         ab /= max(1, ashard)
-        return 3.0 * wb + ab
+        return self.opt_state_factor * wb + ab
 
 
 class OpCostCache:
@@ -268,12 +330,14 @@ class OpCostCache:
     def _op_config(op: Op, fallback):
         return op.model.config if getattr(op, "model", None) is not None else fallback
 
-    def _key(self, op: Op, dp: int) -> Tuple:
+    def _key(self, op: Op, dp: int, tp: int = 1) -> Tuple:
         # precision is part of the identity: the same op lowers to bf16 or
         # f32 matmuls depending on allow_mixed_precision (ops/common.py)
         cfg = self._op_config(op, self.config)
         mixed = bool(cfg.allow_mixed_precision) if cfg is not None else True
-        return (op.cost_key(), dp, mixed)
+        key = (op.cost_key(), dp, mixed)
+        # tp appended only when sharded, keeping round-2 cache files valid
+        return key if tp <= 1 else key + (tp,)
 
     def stats(self) -> str:
         return (f"measured-cost cache: {self.hits} hits, {self.misses} misses, "
@@ -286,23 +350,35 @@ class OpCostCache:
         fwd, _ = self.measure_us(op, s)
         return fwd
 
+    TP_WEIGHT_DIMS = TP_WEIGHT_SHARD_DIMS
+
     def measure_us(self, op: Op, s: OpStrategy) -> Tuple[float, float]:
         """(fwd_us, bwd_us) for op under strategy s; (-1, -1) if unmeasurable.
 
-        The op is measured at its dp-sharded local shape (batch/dp). TP
-        sharding is applied analytically on top (time/tp for TP-capable ops,
-        whose matmul FLOPs scale with 1/tp) — measuring true tp-sharded
-        weight shapes would need per-op param rewriting; the measured dp
-        point anchors the absolute scale, which is what the analytic model
-        lacks."""
+        The op is measured at its true sharded local shapes: batch/dp inputs,
+        and — for TP-capable ops with weight shard maps — tp-sharded weight
+        dims, so the dp-vs-tp decision rests on measured points on both sides
+        (TP-sharded matmuls have different MXU efficiency than time/tp
+        predicts). Degrees without a shard map (batch_matmul tp, expert ep,
+        spatial ap) still scale the measured dp point analytically."""
         if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
             return 0.0, 0.0
-        key = self._key(op, s.dp)
+        measurable_tp = (s.tp if s.tp > 1 and op.op_type in self.TP_WEIGHT_DIMS
+                         and self._tp_shardable(op, s.tp) else 1)
+        key = self._key(op, s.dp, measurable_tp)
         if key in self.cache:
             self.hits += 1
             fwd, bwd = self.cache[key]
         elif key in self.failures:
             self.failure_hits += 1
+            if measurable_tp > 1:
+                # the tp-sharded measurement failed: fall back to the
+                # measured dp point scaled by 1/tp rather than the analytic
+                # model, so dp-vs-tp still compares on the measured scale
+                fwd, bwd = self.measure_us(
+                    op, dataclasses.replace(s, tp=1))
+                return ((fwd / s.tp, bwd / s.tp if bwd >= 0 else bwd)
+                        if fwd >= 0 else (-1.0, -1.0))
             return -1.0, -1.0
         else:
             # promote a persisted (string-keyed) entry to the tuple key
@@ -314,21 +390,34 @@ class OpCostCache:
             else:
                 self.misses += 1
                 try:
-                    fwd, bwd = self._measure(op, s.dp)
+                    fwd, bwd = self._measure(op, s.dp, measurable_tp)
                     self.cache[key] = (fwd, bwd)
                 except Exception as exc:
                     self.failures[key] = f"{type(exc).__name__}: {exc}"
                     _log.warning("op-cost measurement failed for %s: %s",
                                  op.name, self.failures[key])
                     return -1.0, -1.0
-        tp = s.tp if op.op_type in TP_CAPABLE else 1
+        # analytic scaling for the degrees not captured in the measurement
+        scale = 1
+        if op.op_type in TP_CAPABLE and measurable_tp == 1:
+            scale = s.tp
         if op.op_type == OpType.EXPERTS:
-            tp = s.ep
+            scale = s.ep
         elif op.op_type in AP_CAPABLE:
-            tp = s.ap
-        return fwd / tp, (bwd / tp if bwd >= 0 else bwd)
+            scale = s.ap
+        return fwd / scale, (bwd / scale if bwd >= 0 else bwd)
 
-    def _measure(self, op: Op, dp: int) -> Tuple[float, float]:
+    def _tp_shardable(self, op: Op, tp: int) -> bool:
+        dims_map = self.TP_WEIGHT_DIMS[op.op_type]
+        for w in op.weights:
+            name = w._weight_spec.name
+            if name in dims_map:
+                d = dims_map[name] % len(w.dims)
+                if w.dims[d] % tp != 0:
+                    return False
+        return True
+
+    def _measure(self, op: Op, dp: int, tp: int = 1) -> Tuple[float, float]:
         import jax
         import jax.numpy as jnp
 
@@ -344,10 +433,15 @@ class OpCostCache:
         key_rng = jax.random.PRNGKey(0)
         cfg = self._op_config(op, self.config)
         ins = [jnp.zeros(local_shape(t), t.dtype.jnp_dtype) for t in op.inputs]
+        tp_dims = self.TP_WEIGHT_DIMS.get(op.op_type, {}) if tp > 1 else {}
         weights = {}
         for w in op.weights:
             ws = w._weight_spec
-            weights[ws.name] = jnp.zeros(ws.dims, ws.dtype.jnp_dtype)
+            dims = list(ws.dims)
+            if ws.name in tp_dims:
+                d = tp_dims[ws.name] % len(dims)
+                dims[d] //= tp  # true tp-sharded local weight shape
+            weights[ws.name] = jnp.zeros(tuple(dims), ws.dtype.jnp_dtype)
 
         def run(ins, weights):
             ctx = LoweringContext(cfg, CompMode.COMP_MODE_INFERENCE,
@@ -440,38 +534,112 @@ class Simulator:
         return fwd, bwd
 
     def op_step_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Per-op cost used to SEED the segment search. tp_collective is an
+        upper-bound heuristic here — the event-driven simulate() prices TP
+        resharding exactly on boundary edges, and best-first refinement
+        re-scores flips with it — charging it at seed time just biases seeds
+        conservatively where edges are unknown."""
         fwd, bwd = self.fwd_bwd_time_us(op, s)
         return (fwd + bwd + self.cost.tp_collective_time_us(op, s)
                 + self.cost.ep_collective_time_us(op, s)
                 + self.cost.ap_halo_time_us(op, s))
 
     def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
-        """Per-iteration time (us) of the graph under per-op strategies."""
-        total = 0.0
-        grad_sync = 0.0
-        bwd_total = 0.0
+        """Per-iteration time (us): event-driven schedule of the
+        fwd/bwd/update task graph on two streams — compute (ops serialize on
+        the TensorCore, as in one fused XLA program) and ICI (collectives,
+        which XLA's latency-hiding scheduler overlaps with compute).
+        Reference: simulate_runtime's task graph with comm tasks,
+        simulator.cc:815+. config.search_overlap_backward_update=False forces
+        collectives onto the compute stream (no overlap)."""
         default = OpStrategy()
-        for op in graph.topo_order():
+        order = graph.topo_order()
+        overlap = bool(self.config is None
+                       or self.config.search_overlap_backward_update)
+        t_compute = 0.0
+        t_comm = 0.0
+
+        def run_comm(dur: float, ready: float) -> float:
+            nonlocal t_comm, t_compute
+            if dur <= 0.0:
+                return ready
+            if not overlap:
+                start = max(t_compute, ready)
+                t_compute = start + dur
+                return t_compute
+            start = max(t_comm, ready)
+            t_comm = start + dur
+            return t_comm
+
+        def run_compute(dur: float, ready: float) -> float:
+            nonlocal t_compute
+            start = max(t_compute, ready)
+            t_compute = start + dur
+            return t_compute
+
+        def edge_comm_us(t, src_op, src_s, s, backward=False) -> float:
+            bytes_ = t.num_elements() * t.dtype.np_dtype.itemsize
+            return (self.cost.xfer_time_us(bytes_, src_s, s)
+                    + self.cost.tp_boundary_time_us(bytes_, src_op, src_s, s,
+                                                    backward=backward))
+
+        # -- forward -------------------------------------------------------
+        fwd_times: Dict[int, Tuple[float, float]] = {}
+        out_ready: Dict[int, float] = {}
+        for op in order:
             s = strategies.get(op.guid, default)
             fwd, bwd = self.fwd_bwd_time_us(op, s)
-            total += (fwd + bwd + self.cost.tp_collective_time_us(op, s)
-                      + self.cost.ep_collective_time_us(op, s)
-                      + self.cost.ap_halo_time_us(op, s))
-            bwd_total += bwd
-            grad_sync += self.cost.grad_sync_time_us(op, s)
+            fwd_times[op.guid] = (fwd, bwd)
+            ready = 0.0
             for t in op.inputs:
                 src_op = t.owner_op
+                if src_op is None or src_op.guid not in graph.ops:
+                    continue
+                src_s = strategies.get(src_op.guid, default)
+                e = run_comm(edge_comm_us(t, src_op, src_s, s),
+                             out_ready[src_op.guid])
+                ready = max(ready, e)
+            fin = run_compute(fwd, ready)
+            # op-internal fwd collectives (expert all_to_all, conv halos)
+            # gate the op's output
+            intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
+                           + self.cost.ap_halo_time_us(op, s))
+            out_ready[op.guid] = run_comm(intra, fin)
+
+        # -- backward (reverse topo: bwd(op) after bwd of its consumers) ---
+        # consumer edges in graph serialization order (ops dict order, then
+        # input position) — identical to the native core's edge scan
+        consumer_edges: Dict[int, List[Tuple[Op, Any]]] = {g: [] for g in graph.ops}
+        for con in graph.ops.values():
+            for t in con.inputs:
+                src_op = t.owner_op
                 if src_op is not None and src_op.guid in graph.ops:
-                    src_s = strategies.get(src_op.guid, default)
-                    bytes_ = t.num_elements() * t.dtype.np_dtype.itemsize
-                    # fwd reshard + mirrored bwd reshard
-                    total += 2.0 * self.cost.xfer_time_us(bytes_, src_s, s)
-        if self.config is not None and self.config.search_overlap_backward_update:
-            # gradient allreduce overlaps the backward pass (reference:
-            # search_overlap_backward_update): only the non-overlapped tail
-            # remains visible
-            grad_sync = max(0.0, grad_sync - 0.8 * bwd_total)
-        return total + grad_sync
+                    consumer_edges[src_op.guid].append((con, t))
+        bwd_end: Dict[int, float] = {}
+        update_ready = 0.0
+        for op in reversed(order):
+            s = strategies.get(op.guid, default)
+            _, bwd = fwd_times[op.guid]
+            ready = 0.0
+            for con, t in consumer_edges[op.guid]:
+                con_s = strategies.get(con.guid, default)
+                # mirrored reshard of the input gradient
+                ready = max(ready,
+                            run_comm(edge_comm_us(t, op, s, con_s,
+                                                  backward=True),
+                                     bwd_end[con.guid]))
+            fin = run_compute(bwd, ready)
+            intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
+                           + self.cost.ap_halo_time_us(op, s))
+            fin = run_comm(intra, fin)
+            bwd_end[op.guid] = fin
+            # weight-gradient allreduce: async on the ICI stream; the
+            # optimizer update waits for the last one (this is where dp
+            # overlap with the remaining backward is won)
+            gs = self.cost.grad_sync_time_us(op, s)
+            update_ready = max(update_ready, run_comm(gs, fin))
+
+        return max(t_compute, update_ready)
 
     def memory_bytes(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         default = OpStrategy()

@@ -172,30 +172,35 @@ class GraphSearchHelper:
                 segments.append([])
         return [s for s in segments if s]
 
-    def _segment_cost(self, seg_graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
-        return self.sim.simulate(seg_graph, strategies)
+    def _segment_cost(self, seg_graph: Graph, strategies: Dict[int, OpStrategy],
+                      lam: float = 0.0) -> float:
+        cost = self.sim.simulate(seg_graph, strategies)
+        if lam:
+            cost += lam * self.sim.memory_bytes(seg_graph, strategies)
+        return cost
 
     def _optimize_segment(self, seg: List[Op], dp: int, tp: int,
-                          batch: int, ep: int = 1, ap: int = 1
-                          ) -> Dict[int, OpStrategy]:
-        key = (tuple(op.guid for op in seg), dp, tp, ep, ap)
+                          batch: int, ep: int = 1, ap: int = 1,
+                          lam: float = 0.0) -> Dict[int, OpStrategy]:
+        key = (tuple(op.guid for op in seg), dp, tp, ep, ap, round(lam, 15))
         if key in self._memo:
             return self._memo[key]
         seg_graph = Graph(seg)
-        # seed: per-op greedy best in isolation
+        # seed: per-op greedy best in isolation (memory-weighted under lam)
         strategies = {}
         for op in seg:
             menu = [s for s in valid_strategies(op, dp, tp, batch, self.config,
                                                 ep=ep, ap=ap)
                     if self._tp_ok(op, s)]
             strategies[op.guid] = min(
-                menu, key=lambda s: self.sim.op_step_time_us(op, s)
+                menu, key=lambda s: (self.sim.op_step_time_us(op, s)
+                                     + lam * self.sim.cost.op_memory_bytes(op, s))
             )
         # base_optimize: best-first over single-op strategy flips
         budget = max(0, self.config.search_budget)
         alpha = self.config.search_alpha
         best = dict(strategies)
-        best_cost = self._segment_cost(seg_graph, best)
+        best_cost = self._segment_cost(seg_graph, best, lam)
         counter = itertools.count()
         pq: List[Tuple[float, int, Dict[int, OpStrategy]]] = [
             (best_cost, next(counter), best)
@@ -215,7 +220,7 @@ class GraphSearchHelper:
                         continue  # rule file doesn't propose this TP
                     cand = dict(cur)
                     cand[op.guid] = s
-                    c = self._segment_cost(seg_graph, cand)
+                    c = self._segment_cost(seg_graph, cand, lam)
                     if c < best_cost:
                         best, best_cost = cand, c
                     if c < cost * alpha:
@@ -250,22 +255,35 @@ class GraphSearchHelper:
             self._load_tp_candidates(spec, parsed=taso_rules)
 
         search_rules = search_rules_from_spec(spec, is_taso, parsed=taso_rules)
-        if (getattr(self.config, "joint_search", True) and search_rules
-                and self.config.search_budget > 0):
-            best = self._joint_optimize(search_rules, batch_size, n_devices,
-                                        memory_budget_bytes)
-        else:
+        joint = (getattr(self.config, "joint_search", True) and search_rules
+                 and self.config.search_budget > 0)
+        if not joint and search_rules and self.config.search_budget > 0:
             # joint_search=False: trade-off rewrites degrade to the greedy
             # fixed-point pass (the comparison baseline). joint_search=True
             # with no budget applies none — matching the native-path gate so
             # native availability never changes the compiled graph.
-            if (search_rules and self.config.search_budget > 0
-                    and not getattr(self.config, "joint_search", True)):
-                applied2 = apply_substitutions(self.graph, search_rules)
-                if applied2:
-                    self.log.append(f"greedy substitutions: {applied2}")
-            best = self._parallelize(self.graph, batch_size, n_devices,
-                                     memory_budget_bytes)
+            applied2 = apply_substitutions(self.graph, search_rules)
+            if applied2:
+                self.log.append(f"greedy substitutions: {applied2}")
+
+        def select(lam: float, final: bool = True) -> SearchResult:
+            if joint:
+                # probes must not mutate the real graph (the lambda search
+                # calls select repeatedly); only the final call replays the
+                # winning rewrites onto it
+                return self._joint_optimize(search_rules, batch_size,
+                                            n_devices, lam=lam,
+                                            materialize=final)
+            return self._parallelize(self.graph, batch_size, n_devices,
+                                     lam=lam)
+
+        if memory_budget_bytes is not None:
+            # non-joint probes are already final (nothing mutates), so the
+            # lambda search can reuse them without a second pass
+            best = self._lambda_search(select, memory_budget_bytes,
+                                       probe_is_final=not joint)
+        else:
+            best = select(0.0)
         self.log.append(f"selected: {best.log[-1] if best.log else ''}")
         if self.sim.measured is not None:
             self.log.append(
@@ -278,11 +296,11 @@ class GraphSearchHelper:
         return best
 
     def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
-                     memory_budget_bytes: Optional[float] = None,
-                     quiet: bool = False) -> SearchResult:
-        """Best parallelization of a fixed graph: enumerate mesh
-        factorizations, segment-DP each (reference: Graph::optimal_cost via
-        the DP in graph.cc:1586)."""
+                     lam: float = 0.0, quiet: bool = False) -> SearchResult:
+        """Best parallelization of a fixed graph under the runtime +
+        lam * memory objective: enumerate mesh factorizations, segment-DP
+        each (reference: Graph::optimal_cost via the DP in graph.cc:1586;
+        lam is the lambda of the memory-aware search, graph.cc:2075)."""
         candidates: List[SearchResult] = []
         # extra axes only enumerated when usable: 'expert' when the graph has
         # EXPERTS ops (ep must divide every expert count), 'attr' when
@@ -311,13 +329,9 @@ class GraphSearchHelper:
             for seg in self._segments(graph):
                 strategies.update(
                     self._optimize_segment(seg, dp, tp, batch_size,
-                                           ep=ep, ap=ap))
+                                           ep=ep, ap=ap, lam=lam))
             cost = self.sim.simulate(graph, strategies)
             mem = self.sim.memory_bytes(graph, strategies)
-            if memory_budget_bytes is not None:
-                cost = self._memory_adjusted_cost(
-                    cost, mem, memory_budget_bytes, strategies
-                )
             candidates.append(
                 SearchResult(strategies,
                              self._axes(dp, tp, strategies, ep, ap),
@@ -327,25 +341,78 @@ class GraphSearchHelper:
             )
         if not candidates:
             raise ValueError("no feasible mesh factorization")
-        best = min(candidates, key=lambda r: r.cost_us)
+        best = min(candidates, key=lambda r: r.cost_us + lam * r.memory_bytes)
         if not quiet:
             self.log.extend(c.log[0] for c in candidates)
         return best
 
+    def _lambda_search(self, select, budget: float,
+                       probe_is_final: bool = True) -> SearchResult:
+        """Binary-search the lambda of the runtime + lambda*memory objective
+        until the selected strategy fits the per-chip HBM budget, keeping
+        the smallest (fastest) fitting lambda (reference: the lambda binary
+        search of graph.cc:2075-2131). probe_is_final: probes don't mutate
+        (non-joint path) and can be returned directly."""
+
+        def finalize(lam: float, probe: SearchResult) -> SearchResult:
+            return probe if probe_is_final else select(lam)
+
+        r = select(0.0, final=probe_is_final)
+        if r.memory_bytes <= budget:
+            self.log.append(
+                f"lambda search: lam=0 fits ({r.memory_bytes/1e9:.2f}GB"
+                f" <= {budget/1e9:.2f}GB)")
+            return finalize(0.0, r)
+        lam = 1e-12
+        fit_lam = None
+        for _ in range(40):
+            r = select(lam, final=probe_is_final)
+            if r.memory_bytes <= budget:
+                fit_lam = lam
+                break
+            lam *= 4.0
+        else:
+            lam /= 4.0  # last probed value
+        if fit_lam is None:
+            best = finalize(lam, r)
+            self.log.append(
+                "lambda search: no strategy fits the budget; returning the "
+                f"most memory-lean selection ({best.memory_bytes/1e9:.2f}GB)")
+            return best
+        hi_lam = fit_lam
+        hi_r = r
+        lo = hi_lam / 4.0
+        for _ in range(10):
+            mid = (lo + hi_lam) / 2.0
+            rm = select(mid, final=probe_is_final)
+            if rm.memory_bytes <= budget:
+                hi_lam, hi_r = mid, rm
+            else:
+                lo = mid
+        best = finalize(hi_lam, hi_r)
+        self.log.append(
+            f"lambda search: lam={hi_lam:.3g} fits "
+            f"(cost={best.cost_us:.1f}us mem={best.memory_bytes/1e9:.2f}GB)")
+        return best
+
     def _joint_optimize(self, rules, batch_size: int, n_devices: int,
-                        memory_budget_bytes: Optional[float] = None
+                        lam: float = 0.0, materialize: bool = True
                         ) -> SearchResult:
         """Joint substitution x parallelization search (reference:
         GraphSearchHelper::base_optimize, substitution.cc:2229-2311):
         best-first over candidate *graphs* — each neighbor is one rewrite
         application — where a candidate's cost is its optimal parallelization
-        (_parallelize). Candidates are deduplicated by graph hash; the
-        segment-DP memo is shared across candidates because clones preserve
-        op guids, so only rewritten segments re-cost."""
+        (_parallelize) under the runtime + lam*memory objective. Candidates
+        are deduplicated by graph hash; the segment-DP memo is shared across
+        candidates because clones preserve op guids, so only rewritten
+        segments re-cost."""
+
+        def objective(r: SearchResult) -> float:
+            return r.cost_us + lam * r.memory_bytes
+
         base = self.graph
-        best_res = self._parallelize(base, batch_size, n_devices,
-                                     memory_budget_bytes)
-        best_cost = best_res.cost_us
+        best_res = self._parallelize(base, batch_size, n_devices, lam=lam)
+        best_cost = objective(best_res)
         best_seq: List[Tuple[str, str]] = []
         self.log.append(f"joint: base cost={best_cost:.1f}us")
         visited = {base.hash()}
@@ -374,20 +441,20 @@ class GraphSearchHelper:
                 visited.add(h)
                 try:
                     r2 = self._parallelize(g2, batch_size, n_devices,
-                                           memory_budget_bytes, quiet=True)
+                                           lam=lam, quiet=True)
                 except Exception as exc:  # infeasible rewrite: skip, log
                     self.log.append(
                         f"joint: {app.rule}({app.description}) infeasible: {exc}")
                     continue
+                c2 = objective(r2)
                 seq2 = seq + [(app.rule, app.description)]
                 self.log.append(
-                    f"joint: {app.rule}({app.description}) -> "
-                    f"{r2.cost_us:.1f}us")
-                if r2.cost_us < best_cost:
-                    best_cost, best_res, best_seq = r2.cost_us, r2, seq2
-                if r2.cost_us < cost * alpha:
-                    heapq.heappush(pq, (r2.cost_us, next(counter), g2, seq2))
-        if best_seq:
+                    f"joint: {app.rule}({app.description}) -> {c2:.1f}us")
+                if c2 < best_cost:
+                    best_cost, best_res, best_seq = c2, r2, seq2
+                if c2 < cost * alpha:
+                    heapq.heappush(pq, (c2, next(counter), g2, seq2))
+        if best_seq and materialize:
             # materialize the winning rewrites on the real graph, then
             # re-cost it so strategies key to the real (fresh) op guids
             for rule_name, desc in best_seq:
@@ -399,7 +466,7 @@ class GraphSearchHelper:
                 match.apply()
             self.log.append(f"joint: applied {best_seq}")
             best_res = self._parallelize(self.graph, batch_size, n_devices,
-                                         memory_budget_bytes, quiet=True)
+                                         lam=lam, quiet=True)
             self.log.append(
                 f"joint: post-rewrite {best_res.log[0] if best_res.log else ''}")
         return best_res
@@ -410,18 +477,6 @@ class GraphSearchHelper:
             if a.description == description:
                 return a
         return None
-
-    def _memory_adjusted_cost(self, cost, mem, budget, strategies) -> float:
-        """Memory-aware objective (reference role: the lambda-weighted
-        multi-objective of graph.cc:1884/2075-2131, which binary-searches
-        lambda until the chosen strategy fits -ll:fsize). Since candidates
-        here are costed directly, the same semantics — 'prefer feasible
-        strategies, then fastest' — reduces to a steep overflow penalty that
-        pushes selection toward TP-sharded (memory-lean) factorizations."""
-        if mem <= budget:
-            return cost
-        overflow = (mem - budget) / budget
-        return cost * (1.0 + 10.0 * overflow)
 
     def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],
               ep: int = 1, ap: int = 1) -> Dict[str, int]:
@@ -502,6 +557,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     )
     if (simulator is None and not is_taso and not has_experts
             and not wants_attr and not rewrites_applicable
+            and not config.memory_search  # lambda search is Python-only
             and getattr(config, "use_native_search", True)):
         from .. import native
 

@@ -112,6 +112,9 @@ class CostModel {
   double forward_us(const NodeDesc& n, const Strategy& s) const;
   double backward_us(const NodeDesc& n, const Strategy& s) const;
   double tp_collective_us(const NodeDesc& n, const Strategy& s) const;
+  double tp_boundary_us(double bytes, const NodeDesc& src_n,
+                        const Strategy& src, const Strategy& dst,
+                        bool backward) const;
   double xfer_us(double bytes, const Strategy& src, const Strategy& dst) const;
   double grad_sync_us(const NodeDesc& n, const Strategy& s) const;
   double memory_bytes(const NodeDesc& n, const Strategy& s) const;

@@ -72,6 +72,21 @@ double CostModel::xfer_us(double bytes, const Strategy& src,
   return m_.allgather_us(bytes / n, n);
 }
 
+// TP reshard on an edge: charged only at tp-degree boundaries — fwd pays
+// the allgather, bwd the mirrored gradient reduce_scatter; same-degree
+// interior edges keep activations sharded (Megatron column->row pairing).
+// Mirrors simulator.py tp_boundary_time_us exactly.
+double CostModel::tp_boundary_us(double bytes, const NodeDesc& src_n,
+                                 const Strategy& src, const Strategy& dst,
+                                 bool backward) const {
+  if (!src_n.tp_capable || src.tp <= 1) return 0.0;
+  if (dst.tp == src.tp) return 0.0;
+  if (backward)
+    return m_.reduce_scatter_us(bytes / std::max(1, src.dp), src.tp);
+  double shard = bytes / std::max(1, src.dp * src.tp);
+  return m_.allgather_us(shard, src.tp);
+}
+
 double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
   if (s.dp <= 1 || n.weight_bytes <= 0) return 0.0;
   double wb = n.weight_bytes / std::max(1, s.tp);
@@ -89,6 +104,11 @@ double CostModel::op_step_us(const NodeDesc& n, const Strategy& s) const {
 }
 
 // ------------------------------------------------------------- simulator
+// Event-driven two-stream schedule of the fwd/bwd/update task graph —
+// compute (ops serialize on the TensorCore) and ICI (collectives, which
+// overlap compute when Options.overlap). Mirrors simulator.py
+// Simulator::simulate exactly (reference: simulate_runtime,
+// simulator.cc:815+).
 double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
                            const std::vector<int>* subset) const {
   Strategy def;
@@ -102,20 +122,73 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
   } else {
     for (const auto& n : g_.nodes) in_scope.insert(n.guid);
   }
-  double total = 0, grad_sync = 0, bwd_sum = 0;
-  for (const auto& n : g_.nodes) {
-    if (!in_scope.count(n.guid)) continue;
-    Strategy s = get(n.guid);
-    total += cost_.op_step_us(n, s);
-    bwd_sum += cost_.backward_us(n, s);
-    grad_sync += cost_.grad_sync_us(n, s);
-  }
+  double t_compute = 0.0, t_comm = 0.0;
+  const bool overlap = o_.overlap;
+  auto run_comm = [&](double dur, double ready) {
+    if (dur <= 0.0) return ready;
+    if (!overlap) {
+      double start = std::max(t_compute, ready);
+      t_compute = start + dur;
+      return t_compute;
+    }
+    double start = std::max(t_comm, ready);
+    t_comm = start + dur;
+    return t_comm;
+  };
+  auto run_compute = [&](double dur, double ready) {
+    double start = std::max(t_compute, ready);
+    t_compute = start + dur;
+    return t_compute;
+  };
+  auto edge_comm = [&](const EdgeDesc& e, const Strategy& ss,
+                       const Strategy& ds, bool backward) {
+    return cost_.xfer_us(e.bytes, ss, ds) +
+           cost_.tp_boundary_us(e.bytes, g_.nodes[g_.index.at(e.src)], ss, ds,
+                                backward);
+  };
+
+  // pre-index edges by endpoint, preserving serialization order (matches
+  // the Python loop over op.inputs / its consumer_edges map)
+  std::map<int64_t, std::vector<const EdgeDesc*>> by_dst, by_src;
   for (const auto& e : g_.edges) {
     if (!in_scope.count(e.src) || !in_scope.count(e.dst)) continue;
-    total += 2.0 * cost_.xfer_us(e.bytes, get(e.src), get(e.dst));
+    by_dst[e.dst].push_back(&e);
+    by_src[e.src].push_back(&e);
   }
-  if (o_.overlap) grad_sync = std::max(0.0, grad_sync - 0.8 * bwd_sum);
-  return total + grad_sync;
+
+  auto order = g_.topo_order();
+  std::map<int64_t, double> out_ready;
+  for (int i : order) {
+    const NodeDesc& n = g_.nodes[i];
+    if (!in_scope.count(n.guid)) continue;
+    Strategy s = get(n.guid);
+    double ready = 0.0;
+    for (const EdgeDesc* e : by_dst[n.guid]) {
+      double fin =
+          run_comm(edge_comm(*e, get(e->src), s, false), out_ready[e->src]);
+      ready = std::max(ready, fin);
+    }
+    out_ready[n.guid] = run_compute(cost_.forward_us(n, s), ready);
+  }
+  // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
+  std::map<int64_t, double> bwd_end;
+  double update_ready = 0.0;
+  for (auto it = order.rbegin(); it != order.rend(); ++it) {
+    const NodeDesc& n = g_.nodes[*it];
+    if (!in_scope.count(n.guid)) continue;
+    Strategy s = get(n.guid);
+    double ready = 0.0;
+    for (const EdgeDesc* e : by_src[n.guid]) {
+      double fin =
+          run_comm(edge_comm(*e, s, get(e->dst), true), bwd_end[e->dst]);
+      ready = std::max(ready, fin);
+    }
+    double fin = run_compute(cost_.backward_us(n, s), ready);
+    bwd_end[n.guid] = fin;
+    update_ready =
+        std::max(update_ready, run_comm(cost_.grad_sync_us(n, s), fin));
+  }
+  return std::max(t_compute, update_ready);
 }
 
 double Simulator::memory(const std::map<int64_t, Strategy>& strategies) const {
