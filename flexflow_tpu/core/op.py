@@ -49,11 +49,15 @@ class WeightSpec:
 class LoweringContext:
     """State threaded through PCG lowering into a jax computation."""
 
-    def __init__(self, config, mode, mesh=None, rng_key=None):
+    def __init__(self, config, mode, mesh=None, rng_key=None,
+                 iter_seq_length=None):
         self.config = config
         self.mode = mode  # CompMode
         self.mesh = mesh
         self.rng_key = rng_key
+        # FFIterationConfig.seq_length (reference config.h:162-167): ops with
+        # a sequence dim truncate their compute to the first L positions
+        self.iter_seq_length = iter_seq_length
         self._rng_count = 0
         # tensor guid -> traced jax value
         self.values: Dict[int, Any] = {}

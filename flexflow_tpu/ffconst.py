@@ -25,6 +25,12 @@ class DataType(enum.Enum):
 
         return np.dtype(self.value)
 
+    @classmethod
+    def from_numpy(cls, dt) -> "DataType":
+        import numpy as np
+
+        return cls(np.dtype(dt).name)
+
     @property
     def jnp_dtype(self):
         import jax.numpy as jnp

@@ -455,6 +455,18 @@ class FFModel:
     def allreduce(self, input: Tensor, axis_name: str = "data", name: str = "") -> Tensor:
         return self._add_op(OpType.ALLREDUCE, [input], name, axis_name=axis_name).outputs[0]
 
+    def create_constant(self, value, trainable: bool = False,
+                        dtype: Optional[DataType] = None,
+                        name: str = "") -> Tensor:
+        """Fixed tensor value as a graph source (torch-frontend get_attr
+        support; reference: torch/model.py:2427+ attribute access).
+        trainable=True makes it a real parameter."""
+        value = np.asarray(value)
+        if dtype is not None:
+            value = value.astype(dtype.np_dtype)
+        return self._add_op(OpType.WEIGHT, [], name, value=value,
+                            trainable=trainable, dtype=dtype).outputs[0]
+
     def experts(
         self,
         input: Tensor,
@@ -660,6 +672,9 @@ class FFModel:
         recompile_on_condition). The next step re-traces with the new
         dataflow; weights and optimizer state carry over."""
         self._build_step_functions()
+        # per-seq_length jits were lowered from the old graph
+        if getattr(self, "_manual", None):
+            self._manual.pop("seq_fns", None)
 
     def _export_task_graph(self, path: str) -> None:
         """Cost-annotated task-graph dot (reference: --export-strategy-
@@ -918,11 +933,29 @@ class FFModel:
         self._manual["inputs"] = self._prep_inputs(list(inputs), 0, inputs[0].shape[0])
         self._manual["label"] = np.asarray(label)
 
+    def _seq_fn(self, kind: str, seq_length: Optional[int]):
+        """Per-seq_length jitted step cache (FFIterationConfig parity,
+        reference config.h:162-167: forward(seq_length) truncates seq-dim
+        compute). Each distinct length traces once; XLA caches it."""
+        if seq_length is None:
+            return self._forward_fn if kind == "fwd" else self._grad_step
+        cache = self._manual.setdefault("seq_fns", {})
+        key = (kind, seq_length)
+        if key not in cache:
+            if kind == "fwd":
+                cache[key] = self.executor.build_forward(
+                    self.final_tensor, self._comp_mode_used,
+                    seq_length=seq_length)
+            else:
+                cache[key] = self.executor.build_grad_step(
+                    self.loss.fn, self.final_tensor, seq_length=seq_length)
+        return cache[key]
+
     def forward(self, seq_length: Optional[int] = None):
         # one rng per iteration, shared with backward() so the differentiated
         # forward sees the identical dropout masks
         self._manual["rng"] = self._next_rng()
-        pred, self.state = self._forward_fn(
+        pred, self.state = self._seq_fn("fwd", seq_length)(
             self.params, self.state, self._manual["inputs"], self._manual["rng"]
         )
         self._manual["pred"] = pred
@@ -938,7 +971,7 @@ class FFModel:
         rng = self._manual.get("rng")
         if rng is None:
             rng = self._next_rng()
-        self._manual["grads"] = self._grad_step(
+        self._manual["grads"] = self._seq_fn("grad", seq_length)(
             self.params, self.state, self._manual["inputs"], label, rng
         )
 

@@ -87,6 +87,18 @@ class MultiHeadAttentionOp(Op):
         _, _, _, embed, heads, kdim, vdim = self._dims()
         cdt = matmul_dtype(ctx.config, q_in.dtype)
 
+        # iteration seq_length truncation (reference: FFIterationConfig
+        # threading, config.h:162-167): compute on the first L positions
+        # only — a static slice per distinct length, zero-padded back below
+        L = getattr(ctx, "iter_seq_length", None)
+        full_q_len = q_in.shape[1]
+        if L is not None and L < full_q_len:
+            import jax.lax as lax
+
+            q_in = lax.slice_in_dim(q_in, 0, L, axis=1)
+            k_in = lax.slice_in_dim(k_in, 0, min(L, k_in.shape[1]), axis=1)
+            v_in = lax.slice_in_dim(v_in, 0, min(L, v_in.shape[1]), axis=1)
+
         # note: a fused q/k/v projection (one wide matmul + split) wins on an
         # isolated micro-benchmark (~17%) but measured ~6% SLOWER end-to-end
         # on v5e — the split's forced materialization breaks XLA's
@@ -151,6 +163,8 @@ class MultiHeadAttentionOp(Op):
         ).astype(self.outputs[0].dtype.jnp_dtype)
         if "bo" in weights:
             out = out + weights["bo"]
+        if out.shape[1] < full_q_len:  # truncated: pad back to declared shape
+            out = jnp.pad(out, [(0, 0), (0, full_q_len - out.shape[1]), (0, 0)])
         return [out]
 
     def _use_flash(self, ctx) -> bool:

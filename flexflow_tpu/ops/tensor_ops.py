@@ -191,8 +191,11 @@ class TopKOp(Op):
 class BatchMatmulOp(Op):
     """Batched matmul (reference: src/ops/batch_matmul.cc). Carries optional
     a_seq_length_dim/b_seq_length_dim attributes like the reference
-    (batch_matmul.cc:77-90); static shapes mean truncation is handled by the
-    frontend slicing instead."""
+    (batch_matmul.cc:77-90); when the iteration carries a seq_length
+    (FFModel.forward(seq_length), FFIterationConfig config.h:162-167) the
+    declared seq dims are truncated to it before the GEMM — a static slice,
+    so each distinct length compiles once and XLA caches it — and the output
+    is zero-padded back to its declared shape."""
 
     op_type = OpType.BATCHMATMUL
 
@@ -206,11 +209,22 @@ class BatchMatmulOp(Op):
         from .common import matmul_dtype
 
         a, b = inputs
+        L = getattr(ctx, "iter_seq_length", None)
+        a_dim = self.params.get("a_seq_length_dim")
+        b_dim = self.params.get("b_seq_length_dim")
+        if L is not None and a_dim is not None and a_dim >= 0 and L < a.shape[a_dim]:
+            a = jax.lax.slice_in_dim(a, 0, L, axis=a_dim)
+        if L is not None and b_dim is not None and b_dim >= 0 and L < b.shape[b_dim]:
+            b = jax.lax.slice_in_dim(b, 0, L, axis=b_dim)
         cdt = matmul_dtype(ctx.config, a.dtype)
         y = jnp.matmul(
             a.astype(cdt), b.astype(cdt), preferred_element_type=jnp.float32
         )
-        return [y.astype(self.outputs[0].dtype.jnp_dtype)]
+        out = self.outputs[0]
+        if y.shape != out.dims:
+            pad = [(0, full - got) for full, got in zip(out.dims, y.shape)]
+            y = jnp.pad(y, pad)
+        return [y.astype(out.dtype.jnp_dtype)]
 
     def flops(self) -> float:
         a, b = self.inputs

@@ -73,9 +73,12 @@ class Executor:
         input_values: Dict[str, Any],
         rng,
         mode: CompMode,
+        seq_length: Optional[int] = None,
     ) -> Tuple[Dict[int, Any], Dict]:
-        """Returns (tensor guid -> value, new state)."""
-        ctx = LoweringContext(self.config, mode, self.mesh, rng)
+        """Returns (tensor guid -> value, new state). seq_length: iteration
+        truncation (FFIterationConfig) — static per distinct length."""
+        ctx = LoweringContext(self.config, mode, self.mesh, rng,
+                              iter_seq_length=seq_length)
         # flatten state into ctx keyed by (op_name, var)
         for op_name, vars_ in state.items():
             for var, val in vars_.items():
@@ -147,28 +150,32 @@ class Executor:
         self._eval_step = jax.jit(eval_step)
         return self._eval_step
 
-    def build_forward(self, final_tensor, mode: CompMode = CompMode.COMP_MODE_INFERENCE):
+    def build_forward(self, final_tensor, mode: CompMode = CompMode.COMP_MODE_INFERENCE,
+                      seq_length: Optional[int] = None):
         """mode matters for the manual loop: the reference's forward() during
         training is a training-mode pass (dropout active, BN batch stats), so
-        FFModel passes its comp_mode here."""
+        FFModel passes its comp_mode here. seq_length: iteration truncation
+        — each distinct length jits its own (cached) executable."""
 
         def fwd(params, state, inputs, rng):
             values, new_state, _ = self.forward_values(
-                params, state, inputs, rng, mode
+                params, state, inputs, rng, mode, seq_length=seq_length
             )
             return values[final_tensor.guid], new_state
 
         self._forward_jit = jax.jit(fwd)
         return self._forward_jit
 
-    def build_grad_step(self, loss_fn, final_tensor):
+    def build_grad_step(self, loss_fn, final_tensor,
+                        seq_length: Optional[int] = None):
         """Separate backward pass for the manual forward/backward/update API
         (reference: FFModel::backward model.cc:2438)."""
 
         def grad_step(params, state, inputs, label, rng):
             def loss_of(p):
                 values, _, aux = self.forward_values(
-                    p, state, inputs, rng, CompMode.COMP_MODE_TRAINING
+                    p, state, inputs, rng, CompMode.COMP_MODE_TRAINING,
+                    seq_length=seq_length
                 )
                 return loss_fn(values[final_tensor.guid], label) + aux
 

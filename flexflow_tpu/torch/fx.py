@@ -10,6 +10,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List
 
+import numpy as np
+
 
 def _module_spec(mod) -> Dict[str, Any]:
     import torch.nn as nn
@@ -60,6 +62,10 @@ def _encode_arg(a) -> Any:
 
     if isinstance(a, tfx.Node):
         return {"node": a.name}
+    if isinstance(a, slice):
+        # bounds may themselves be traced nodes (size arithmetic)
+        return {"slice": [_encode_arg(a.start), _encode_arg(a.stop),
+                          _encode_arg(a.step)]}
     if isinstance(a, (list, tuple)):
         return [_encode_arg(x) for x in a]
     if isinstance(a, dict):
@@ -73,13 +79,25 @@ def _encode_arg(a) -> Any:
     return {"repr": repr(a)}
 
 
-def trace_to_records(model, tracer_cls=None) -> List[Dict[str, Any]]:
-    """Symbolically trace a torch module into .ff records."""
+def trace_to_records(model, tracer_cls=None,
+                     input_names=None) -> List[Dict[str, Any]]:
+    """Symbolically trace a torch module into .ff records.
+
+    HuggingFace models (transformers PreTrainedModel) go through
+    transformers.utils.fx.symbolic_trace, which handles their dynamic
+    control flow (reference: the HF tracing path of torch/model.py:
+    2427-2444); input_names selects the traced signature (e.g.
+    ["input_ids"])."""
     import torch.fx as tfx
 
     if tracer_cls is not None:
         graph = tracer_cls().trace(model)
         traced = tfx.GraphModule(model, graph)
+    elif type(model).__module__.startswith("transformers."):
+        from transformers.utils import fx as hf_fx
+
+        traced = hf_fx.symbolic_trace(
+            model, input_names=list(input_names) if input_names else None)
     else:
         traced = tfx.symbolic_trace(model)
     modules = dict(traced.named_modules())
@@ -99,8 +117,44 @@ def trace_to_records(model, tracer_cls=None) -> List[Dict[str, Any]]:
             rec["target_module"] = mod_name
         if node.op == "call_module":
             rec["module"] = _module_spec(modules[node.target])
+        if node.op == "get_attr":
+            # direct parameter/buffer access (reference:
+            # torch/model.py:2427+): capture the tensor value so the
+            # importer can materialize it as a constant (buffers) or a
+            # trainable parameter
+            val, trainable = _fetch_attr(traced, node.target)
+            val = val.detach().cpu()
+            import torch
+
+            if val.dtype == torch.bfloat16:  # numpy has no bf16
+                val = val.float()
+            arr = val.numpy()
+            rec["tensor"] = {
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "trainable": trainable,
+            }
+            if arr.size <= 65536:
+                rec["tensor"]["data"] = arr.tolist()
+            else:  # large params (tied embeddings etc.): raw bytes, not
+                # a 25x-bloated Python list
+                import base64
+
+                rec["tensor"]["data_b64"] = base64.b64encode(
+                    np.ascontiguousarray(arr).tobytes()).decode("ascii")
         records.append(rec)
     return records
+
+
+def _fetch_attr(mod, target: str):
+    """Resolve a dotted get_attr target; returns (tensor, trainable)."""
+    import torch
+
+    obj = mod
+    for part in target.split("."):
+        obj = getattr(obj, part)
+    trainable = isinstance(obj, torch.nn.Parameter) and obj.requires_grad
+    return obj, trainable
 
 
 def torch_to_flexflow(model, filename: str, tracer_cls=None) -> str:

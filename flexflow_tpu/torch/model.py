@@ -9,6 +9,8 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from ..core.tensor import Tensor
 from ..ffconst import ActiMode, AggrMode, PoolType
 
@@ -21,10 +23,200 @@ def _is_tensor(v) -> bool:
     return isinstance(v, Tensor)
 
 
+class _Const:
+    """A concrete value flowing through the import — get_attr
+    parameters/buffers and trace-time mask/position arithmetic. Folded
+    eagerly with numpy; materialized into the graph (create_constant) only
+    where a real tensor op consumes it."""
+
+    def __init__(self, value, trainable: bool = False):
+        self.value = np.asarray(value)
+        self.trainable = trainable
+
+    def __repr__(self):
+        return f"_Const{self.value.shape}"
+
+
+_TORCH_NP_DTYPES = {
+    "torch.float32": np.float32, "torch.float": np.float32,
+    "torch.float64": np.float64, "torch.float16": np.float16,
+    "torch.bfloat16": np.float32,  # folded math runs f32; cast at materialize
+    "torch.int64": np.int64, "torch.long": np.int64,
+    "torch.int32": np.int32, "torch.int": np.int32,
+    "torch.bool": np.bool_, "torch.uint8": np.uint8,
+}
+
+
+def _np_dtype(d, default=np.float32):
+    if d is None:
+        return default
+    if isinstance(d, dict):
+        d = d.get("dtype")
+    return _TORCH_NP_DTYPES.get(str(d), default)
+
+
+def _npv(v):
+    """Unwrap to a numpy-compatible value (non-Tensor args only)."""
+    return v.value if isinstance(v, _Const) else v
+
+
+def _foldable(v) -> bool:
+    """True when v (possibly nested) contains no graph Tensor."""
+    if isinstance(v, Tensor):
+        return False
+    if isinstance(v, (list, tuple)):
+        return all(_foldable(x) for x in v)
+    if isinstance(v, dict):
+        return all(_foldable(x) for x in v.values())
+    return True
+
+
+def _fold(target: str, args, kwargs):
+    """Evaluate trace-time tensor math (masks, position ids, size
+    arithmetic) eagerly with numpy. Returns NotImplemented when the target
+    is not a known fold."""
+    a = [_npv(x) for x in args]
+    k = {key: _npv(v) for key, v in kwargs.items()}
+
+    def wrap(v):
+        return _Const(v) if isinstance(v, np.ndarray) else v
+
+    def shape_args(rest):
+        if len(rest) == 1 and isinstance(rest[0], (list, tuple)):
+            return tuple(rest[0])
+        return tuple(rest)
+
+    try:
+        if target in ("add", "iadd"):
+            return wrap(a[0] + a[1])
+        if target in ("sub", "isub", "rsub"):
+            return wrap(a[0] - a[1])
+        if target in ("mul", "imul"):
+            return wrap(a[0] * a[1])
+        if target in ("truediv", "div"):
+            return wrap(a[0] / a[1])
+        if target == "floordiv":
+            return wrap(a[0] // a[1])
+        if target == "neg":
+            return wrap(-a[0])
+        if target == "abs":
+            return wrap(np.abs(a[0]))
+        if target in ("pow",):
+            return wrap(np.power(a[0], a[1]))
+        if target == "rsqrt":
+            return wrap(1.0 / np.sqrt(a[0]))
+        if target == "sqrt":
+            return wrap(np.sqrt(a[0]))
+        if target == "log":
+            return wrap(np.log(a[0]))
+        if target in ("eq", "ne", "gt", "lt", "ge", "le"):
+            return wrap(getattr(np, {"eq": "equal", "ne": "not_equal",
+                                     "gt": "greater", "lt": "less",
+                                     "ge": "greater_equal",
+                                     "le": "less_equal"}[target])(a[0], a[1]))
+        if target in ("min", "max"):
+            if len(a) > 1 and isinstance(a[1], (int, np.integer)) \
+                    and np.asarray(a[0]).ndim > 0:
+                # torch dim-reduction form: returns (values, indices)
+                dim = int(a[1])
+                red = np.min if target == "min" else np.max
+                arg = np.argmin if target == "min" else np.argmax
+                return (wrap(red(a[0], axis=dim)), wrap(arg(a[0], axis=dim)))
+            if len(a) > 1:
+                fn = np.minimum if target == "min" else np.maximum
+                return wrap(fn(a[0], a[1]))
+            return wrap((np.min if target == "min" else np.max)(a[0]))
+        if target == "minimum":
+            return wrap(np.minimum(a[0], a[1]))
+        if target == "maximum":
+            return wrap(np.maximum(a[0], a[1]))
+        if target == "where":
+            return wrap(np.where(a[0], a[1], a[2]))
+        if target == "triu":
+            return wrap(np.triu(a[0], k.get("diagonal", a[1] if len(a) > 1 else 0)))
+        if target == "cumsum":
+            return wrap(np.cumsum(a[0], axis=k.get("dim", a[1] if len(a) > 1 else None)))
+        if target == "arange":
+            return wrap(np.arange(*a, dtype=_np_dtype(k.get("dtype"), np.int64)))
+        if target == "ones":
+            return wrap(np.ones(shape_args(a), dtype=_np_dtype(k.get("dtype"))))
+        if target == "zeros":
+            return wrap(np.zeros(shape_args(a), dtype=_np_dtype(k.get("dtype"))))
+        if target == "full":
+            return wrap(np.full(tuple(a[0]), a[1],
+                                dtype=_np_dtype(k.get("dtype"))))
+        if target == "full_like":
+            return wrap(np.full_like(a[0], a[1]))
+        if target == "zeros_like":
+            return wrap(np.zeros_like(a[0]))
+        if target == "ones_like":
+            return wrap(np.ones_like(a[0]))
+        if target == "tensor":
+            return wrap(np.asarray(a[0]))
+        if target == "finfo":
+            return np.finfo(_np_dtype(args[0] if args else None))
+        if target == "getitem":
+            idx = args[1]
+            if isinstance(idx, list):
+                idx = tuple(x if isinstance(x, (slice, int)) else _npv(x)
+                            for x in idx)
+            return wrap(a[0][idx])
+        if target == "getattr":
+            return wrap(getattr(a[0], args[1]))
+        if target in ("to", "type_as"):
+            dt = _np_dtype(args[1] if len(args) > 1 else k.get("dtype"),
+                           default=None)
+            return wrap(a[0].astype(dt) if dt is not None else a[0])
+        if target in ("float",):
+            return wrap(np.asarray(a[0], np.float32))
+        if target in ("long", "int"):
+            return wrap(np.asarray(a[0], np.int64))
+        if target == "bool":
+            return wrap(np.asarray(a[0], np.bool_))
+        if target == "expand":
+            sizes = shape_args(a[1:])
+            src = np.asarray(a[0])
+            tgt = [s if s != -1 else src.shape[i]
+                   for i, s in enumerate(sizes)]
+            return wrap(np.broadcast_to(src, tuple(tgt)).copy())
+        if target == "masked_fill":
+            out = np.array(a[0], dtype=np.float32 if not np.issubdtype(
+                np.asarray(a[0]).dtype, np.floating) else None)
+            out[np.asarray(a[1], bool)] = a[2]
+            return wrap(out)
+        if target in ("unsqueeze",):
+            return wrap(np.expand_dims(a[0], a[1]))
+        if target in ("squeeze",):
+            return wrap(np.squeeze(a[0], a[1] if len(a) > 1 else None))
+        if target in ("view", "reshape"):
+            shape = shape_args(a[1:])
+            return wrap(np.reshape(a[0], shape))
+        if target in ("contiguous", "clone", "detach"):
+            return wrap(np.asarray(a[0]))
+        if target == "size":
+            return (list(np.asarray(a[0]).shape) if len(a) == 1
+                    else np.asarray(a[0]).shape[a[1]])
+        if target == "dim":
+            return np.asarray(a[0]).ndim
+        if target == "transpose":
+            arr = np.asarray(a[0])
+            perm = list(range(arr.ndim))
+            perm[a[1]], perm[a[2]] = perm[a[2]], perm[a[1]]
+            return wrap(arr.transpose(perm))
+        if target == "permute":
+            perm = shape_args(a[1:])
+            return wrap(np.asarray(a[0]).transpose(perm))
+    except Exception:
+        return NotImplemented
+    return NotImplemented
+
+
 class PyTorchModel:
-    def __init__(self, model_or_path, tracer_cls=None, batch_size: Optional[int] = None):
-        """model_or_path: a torch.nn.Module (traced on the fly) or a path to a
-        .ff file written by fx.torch_to_flexflow."""
+    def __init__(self, model_or_path, tracer_cls=None,
+                 batch_size: Optional[int] = None, input_names=None):
+        """model_or_path: a torch.nn.Module (traced on the fly; HuggingFace
+        models route through transformers' fx tracer — pass input_names) or
+        a path to a .ff file written by fx.torch_to_flexflow."""
         from . import fx
 
         self._torch_module = None
@@ -32,12 +224,17 @@ class PyTorchModel:
             self.records = fx.load_ff_file(model_or_path)
         else:
             self._torch_module = model_or_path
-            self.records = fx.trace_to_records(model_or_path, tracer_cls=tracer_cls)
+            self.records = fx.trace_to_records(
+                model_or_path, tracer_cls=tracer_cls, input_names=input_names)
         self.batch_size = batch_size
 
     # ------------------------------------------------------------------
     def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
         env = _Env()
+        # one materialized graph tensor per _Const object: a torch parameter
+        # read once via get_attr but consumed at several sites stays ONE
+        # (trainable) tensor, so gradients accumulate into a single weight
+        self._const_cache: Dict[int, Tensor] = {}
         inputs = list(input_tensors)
         outputs: List[Tensor] = []
         for rec in self.records:
@@ -51,10 +248,23 @@ class PyTorchModel:
             elif op == "call_method":
                 env[rec["name"]] = self._call_method(ffmodel, rec, env)
             elif op == "get_attr":
-                raise NotImplementedError(
-                    f"get_attr node {rec['name']} ({rec['target']}): direct "
-                    "parameter access is not supported by the importer"
-                )
+                t = rec.get("tensor")
+                if t is None:
+                    raise NotImplementedError(
+                        f"get_attr node {rec['name']} ({rec['target']}): the "
+                        ".ff file predates get_attr capture — re-trace it"
+                    )
+                if "data_b64" in t:
+                    import base64
+
+                    val = np.frombuffer(
+                        base64.b64decode(t["data_b64"]),
+                        dtype=np.dtype(t["dtype"]),
+                    ).reshape(t["shape"]).copy()
+                else:
+                    val = np.array(t["data"], dtype=np.dtype(t["dtype"]))
+                env[rec["name"]] = _Const(
+                    val, trainable=t.get("trainable", False))
             elif op == "output":
                 out = self._decode(rec["args"], env)[0]
                 outputs = list(out) if isinstance(out, (list, tuple)) else [out]
@@ -65,12 +275,32 @@ class PyTorchModel:
         if isinstance(a, dict):
             if "node" in a:
                 return env[a["node"]]
+            if "slice" in a:
+                return slice(*[self._decode(x, env) for x in a["slice"]])
             if "dtype" in a or "repr" in a:
                 return a
             return {k: self._decode(v, env) for k, v in a.items()}
         if isinstance(a, list):
             return [self._decode(x, env) for x in a]
         return a
+
+    def _materialize(self, fm, v, name: str):
+        """Turn a _Const into a graph tensor where an op needs one (cached
+        per _Const object, see apply)."""
+        if isinstance(v, _Const):
+            cached = self._const_cache.get(id(v))
+            if cached is not None:
+                return cached
+            val = v.value
+            if val.dtype == np.int64:  # jax default x64 is off
+                val = val.astype(np.int32)
+            if val.dtype == np.float64:
+                val = val.astype(np.float32)
+            t = fm.create_constant(val, trainable=v.trainable,
+                                   name=f"{name}_const")
+            self._const_cache[id(v)] = t
+            return t
+        return v
 
     def _args(self, rec, env):
         return self._decode(rec["args"], env), self._decode(rec["kwargs"], env)
@@ -80,8 +310,12 @@ class PyTorchModel:
         spec = rec["module"]
         t = spec["type"]
         args, kwargs = self._args(rec, env)
-        x = args[0] if args else None
         name = rec["name"]
+        # modules consume graph tensors: materialize folded constants (e.g.
+        # the position-id buffer feeding an Embedding)
+        args = [self._materialize(fm, a, f"{name}_in{i}")
+                for i, a in enumerate(args)]
+        x = args[0] if args else None
 
         if t == "Linear":
             return fm.dense(x, spec["out_features"], ActiMode.AC_MODE_NONE,
@@ -154,11 +388,25 @@ class PyTorchModel:
         name = rec["name"]
         args, kwargs = self._args(rec, env)
 
+        # trace-time math on concrete values (masks, position ids, sizes)
+        # folds eagerly; only ops touching graph tensors build graph nodes
+        if _foldable(args) and _foldable(kwargs):
+            folded = _fold(target, args, kwargs)
+            if folded is not NotImplemented:
+                return folded
+
         def binop(tensor_fn, scalar_fn, rev_scalar_fn=None, py_fn=None):
             """rev_scalar_fn(t, c) computes c OP t for non-commutative ops
             when the scalar is on the LEFT (e.g. 1.0 - x). Two plain numbers
-            (traced size() arithmetic) fold in Python via py_fn."""
+            (traced size() arithmetic) fold in Python via py_fn. _Const
+            operands scalarize when 0-d, else materialize as constants."""
             a, b = args[0], args[1]
+            if isinstance(a, _Const):
+                a = (float(a.value) if a.value.ndim == 0
+                     else self._materialize(fm, a, name))
+            if isinstance(b, _Const):
+                b = (float(b.value) if b.value.ndim == 0
+                     else self._materialize(fm, b, name))
             if not _is_tensor(a) and not _is_tensor(b):
                 return py_fn(a, b)
             if _is_tensor(a) and _is_tensor(b):
@@ -225,10 +473,16 @@ class PyTorchModel:
             p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
             return fm.dropout(args[0], p, name=name)
         if target == "getitem":
+            if _is_tensor(args[0]):
+                return self._tensor_getitem(fm, args[0], args[1], name)
             return args[0][args[1]]
         if target == "getattr":
             if args[1] == "shape":
                 return args[0].dims
+            if args[1] in ("device", "dtype"):
+                # trace-time placement/dtype introspection: a token value —
+                # consumed only by folded torch.* factory calls
+                return {"repr": f"tensor.{args[1]}"}
             raise NotImplementedError(f"getattr {args[1]}")
         if target in ("mean",):
             dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
@@ -246,14 +500,74 @@ class PyTorchModel:
             return fm.transpose(args[0], perm, name=name)
         if target == "reshape":
             return self._reshape(fm, args[0], args[1], name)
+        if target == "scaled_dot_product_attention":
+            return self._sdpa(fm, args, kwargs, name)
+        if target == "rsqrt":
+            return fm.rsqrt(args[0], name=name)
+        if target == "pow":
+            return fm.pow(args[0], float(args[1]), name=name)
+        if target == "exp":
+            return fm.exp(args[0], name=name)
+        if target in ("to", "type_as", "float", "contiguous", "clone",
+                      "detach"):
+            # graph tensors carry their dtype through lowering; trace-time
+            # dtype juggling is a no-op here
+            return args[0]
         raise NotImplementedError(f"call_function {target} not supported")
+
+    def _sdpa(self, fm, args, kwargs, name):
+        """torch.nn.functional.scaled_dot_product_attention on [B, H, L, D]
+        tensors, built from batch_matmul/softmax (the F.sdpa path HF BERT
+        traces to)."""
+        q, k, v = args[0], args[1], args[2]
+        # positional signature: (q, k, v, attn_mask, dropout_p, is_causal)
+        mask = kwargs.get("attn_mask", args[3] if len(args) > 3 else None)
+        dropout_p = kwargs.get("dropout_p",
+                               args[4] if len(args) > 4 else 0.0)
+        is_causal = kwargs.get("is_causal",
+                               args[5] if len(args) > 5 else False)
+        if dropout_p:
+            raise NotImplementedError("sdpa dropout_p not supported")
+        d = q.dims[-1]
+        lq, lk = q.dims[-2], k.dims[-2]
+        add_mask = None
+        if isinstance(mask, _Const):
+            mv = mask.value
+            if mv.dtype == np.bool_:
+                mv = np.where(mv, 0.0, -1e9).astype(np.float32)
+            if np.any(mv != 0.0):
+                add_mask = mv.astype(np.float32)
+            mask = None
+        elif mask is not None:
+            raise NotImplementedError("sdpa with a traced-tensor mask")
+        if is_causal:
+            causal = np.triu(np.full((lq, lk), -1e9, np.float32), 1)
+            add_mask = causal if add_mask is None else add_mask + causal
+        kt = fm.transpose(k, [0, 1, 3, 2], name=f"{name}_kT")
+        s = fm.batch_matmul(q, kt, name=f"{name}_qk")
+        s = fm.scalar_multiply(s, 1.0 / math.sqrt(d), name=f"{name}_scale")
+        if add_mask is not None:
+            # natural broadcast shape — the elementwise add broadcasts
+            s = fm.add(s, self._materialize(fm, _Const(add_mask),
+                                            f"{name}_mask"),
+                       name=f"{name}_masked")
+        p = fm.softmax(s, -1, name=f"{name}_probs")
+        return fm.batch_matmul(p, v, name=f"{name}_ctx")
 
     # -- call_method ----------------------------------------------------
     def _call_method(self, fm, rec, env):
         target = rec["target"]
         name = rec["name"]
         args, kwargs = self._args(rec, env)
+        if _foldable(args) and _foldable(kwargs):
+            folded = _fold(target, args, kwargs)
+            if folded is not NotImplemented:
+                return folded
         x = args[0]
+        if target in ("to", "type_as", "float", "clone", "detach"):
+            return x
+        if target == "dim":
+            return len(x.dims)
         if target in ("view", "reshape"):
             shape = args[1] if isinstance(args[1], list) else list(args[1:])
             return self._reshape(fm, x, shape, name)
@@ -294,9 +608,61 @@ class PyTorchModel:
             return fm.reshape(x, dims, name=name)
         if target == "softmax":
             return fm.softmax(x, args[1] if len(args) > 1 else -1, name=name)
+        if target == "pow":
+            return fm.pow(x, float(args[1]), name=name)
+        if target == "rsqrt":
+            return fm.rsqrt(x, name=name)
+        if target == "masked_fill":
+            mask, value = args[1], args[2]
+            if isinstance(mask, _Const):
+                mv = mask.value.astype(bool)
+                if not np.any(mv):
+                    return x
+                # exact replace semantics: x*(1-m) + value*m, constants at
+                # the mask's natural shape (elementwise ops broadcast)
+                keep = np.where(mv, 0.0, 1.0).astype(np.float32)
+                fill = np.where(mv, float(value), 0.0).astype(np.float32)
+                kept = fm.multiply(
+                    x, self._materialize(fm, _Const(keep), f"{name}_keep"),
+                    name=f"{name}_kept")
+                return fm.add(
+                    kept, self._materialize(fm, _Const(fill), f"{name}_fill"),
+                    name=name)
+            raise NotImplementedError("masked_fill with a traced mask")
         raise NotImplementedError(f"call_method {target} not supported")
 
     # -- helpers --------------------------------------------------------
+    def _tensor_getitem(self, fm, x, idx, name):
+        """Basic tensor indexing (x[:, 0], x[:, :L]) via split + reshape."""
+        if not isinstance(idx, (list, tuple)):
+            idx = [idx]
+        out = x
+        squeeze_axes = []
+        for ax, it in enumerate(idx):
+            size = out.dims[ax]
+            if isinstance(it, slice):
+                start, stop, step = it.indices(size)
+                if step != 1:
+                    raise NotImplementedError(f"strided getitem {it}")
+                if (start, stop) == (0, size):
+                    continue
+            elif isinstance(it, int):
+                start = it if it >= 0 else size + it
+                stop = start + 1
+                squeeze_axes.append(ax)
+            else:
+                raise NotImplementedError(f"getitem index {it!r}")
+            pre, mid, post = start, stop - start, size - stop
+            sizes = [s for s in (pre, mid, post) if s > 0]
+            if len(sizes) > 1:
+                out = fm.split(out, sizes, ax, name=f"{name}_ax{ax}")[
+                    1 if pre > 0 else 0]
+        if squeeze_axes:
+            dims = [d for ax, d in enumerate(out.dims)
+                    if ax not in squeeze_axes]
+            out = fm.reshape(out, dims, name=f"{name}_sq")
+        return out
+
     @staticmethod
     def _axes(x, dims):
         """torch dim=None means reduce over ALL axes."""

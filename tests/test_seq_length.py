@@ -1,0 +1,92 @@
+"""Iteration seq_length truncation (reference: FFIterationConfig
+config.h:162-167 threading into batch_matmul.cc:77-90 and attention):
+forward(seq_length=L) computes the first L positions only."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def test_attention_forward_truncates_to_seq_length():
+    B, S, E, H = 2, 8, 16, 4
+    L = 5
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, S, E).astype(np.float32)
+
+    config = ff.FFConfig()
+    config.batch_size = B
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([B, S, E])
+    out = model.multihead_attention(inp, inp, inp, E, H, name="attn")
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+
+    model.set_iteration_batch([x], np.zeros((B, S, E), np.float32))
+    full = np.asarray(model.forward())
+    trunc = np.asarray(model.forward(seq_length=L))
+
+    # reference oracle: running the full forward on the truncated input
+    model2 = ff.FFModel(config)
+    inp2 = model2.create_tensor([B, L, E])
+    out2 = model2.multihead_attention(inp2, inp2, inp2, E, H, name="attn")
+    model2.final_tensor = out2
+    model2.compile(optimizer=ff.SGDOptimizer(model2, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    model2.params = model.params  # same weights
+    model2.set_iteration_batch([x[:, :L]], np.zeros((B, L, E), np.float32))
+    ref = np.asarray(model2.forward())
+
+    np.testing.assert_allclose(trunc[:, :L], ref, rtol=1e-5, atol=1e-6)
+    assert np.all(trunc[:, L:] == 0.0)
+    # and the truncated pass differs from the full one (it really truncated)
+    assert not np.allclose(trunc[:, :L], full[:, :L])
+
+
+def test_batch_matmul_seq_length_dims_truncate():
+    B, S, D = 2, 6, 4
+    L = 3
+    rng = np.random.RandomState(4)
+    a = rng.randn(B, S, D).astype(np.float32)
+    b = rng.randn(B, D, S).astype(np.float32)
+
+    config = ff.FFConfig()
+    config.batch_size = B
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    ta = model.create_tensor([B, S, D])
+    tb = model.create_tensor([B, D, S])
+    out = model.batch_matmul(ta, tb, a_seq_length_dim=1, b_seq_length_dim=2)
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+
+    model.set_iteration_batch([a, b], np.zeros((B, S, S), np.float32))
+    got = np.asarray(model.forward(seq_length=L))
+    ref = a[:, :L] @ b[:, :, :L]
+    np.testing.assert_allclose(got[:, :L, :L], ref, rtol=1e-5, atol=1e-6)
+    assert np.all(got[:, L:, :] == 0.0) and np.all(got[:, :, L:] == 0.0)
+
+
+def test_backward_seq_length_zeroes_truncated_grads():
+    B, S, E, H = 2, 8, 16, 4
+    L = 4
+    config = ff.FFConfig()
+    config.batch_size = B
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([B, S, E])
+    out = model.multihead_attention(inp, inp, inp, E, H, name="attn")
+    model.dense(out, 3, name="cls")
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.01),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    x = np.random.RandomState(0).randn(B, S, E).astype(np.float32)
+    y = np.zeros((B, S, 1), dtype=np.int32)
+    model.set_iteration_batch([x], y)
+    model.forward(seq_length=L)
+    model.backward(seq_length=L)
+    model.update()
+    grads = model._manual["grads"]
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in __import__("jax").tree_util.tree_leaves(grads))
