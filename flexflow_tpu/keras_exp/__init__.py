@@ -1,0 +1,12 @@
+"""keras_exp: the tf.keras tracing frontend (reference:
+python/flexflow/keras_exp/models/model.py — a REAL tf.keras Model is run
+through keras2onnx and replayed by ONNXModelKeras).
+
+Same pipeline here: `Model(tf_keras_model)` converts the live model with
+tf2onnx when tensorflow is installed; `Model("model.onnx")` (or an onnx
+ModelProto) skips the conversion and replays an already-exported keras model
+through the ONNX importer. The native keras API (flexflow_tpu.keras) remains
+the non-tf path."""
+from .models import Model
+
+__all__ = ["Model"]

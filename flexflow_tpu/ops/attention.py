@@ -89,8 +89,17 @@ class MultiHeadAttentionOp(Op):
 
         # iteration seq_length truncation (reference: FFIterationConfig
         # threading, config.h:162-167): compute on the first L positions
-        # only — a static slice per distinct length, zero-padded back below
+        # only — a static slice per distinct length, zero-padded back below.
+        # Skipped under sequence parallelism: the ring kernel's shard_map
+        # needs the full length to divide the 'seq' mesh axis.
         L = getattr(ctx, "iter_seq_length", None)
+        seq_parallel_active = (
+            p.get("sequence_parallel", False)
+            and ctx.mesh is not None
+            and "seq" in getattr(ctx.mesh, "axis_names", ())
+        )
+        if seq_parallel_active:
+            L = None
         full_q_len = q_in.shape[1]
         if L is not None and L < full_q_len:
             import jax.lax as lax
@@ -116,11 +125,7 @@ class MultiHeadAttentionOp(Op):
         rate = p.get("dropout", 0.0)
         dropout_active = rate > 0.0 and ctx.mode == CompMode.COMP_MODE_TRAINING
 
-        if (
-            p.get("sequence_parallel", False)
-            and ctx.mesh is not None
-            and "seq" in getattr(ctx.mesh, "axis_names", ())
-        ):
+        if seq_parallel_active:
             # sequence/context parallelism: ring attention over the 'seq'
             # mesh axis (kernels/ring_attention.py) — K/V blocks rotate on
             # ICI neighbor links instead of materializing the full L x L

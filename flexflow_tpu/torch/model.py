@@ -27,11 +27,17 @@ class _Const:
     """A concrete value flowing through the import — get_attr
     parameters/buffers and trace-time mask/position arithmetic. Folded
     eagerly with numpy; materialized into the graph (create_constant) only
-    where a real tensor op consumes it."""
+    where a real tensor op consumes it. The materialized tensor is stored on
+    the object itself (not an id()-keyed cache — transient ids get reused),
+    so a parameter read once but consumed at several sites stays ONE weight.
+    source_target: the originating get_attr target, for weight transfer."""
 
-    def __init__(self, value, trainable: bool = False):
+    def __init__(self, value, trainable: bool = False,
+                 source_target: Optional[str] = None):
         self.value = np.asarray(value)
         self.trainable = trainable
+        self.source_target = source_target
+        self._tensor = None  # set by _materialize
 
     def __repr__(self):
         return f"_Const{self.value.shape}"
@@ -89,8 +95,10 @@ def _fold(target: str, args, kwargs):
     try:
         if target in ("add", "iadd"):
             return wrap(a[0] + a[1])
-        if target in ("sub", "isub", "rsub"):
+        if target in ("sub", "isub"):
             return wrap(a[0] - a[1])
+        if target == "rsub":  # torch.rsub(input, other) = other - input
+            return wrap(a[1] - a[0])
         if target in ("mul", "imul"):
             return wrap(a[0] * a[1])
         if target in ("truediv", "div"):
@@ -231,10 +239,8 @@ class PyTorchModel:
     # ------------------------------------------------------------------
     def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
         env = _Env()
-        # one materialized graph tensor per _Const object: a torch parameter
-        # read once via get_attr but consumed at several sites stays ONE
-        # (trainable) tensor, so gradients accumulate into a single weight
-        self._const_cache: Dict[int, Tensor] = {}
+        # get_attr target -> materialized constant-op name (weight transfer)
+        self._attr_op_names: Dict[str, str] = {}
         inputs = list(input_tensors)
         outputs: List[Tensor] = []
         for rec in self.records:
@@ -264,7 +270,8 @@ class PyTorchModel:
                 else:
                     val = np.array(t["data"], dtype=np.dtype(t["dtype"]))
                 env[rec["name"]] = _Const(
-                    val, trainable=t.get("trainable", False))
+                    val, trainable=t.get("trainable", False),
+                    source_target=rec["target"])
             elif op == "output":
                 out = self._decode(rec["args"], env)[0]
                 outputs = list(out) if isinstance(out, (list, tuple)) else [out]
@@ -286,11 +293,10 @@ class PyTorchModel:
 
     def _materialize(self, fm, v, name: str):
         """Turn a _Const into a graph tensor where an op needs one (cached
-        per _Const object, see apply)."""
+        on the _Const itself, see _Const docstring)."""
         if isinstance(v, _Const):
-            cached = self._const_cache.get(id(v))
-            if cached is not None:
-                return cached
+            if v._tensor is not None:
+                return v._tensor
             val = v.value
             if val.dtype == np.int64:  # jax default x64 is off
                 val = val.astype(np.int32)
@@ -298,7 +304,9 @@ class PyTorchModel:
                 val = val.astype(np.float32)
             t = fm.create_constant(val, trainable=v.trainable,
                                    name=f"{name}_const")
-            self._const_cache[id(v)] = t
+            v._tensor = t
+            if v.source_target is not None:
+                self._attr_op_names[v.source_target] = t.owner_op.name
             return t
         return v
 
@@ -754,4 +762,19 @@ class PyTorchModel:
                     put("bk", bk.reshape(h, hd))
                     put("bv", bv.reshape(h, hd))
                     put("bo", mod.out_proj.bias)
+        # get_attr-backed trainable parameters (materialized as ConstantOp
+        # weights): refresh from the module's CURRENT values too
+        from . import fx as _fx
+
+        for target, op_name in getattr(self, "_attr_op_names", {}).items():
+            if op_name not in (ffmodel.params or {}):
+                continue
+            val, _ = _fx._fetch_attr(self._torch_module, target)
+            slot = ffmodel.params[op_name]
+            import jax.numpy as jnp
+
+            slot["value"] = jnp.asarray(
+                val.detach().cpu().float().numpy()
+            ).astype(slot["value"].dtype)
+            copied += 1
         return copied

@@ -1,0 +1,49 @@
+"""Substitution tools (reference: tools/protobuf_to_json,
+tools/substitutions_to_dot)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PB = "/root/reference/substitutions/graph_subst_3_v2.pb"
+JSON_REF = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+@pytest.mark.skipif(not os.path.exists(PB), reason="reference pb not present")
+def test_protobuf_to_json_roundtrips_reference_file(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "protobuf_to_json.py"), PB],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    conv = json.loads(out)
+    ref = json.load(open(JSON_REF))
+    assert len(conv["rule"]) == len(ref["rule"]) == 640
+
+    def strip(r):
+        return {k: r[k] for k in ("srcOp", "dstOp", "mappedOutput")}
+
+    assert all(strip(a) == strip(b)
+               for a, b in zip(conv["rule"], ref["rule"]))
+    # and the converted file loads in the search's rule loader
+    from flexflow_tpu.search.substitution_loader import (
+        rules_from_spec,
+        summarize,
+    )
+
+    assert summarize(rules_from_spec(conv))["supported"] == 640
+
+
+@pytest.mark.skipif(not os.path.exists(JSON_REF),
+                    reason="reference json not present")
+def test_substitutions_to_dot_renders_rule():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "substitutions_to_dot.py"),
+         JSON_REF, "taso_rule_448"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert out.startswith("digraph substitution")
+    assert "cluster_src" in out and "cluster_dst" in out
+    assert "OP_LINEAR" in out

@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Convert a TASO substitution rule .pb file to the JSON rule format the
+search consumes (reference: tools/protobuf_to_json/protobuf_to_json.cc +
+rules.proto).
+
+The schema (GraphSubst.RuleCollection, proto2) is tiny and fixed, so this
+decodes the wire format directly — no generated bindings, no protobuf
+runtime-version coupling.
+
+Usage: python tools/protobuf_to_json.py graph_subst.pb > graph_subst.json
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+# enum value -> wire name (reference: protobuf_to_json.cc:14-80)
+OP_NAMES = [
+    "OP_INPUT", "OP_WEIGHT", "OP_ANY", "OP_CONV2D", "OP_DROPOUT",
+    "OP_LINEAR", "OP_POOL2D_MAX", "OP_POOL2D_AVG", "OP_RELU", "OP_SIGMOID",
+    "OP_TANH", "OP_BATCHNORM", "OP_CONCAT", "OP_SPLIT", "OP_RESHAPE",
+    "OP_TRANSPOSE", "OP_EW_ADD", "OP_EW_MUL", "OP_MATMUL", "OP_MUL",
+    "OP_ENLARGE", "OP_MERGE_GCONV", "OP_CONSTANT_IMM", "OP_CONSTANT_ICONV",
+    "OP_CONSTANT_ONE", "OP_CONSTANT_POOL", "OP_PARTITION", "OP_COMBINE",
+    "OP_REPLICATE", "OP_REDUCE", "OP_EMBEDDING",
+]
+# reference: protobuf_to_json.cc:82-99
+PM_NAMES = [
+    "PM_OP_TYPE", "PM_NUM_INPUTS", "PM_NUM_OUTPUTS", "PM_GROUP",
+    "PM_KERNEL_H", "PM_KERNEL_W", "PM_STRIDE_H", "PM_STRIDE_W", "PM_PAD",
+    "PM_ACTI", "PM_NUMDIM", "PM_AXIS", "PM_PERM", "PM_OUTSHUFFLE",
+    "PM_MERGE_GCONV_COUNT", "PM_PARALLEL_DIM", "PM_PARALLEL_DEGREE",
+]
+
+
+def _decode_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _decode_message(buf: bytes):
+    """-> {field_number: [values]}; values are ints or sub-message bytes."""
+    fields = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _decode_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, pos = _decode_varint(buf, pos)
+        elif wire == 2:  # length-delimited (sub-message here)
+            length, pos = _decode_varint(buf, pos)
+            val = buf[pos:pos + length]
+            pos += length
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def _int32(v: int) -> int:
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def _parameter(buf):  # Parameter {key=1, value=2}
+    f = _decode_message(buf)
+    key = _int32(f[1][0])
+    return {
+        "_t": "Parameter",
+        "key": PM_NAMES[key] if 0 <= key < len(PM_NAMES) else key,
+        "value": _int32(f[2][0]),
+    }
+
+
+def _tensor(buf):  # Tensor {opId=1, tsId=2}
+    f = _decode_message(buf)
+    return {"_t": "Tensor", "opId": _int32(f[1][0]), "tsId": _int32(f[2][0])}
+
+
+def _operator(buf):  # Operator {type=1, input=2*, para=3*}
+    f = _decode_message(buf)
+    t = _int32(f[1][0])
+    return {
+        "_t": "Operator",
+        "type": OP_NAMES[t] if 0 <= t < len(OP_NAMES) else t,
+        "input": [_tensor(b) for b in f.get(2, [])],
+        "para": [_parameter(b) for b in f.get(3, [])],
+    }
+
+
+def _map_output(buf):  # MapOutput {srcOpId=1, dstOpId=2, srcTsId=3, dstTsId=4}
+    f = _decode_message(buf)
+    return {
+        "_t": "MapOutput",
+        "srcOpId": _int32(f[1][0]), "dstOpId": _int32(f[2][0]),
+        "srcTsId": _int32(f[3][0]), "dstTsId": _int32(f[4][0]),
+    }
+
+
+def _rule(buf, idx):  # Rule {srcOp=1*, dstOp=2*, mappedOutput=3*}
+    f = _decode_message(buf)
+    return {
+        "_t": "Rule",
+        "name": f"rule_{idx}",
+        "srcOp": [_operator(b) for b in f.get(1, [])],
+        "dstOp": [_operator(b) for b in f.get(2, [])],
+        "mappedOutput": [_map_output(b) for b in f.get(3, [])],
+    }
+
+
+def convert(pb_bytes: bytes) -> dict:
+    top = _decode_message(pb_bytes)  # RuleCollection {rule=1*}
+    return {
+        "_t": "RuleCollection",
+        "rule": [_rule(b, i) for i, b in enumerate(top.get(1, []))],
+    }
+
+
+def main(argv):
+    if len(argv) != 2:
+        print(f"Usage: {argv[0]} <rules.pb>", file=sys.stderr)
+        return 1
+    with open(argv[1], "rb") as f:
+        doc = convert(f.read())
+    json.dump(doc, sys.stdout, indent=1)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Render one substitution rule of a TASO rule file as graphviz dot
+(reference: tools/substitutions_to_dot/substitution_to_dot.cc — src and dst
+pattern graphs as two subgraphs with tensor nodes).
+
+Usage: python tools/substitutions_to_dot.py <json-file> <rule-name>
+"""
+from __future__ import annotations
+
+import sys
+
+
+def rule_to_dot(rule) -> str:
+    lines = ["digraph substitution {", "  rankdir=TB;"]
+    for side, ops in (("src", rule.src_ops), ("dst", rule.dst_ops)):
+        lines.append(f"  subgraph cluster_{side} {{")
+        lines.append(f'    label="{side}";')
+        for i, op in enumerate(ops):
+            para = ", ".join(f"{k}={v}" for k, v in op.params.items())
+            label = op.type_name + (f"\\n{para}" if para else "")
+            lines.append(f'    {side}_op{i} [label="{label}", shape=box];')
+            for j, t in enumerate(op.inputs):
+                if t.is_external:
+                    ext = f"{side}_in{-t.op_id - 1}"
+                    lines.append(
+                        f'    {ext} [label="input {-t.op_id - 1}", '
+                        "shape=ellipse];"
+                    )
+                    lines.append(f"    {ext} -> {side}_op{i} "
+                                 f'[label="arg{j}"];')
+                else:
+                    lines.append(
+                        f"    {side}_op{t.op_id} -> {side}_op{i} "
+                        f'[label="out{t.ts_id}->arg{j}"];'
+                    )
+        lines.append("  }")
+    for m in rule.mapped_outputs:
+        lines.append(
+            f"  src_op{m.src_op_id} -> dst_op{m.dst_op_id} "
+            '[style=dashed, label="maps", constraint=false];'
+        )
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(f"Usage: {argv[0]} <json-file> <rule-name>", file=sys.stderr)
+        return 1
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from flexflow_tpu.search.substitution_loader import load_substitution_file
+
+    rules = load_substitution_file(argv[1])
+    for rule in rules:
+        if rule.name == argv[2]:
+            print(rule_to_dot(rule))
+            return 0
+    print(f"Could not find rule with name {argv[2]}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
