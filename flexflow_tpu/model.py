@@ -765,7 +765,20 @@ class FFModel:
                         )
                     w.parallel_shape = ParallelTensorShape(dims, w.dtype)
             elif op_tp > 1:
-                self._assign_tp_weights(op, op_tp)
+                row = bool(s and s.tp_row and op.op_type == OpType.LINEAR)
+                self._assign_tp_weights(op, op_tp, row=row)
+                if row and op.inputs and op.inputs[0].parallel_shape is not None:
+                    # Megatron pairing: the row-parallel linear consumes its
+                    # input sharded on the contraction (feature) dim — the
+                    # column-parallel producer's output then never gathers
+                    t_in = op.inputs[0]
+                    if t_in.dims[-1] % op_tp == 0:
+                        pdims = list(t_in.parallel_shape.dims)
+                        pdims[-1] = ParallelDim(
+                            t_in.dims[-1], op_tp, "model",
+                            kind=ParallelDimKind.CHANNEL)
+                        t_in.parallel_shape = ParallelTensorShape(
+                            pdims, t_in.dtype)
             elif tp > 1:
                 # non-TP op under a TP mesh: weights replicated
                 for w in op.weights:
@@ -805,11 +818,14 @@ class FFModel:
             elif op.op_type == OpType.REPLICATE:
                 op.apply_parallel_shape()
 
-    def _assign_tp_weights(self, op: Op, tp: int) -> None:
-        """Shard weight dims over the 'model' axis where the op supports TP."""
+    def _assign_tp_weights(self, op: Op, tp: int, row: bool = False) -> None:
+        """Shard weight dims over the 'model' axis where the op supports TP.
+        row=True (LINEAR only): kernel shards the INPUT-feature dim and the
+        bias stays replicated — the reduction-parallel half of Megatron."""
         from .search.simulator import TP_WEIGHT_SHARD_DIMS
 
-        shard_dim = TP_WEIGHT_SHARD_DIMS.get(op.op_type)
+        shard_dim = ({"kernel": 0} if row
+                     else TP_WEIGHT_SHARD_DIMS.get(op.op_type))
         for w in op.weights:
             ws = w._weight_spec
             dims = [ParallelDim(s, 1, None) for s in w.dims]

@@ -50,6 +50,12 @@ class OpStrategy:
     tp: int = 1
     ep: int = 1
     ap: int = 1
+    # reduction/"parameter" parallelism (LINEAR only): the kernel shards on
+    # the INPUT-feature dim; the output is a partial sum all-reduced by
+    # GSPMD — the Megatron row-parallel half, paired with a column-parallel
+    # producer whose sharded output it consumes for free (reference:
+    # --enable-parameter-parallel + ReductionOp, src/parallel_ops/reduction.cc)
+    tp_row: bool = False
 
     @property
     def degree(self) -> int:
@@ -119,12 +125,20 @@ class CostModel:
         return _MEMORY_BOUND_BWD_FACTOR * self.forward_time_us(op, s)
 
     def tp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
-        """Extra collective a TP op needs per step (e.g. the Combine/allgather
-        after a column-parallel linear)."""
+        """Extra collective a TP op needs per step: a row-parallel linear
+        all-reduces its partial-sum output; a column-parallel op's gather is
+        edge-dependent (tp_boundary_time_us) and not charged here."""
         if s.tp <= 1 or op.op_type not in TP_CAPABLE or not op.outputs:
             return 0.0
         out = op.outputs[0]
         bytes_ = out.num_elements() * self.op_dtype_bytes(op) / max(1, s.dp)
+        if s.tp_row:
+            # the Megatron pair costs TWO allreduces per step: fwd partial
+            # sums here, plus the bwd allreduce at the pair entry (the
+            # column partner's input gradient — same bytes for the
+            # canonical d->4d->d pairing); simulate() charges half in each
+            # pass
+            return 2.0 * self.machine.allreduce_time_us(bytes_, s.tp)
         # fwd allgather + bwd reduce_scatter of the same bytes
         return self.machine.allgather_time_us(bytes_ / s.tp, s.tp) + \
             self.machine.reduce_scatter_time_us(bytes_, s.tp)
@@ -187,18 +201,17 @@ class CostModel:
     def tp_boundary_time_us(self, tensor_bytes: float, src_op: Op,
                             src: OpStrategy, dst: OpStrategy,
                             backward: bool = False) -> float:
-        """TP reshard on an edge: a TP op's output is sharded over 'model';
-        a consumer at a *different* tp degree needs an allgather in fwd and
-        the mirrored reduce_scatter of the gradient in bwd (charged by the
-        pass that incurs it). Consumers at the SAME degree keep the
-        activation sharded (the Megatron column->row pairing GSPMD also
-        finds), so interior same-tp edges are free — per-edge costing
-        replaces the old unconditional per-op collective, fixing both the
-        free-mismatch-edge hole and the interior-edge overcharge."""
-        if src_op.op_type not in TP_CAPABLE or src.tp <= 1:
+        """TP reshard on an edge. A column-parallel producer's output is
+        sharded over 'model': a row-parallel consumer at the SAME degree
+        consumes it sharded for free (the Megatron column->row pairing);
+        any other consumer needs the allgather in fwd and the mirrored
+        gradient reduce_scatter in bwd (charged by the pass that incurs
+        it). A row-parallel producer's output is already replicated after
+        its all-reduce (tp_collective_time_us), so its edges are free."""
+        if src_op.op_type not in TP_CAPABLE or src.tp <= 1 or src.tp_row:
             return 0.0
-        if dst.tp == src.tp:
-            return 0.0
+        if dst.tp == src.tp and dst.tp_row:
+            return 0.0  # paired column->row: stays sharded
         if backward:
             return self.machine.reduce_scatter_time_us(
                 tensor_bytes / max(1, src.dp), src.tp)
@@ -251,18 +264,26 @@ class CostModel:
         activations saved for the backward pass. Liveness: fusion-transient
         outputs (elementwise/reshape) are excluded — XLA never materializes
         them as saved buffers."""
-        wb = sum(w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights)
         wshard = s.tp if op.op_type in TP_CAPABLE else 1
         if op.op_type == OpType.EXPERTS:
             wshard = s.ep
-        wb /= max(1, wshard)
+        wb = 0.0
+        for w in op.weights:
+            b = w.num_elements() * w.dtype.np_dtype.itemsize
+            # row-parallel: only the kernel shards; the bias is replicated
+            if s.tp_row and w._weight_spec.name != "kernel":
+                wb += b
+            else:
+                wb += b / max(1, wshard)
         if op.op_type in self.FUSION_TRANSIENT:
             return self.opt_state_factor * wb
         ab = sum(t.num_elements() * t.dtype.np_dtype.itemsize for t in op.outputs)
-        # activations shard over dp (tp for TP ops, ap for spatial ops);
+        # activations shard over dp (tp for column-TP ops, ap for spatial
+        # ops); row-parallel outputs are replicated after their all-reduce;
         # EXPERTS outputs are data-sharded only — the expert axis shards
         # weights/buffers, not them
-        ashard = s.dp * (s.tp if op.op_type in TP_CAPABLE else 1)
+        ashard = s.dp * (s.tp if op.op_type in TP_CAPABLE
+                         and not s.tp_row else 1)
         if op.op_type in AP_CAPABLE:
             ashard *= s.ap
         ab /= max(1, ashard)
@@ -363,9 +384,14 @@ class OpCostCache:
         spatial ap) still scale the measured dp point analytically."""
         if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
             return 0.0, 0.0
-        measurable_tp = (s.tp if s.tp > 1 and op.op_type in self.TP_WEIGHT_DIMS
-                         and self._tp_shardable(op, s.tp) else 1)
+        row = bool(s.tp_row) and op.op_type == OpType.LINEAR
+        dims_map = ({"kernel": 0} if row
+                    else self.TP_WEIGHT_DIMS.get(op.op_type))
+        measurable_tp = (s.tp if s.tp > 1 and dims_map
+                         and self._tp_shardable(op, s.tp, dims_map) else 1)
         key = self._key(op, s.dp, measurable_tp)
+        if row and measurable_tp > 1:
+            key = key + ("row",)
         if key in self.cache:
             self.hits += 1
             fwd, bwd = self.cache[key]
@@ -390,7 +416,9 @@ class OpCostCache:
             else:
                 self.misses += 1
                 try:
-                    fwd, bwd = self._measure(op, s.dp, measurable_tp)
+                    fwd, bwd = self._measure(op, s.dp, measurable_tp,
+                                             tp_dims=dims_map,
+                                             shard_input_dim=-1 if row else None)
                     self.cache[key] = (fwd, bwd)
                 except Exception as exc:
                     self.failures[key] = f"{type(exc).__name__}: {exc}"
@@ -407,8 +435,8 @@ class OpCostCache:
             scale = s.ap
         return fwd / scale, (bwd / scale if bwd >= 0 else bwd)
 
-    def _tp_shardable(self, op: Op, tp: int) -> bool:
-        dims_map = self.TP_WEIGHT_DIMS[op.op_type]
+    def _tp_shardable(self, op: Op, tp: int, dims_map=None) -> bool:
+        dims_map = dims_map or self.TP_WEIGHT_DIMS[op.op_type]
         for w in op.weights:
             name = w._weight_spec.name
             if name in dims_map:
@@ -417,7 +445,8 @@ class OpCostCache:
                     return False
         return True
 
-    def _measure(self, op: Op, dp: int, tp: int = 1) -> Tuple[float, float]:
+    def _measure(self, op: Op, dp: int, tp: int = 1, tp_dims=None,
+                 shard_input_dim=None) -> Tuple[float, float]:
         import jax
         import jax.numpy as jnp
 
@@ -428,12 +457,19 @@ class OpCostCache:
             dims = list(t.dims)
             if dims and dims[0] % max(dp, 1) == 0:
                 dims[0] //= max(dp, 1)
+            if (shard_input_dim is not None and tp > 1
+                    and dims[shard_input_dim] % tp == 0):
+                # row-parallel: the contraction dim shards with the kernel
+                dims[shard_input_dim] //= tp
             return tuple(dims)
 
         key_rng = jax.random.PRNGKey(0)
         cfg = self._op_config(op, self.config)
         ins = [jnp.zeros(local_shape(t), t.dtype.jnp_dtype) for t in op.inputs]
-        tp_dims = self.TP_WEIGHT_DIMS.get(op.op_type, {}) if tp > 1 else {}
+        if tp <= 1:
+            tp_dims = {}
+        elif tp_dims is None:
+            tp_dims = self.TP_WEIGHT_DIMS.get(op.op_type, {})
         weights = {}
         for w in op.weights:
             ws = w._weight_spec
@@ -600,10 +636,13 @@ class Simulator:
                              out_ready[src_op.guid])
                 ready = max(ready, e)
             fin = run_compute(fwd, ready)
-            # op-internal fwd collectives (expert all_to_all, conv halos)
-            # gate the op's output
+            # op-internal fwd collectives gate the op's output: expert
+            # all_to_all, conv halos, and the row-parallel linear's
+            # partial-sum allreduce
             intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
                            + self.cost.ap_halo_time_us(op, s))
+            if s.tp_row:
+                intra += 0.5 * self.cost.tp_collective_time_us(op, s)
             out_ready[op.guid] = run_comm(intra, fin)
 
         # -- backward (reverse topo: bwd(op) after bwd of its consumers) ---
@@ -631,6 +670,8 @@ class Simulator:
             fin = run_compute(bwd, ready)
             intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
                            + self.cost.ap_halo_time_us(op, s))
+            if s.tp_row:  # bwd allreduce at the Megatron pair entry
+                intra += 0.5 * self.cost.tp_collective_time_us(op, s)
             fin = run_comm(intra, fin)
             bwd_end[op.guid] = fin
             # weight-gradient allreduce: async on the ICI stream; the

@@ -54,14 +54,20 @@ def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
     dps = [d for d in (dp, 1) if batch_size % max(d, 1) == 0]
     if not dps:
         dps = [1]
-    tps = [1]
+    tps = [(1, False)]
     if (
         tp > 1
         and op.op_type in TP_CAPABLE
         and not config.only_data_parallel
     ):
         if _tp_divides(op, tp):
-            tps = [tp, 1]
+            tps = [(tp, False), (1, False)]
+        # reduction/"parameter" parallelism: row-parallel linear (kernel
+        # shards on in-features; reference: --enable-parameter-parallel)
+        if (config.enable_parameter_parallel
+                and op.op_type == OpType.LINEAR
+                and op.inputs[0].dims[-1] % tp == 0):
+            tps.append((tp, True))
     eps = [1]
     if (
         ep > 1
@@ -80,10 +86,11 @@ def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
     ):
         aps = [ap, 1]
     for d in dps:
-        for t in tps:
+        for t, row in tps:
             for e in eps:
                 for a in aps:
-                    menu.append(OpStrategy(dp=d, tp=t, ep=e, ap=a))
+                    menu.append(OpStrategy(dp=d, tp=t, ep=e, ap=a,
+                                           tp_row=row))
     return menu
 
 
@@ -197,10 +204,25 @@ class GraphSearchHelper:
                                      + lam * self.sim.cost.op_memory_bytes(op, s))
             )
         # base_optimize: best-first over single-op strategy flips
+        best = self._best_first_flips(
+            seg, strategies,
+            lambda st: self._segment_cost(seg_graph, st, lam),
+            dp, tp, batch, ep, ap)
+        self._memo[key] = best
+        return best
+
+    def _best_first_flips(self, ops: List[Op],
+                          strategies: Dict[int, OpStrategy],
+                          cost_fn, dp: int, tp: int, batch: int,
+                          ep: int, ap: int) -> Dict[int, OpStrategy]:
+        """Best-first refinement over single-op strategy flips with alpha
+        pruning and the iteration budget (reference: base_optimize,
+        substitution.cc:2229-2311) — shared by the per-segment DP and the
+        whole-graph cross-segment pass."""
         budget = max(0, self.config.search_budget)
         alpha = self.config.search_alpha
         best = dict(strategies)
-        best_cost = self._segment_cost(seg_graph, best, lam)
+        best_cost = cost_fn(best)
         counter = itertools.count()
         pq: List[Tuple[float, int, Dict[int, OpStrategy]]] = [
             (best_cost, next(counter), best)
@@ -211,21 +233,20 @@ class GraphSearchHelper:
             pops += 1
             if cost > best_cost * alpha:
                 continue  # prune (reference: substitution.cc:2278)
-            for op in seg:
+            for op in ops:
                 for s in valid_strategies(op, dp, tp, batch, self.config,
                                           ep=ep, ap=ap):
-                    if s == cur[op.guid]:
+                    if s == cur.get(op.guid):
                         continue
                     if not self._tp_ok(op, s):
                         continue  # rule file doesn't propose this TP
                     cand = dict(cur)
                     cand[op.guid] = s
-                    c = self._segment_cost(seg_graph, cand, lam)
+                    c = cost_fn(cand)
                     if c < best_cost:
                         best, best_cost = cand, c
                     if c < cost * alpha:
                         heapq.heappush(pq, (c, next(counter), cand))
-        self._memo[key] = best
         return best
 
     # -- top level --------------------------------------------------------
@@ -330,6 +351,12 @@ class GraphSearchHelper:
                 strategies.update(
                     self._optimize_segment(seg, dp, tp, batch_size,
                                            ep=ep, ap=ap, lam=lam))
+            # cross-segment refinement: per-segment DP cannot see reshard
+            # costs across segment boundaries (e.g. the column->row TP
+            # pairing on a chain, where every node is its own segment) —
+            # re-optimize single-op flips against the FULL-graph simulate
+            strategies = self._refine_global(graph, strategies, dp, tp,
+                                             batch_size, ep, ap, lam)
             cost = self.sim.simulate(graph, strategies)
             mem = self.sim.memory_bytes(graph, strategies)
             candidates.append(
@@ -344,6 +371,66 @@ class GraphSearchHelper:
         best = min(candidates, key=lambda r: r.cost_us + lam * r.memory_bytes)
         if not quiet:
             self.log.extend(c.log[0] for c in candidates)
+        return best
+
+    def _boundary_ops(self, graph: Graph) -> List[Op]:
+        """Ops with an edge crossing a segment boundary — the only ops whose
+        flips the per-segment DP mis-costed."""
+        seg_of: Dict[int, int] = {}
+        for i, seg in enumerate(self._segments(graph)):
+            for op in seg:
+                seg_of[op.guid] = i
+        seen = set()
+        uniq: List[Op] = []
+
+        def add(op):
+            if op.guid not in seen:
+                seen.add(op.guid)
+                uniq.append(op)
+
+        for op in graph.topo_order():
+            # cross-segment producers in input order (deterministic — the
+            # native core iterates its edge list the same way)
+            cross = [t.owner_op for t in op.inputs
+                     if t.owner_op is not None
+                     and t.owner_op.guid in graph.ops
+                     and seg_of.get(t.owner_op.guid) != seg_of.get(op.guid)]
+            if not cross:
+                continue
+            add(op)
+            for src in cross:
+                add(src)
+        return uniq
+
+    def _refine_global(self, graph: Graph, strategies: Dict[int, OpStrategy],
+                       dp: int, tp: int, batch: int, ep: int = 1,
+                       ap: int = 1, lam: float = 0.0) -> Dict[int, OpStrategy]:
+        """Whole-graph best-first refinement, costed by the event-driven
+        full-graph simulate — the pass that sees cross-segment edge
+        interactions the per-segment DP cannot (reference: base_optimize
+        runs its flips against Graph::optimal_cost of the whole graph,
+        substitution.cc:2229). Flip candidates are restricted to
+        segment-boundary ops: interior flips were already optimal under the
+        segment DP, so sweeping them against the (much costlier) full-graph
+        simulate only burns budget."""
+        budget = max(0, self.config.search_budget)
+        ops = self._boundary_ops(graph)
+        if budget == 0 or not ops:
+            return strategies
+        key = (tuple(sorted(graph.ops)), dp, tp, ep, ap, round(lam, 15),
+               "global")
+        if key in self._memo:
+            return self._memo[key]
+
+        def cost_of(st):
+            c = self.sim.simulate(graph, st)
+            if lam:
+                c += lam * self.sim.memory_bytes(graph, st)
+            return c
+
+        best = self._best_first_flips(ops, strategies, cost_of,
+                                      dp, tp, batch, ep, ap)
+        self._memo[key] = best
         return best
 
     def _lambda_search(self, select, budget: float,
@@ -558,6 +645,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     if (simulator is None and not is_taso and not has_experts
             and not wants_attr and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
+            and not config.enable_parameter_parallel  # row-TP is Python-only
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -585,7 +673,7 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         "memory_bytes": result.memory_bytes,
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
-                                   "ap": s.ap}
+                                   "ap": s.ap, "tp_row": s.tp_row}
             for guid, s in result.strategies.items()
             if guid in graph.ops
         },
@@ -603,5 +691,6 @@ def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dic
     for name, s in data["ops"].items():
         if name in by_name:
             strategies[by_name[name].guid] = OpStrategy(
-                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1))
+                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1),
+                tp_row=s.get("tp_row", False))
     return strategies, data.get("mesh_axes", {})

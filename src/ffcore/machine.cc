@@ -72,15 +72,16 @@ double CostModel::xfer_us(double bytes, const Strategy& src,
   return m_.allgather_us(bytes / n, n);
 }
 
-// TP reshard on an edge: charged only at tp-degree boundaries — fwd pays
-// the allgather, bwd the mirrored gradient reduce_scatter; same-degree
-// interior edges keep activations sharded (Megatron column->row pairing).
-// Mirrors simulator.py tp_boundary_time_us exactly.
+// TP reshard on an edge: a column-parallel producer's sharded output costs
+// an allgather in fwd / gradient reduce_scatter in bwd for any consumer.
+// (The free Megatron column->row pairing needs the row-parallel mode, which
+// only the Python search emits — --enable-parameter-parallel routes there.)
+// Mirrors simulator.py tp_boundary_time_us for tp_row=False strategies.
 double CostModel::tp_boundary_us(double bytes, const NodeDesc& src_n,
                                  const Strategy& src, const Strategy& dst,
                                  bool backward) const {
+  (void)dst;
   if (!src_n.tp_capable || src.tp <= 1) return 0.0;
-  if (dst.tp == src.tp) return 0.0;
   if (backward)
     return m_.reduce_scatter_us(bytes / std::max(1, src.dp), src.tp);
   double shard = bytes / std::max(1, src.dp * src.tp);

@@ -103,6 +103,66 @@ static std::map<int64_t, Strategy> optimize_segment(
   return best;
 }
 
+// whole-graph best-first refinement over single-op flips, costed by the
+// full-graph event-driven simulate (cross-segment interactions). Flip
+// candidates restricted to segment-boundary ops — interior flips were
+// already optimal under the segment DP (mirrors unity.py
+// GraphSearchHelper._refine_global + _boundary_ops exactly).
+static void refine_global(const Graph& g, const Simulator& sim, int dp,
+                          int tp, const Options& o,
+                          const std::vector<std::vector<int>>& segs,
+                          std::map<int64_t, Strategy>& strategies) {
+  if (o.budget <= 0 || g.nodes.size() < 2) return;
+  std::map<int64_t, int> seg_of;
+  for (size_t i = 0; i < segs.size(); ++i)
+    for (int u : segs[i]) seg_of[g.nodes[u].guid] = (int)i;
+  // boundary ops in topo order: edge-crossing dsts, then their cross srcs
+  std::vector<int64_t> cand_order;
+  std::set<int64_t> cand_set;
+  auto add = [&](int64_t guid) {
+    if (cand_set.insert(guid).second) cand_order.push_back(guid);
+  };
+  for (int u : g.topo_order()) {
+    int64_t guid = g.nodes[u].guid;
+    std::vector<int64_t> cross_srcs;
+    for (const auto& e : g.edges)
+      if (e.dst == guid && seg_of.count(e.src) &&
+          seg_of[e.src] != seg_of[guid])
+        cross_srcs.push_back(e.src);
+    if (cross_srcs.empty()) continue;
+    add(guid);
+    for (int64_t s : cross_srcs) add(s);
+  }
+  if (cand_order.empty()) return;
+  auto best = strategies;
+  double best_cost = sim.simulate(best);
+  std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
+  uint64_t counter = 0;
+  pq.push({best_cost, counter++, best});
+  int pops = 0;
+  while (!pq.empty() && pops < o.budget) {
+    Candidate cur = pq.top();
+    pq.pop();
+    pops++;
+    if (cur.cost > best_cost * o.alpha) continue;
+    for (int64_t guid : cand_order) {
+      const NodeDesc& n = g.nodes[g.index.at(guid)];
+      for (const auto& s : menu(n, dp, tp, o)) {
+        if (s == cur.strategies[n.guid]) continue;
+        auto cand = cur.strategies;
+        cand[n.guid] = s;
+        double c = sim.simulate(cand);
+        if (c < best_cost) {
+          best = cand;
+          best_cost = c;
+        }
+        if (c < cur.cost * o.alpha) pq.push({c, counter++, std::move(cand)});
+      }
+    }
+  }
+  strategies = std::move(best);
+}
+
 // MCMC refinement (reference: mcmc_optimize model.cc:3286): random single-op
 // rewrites, Metropolis acceptance, annealed temperature.
 static void mcmc_refine(const Graph& g, const Simulator& sim, int dp, int tp,
@@ -156,6 +216,10 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
       auto part = optimize_segment(g, sim, seg, dp, tp, o);
       strategies.insert(part.begin(), part.end());
     }
+    // cross-segment refinement: single-op flips against the FULL-graph
+    // simulate, seeing reshard costs across segment boundaries (mirrors
+    // GraphSearchHelper._refine_global)
+    refine_global(g, sim, dp, tp, o, segs, strategies);
     double cost = sim.simulate(strategies);
     if (o.mcmc_iters > 0) mcmc_refine(g, sim, dp, tp, o, strategies, cost);
     double mem = sim.memory(strategies);

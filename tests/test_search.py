@@ -330,7 +330,7 @@ def test_op_cost_cache_failure_is_recorded_and_fallback_counted():
     op = _linear_op(model)
 
     class BrokenCache(OpCostCache):
-        def _measure(self, op, dp, tp=1):
+        def _measure(self, op, dp, tp=1, **kw):
             raise RuntimeError("no device")
 
     cache = BrokenCache(model.config)
@@ -380,7 +380,7 @@ def test_measured_costs_change_search_outcome():
     graph = Graph(model.ops)
 
     class FakeMeasured(OpCostCache):
-        def _measure(self, op, dp, tp=1):
+        def _measure(self, op, dp, tp=1, **kw):
             return 5000.0 / dp, 10000.0 / dp  # much slower than analytic
 
     analytic = Simulator(machine, model.config)
