@@ -125,6 +125,23 @@ class MultiHeadAttentionOp(Op):
         rate = p.get("dropout", 0.0)
         dropout_active = rate > 0.0 and ctx.mode == CompMode.COMP_MODE_TRAINING
 
+        # KV-cache paths for autoregressive serving (serving/generate.py;
+        # reference role: the incremental-decoding half of the Triton
+        # prototype). fill_kv_cache: a full (prefill) pass also writes its
+        # K/V into the session cache. decode_pos: q is one new token; attend
+        # against the cache up to the traced position.
+        kc = ctx.state.get((self.name, "k_cache")) if hasattr(ctx, "state") else None
+        if kc is not None and getattr(ctx, "decode_pos", None) is not None:
+            return [self._decode_step(ctx, q, k, v, weights, scale)]
+        if kc is not None and getattr(ctx, "fill_kv_cache", False):
+            vc = ctx.state[(self.name, "v_cache")]
+            ctx.state_updates[(self.name, "k_cache")] = (
+                jax.lax.dynamic_update_slice(
+                    kc, k.astype(kc.dtype), (0, 0, 0, 0)))
+            ctx.state_updates[(self.name, "v_cache")] = (
+                jax.lax.dynamic_update_slice(
+                    vc, v.astype(vc.dtype), (0, 0, 0, 0)))
+
         if seq_parallel_active:
             # sequence/context parallelism: ring attention over the 'seq'
             # mesh axis (kernels/ring_attention.py) — K/V blocks rotate on
@@ -171,6 +188,35 @@ class MultiHeadAttentionOp(Op):
         if out.shape[1] < full_q_len:  # truncated: pad back to declared shape
             out = jnp.pad(out, [(0, 0), (0, full_q_len - out.shape[1]), (0, 0)])
         return [out]
+
+    def _decode_step(self, ctx, q, k, v, weights, scale):
+        """One incremental-decoding step: q/k/v are projections of the single
+        new token (B, 1, h, d); the K/V caches (B, M, h, d) are updated at
+        decode_pos and attended with a <= pos mask."""
+        pos = ctx.decode_pos
+        kc = ctx.state[(self.name, "k_cache")]
+        vc = ctx.state[(self.name, "v_cache")]
+        kc = jax.lax.dynamic_update_slice(
+            kc, k.astype(kc.dtype), (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype), (0, pos, 0, 0))
+        ctx.state_updates[(self.name, "k_cache")] = kc
+        ctx.state_updates[(self.name, "v_cache")] = vc
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kc.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (B, h, 1, M)
+        mask = jnp.arange(kc.shape[1]) <= pos
+        logits = jnp.where(mask[None, None, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
+                          vc.astype(q.dtype))
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(q.dtype),
+                         weights["wo"].astype(q.dtype))
+        out = out.astype(self.outputs[0].dtype.jnp_dtype)
+        if "bo" in weights:
+            out = out + weights["bo"]
+        return out
 
     def _use_flash(self, ctx) -> bool:
         """Auto policy, measured on v5e: XLA's fused einsum attention is

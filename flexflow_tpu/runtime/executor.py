@@ -74,11 +74,18 @@ class Executor:
         rng,
         mode: CompMode,
         seq_length: Optional[int] = None,
-    ) -> Tuple[Dict[int, Any], Dict]:
-        """Returns (tensor guid -> value, new state). seq_length: iteration
-        truncation (FFIterationConfig) — static per distinct length."""
+        decode_pos=None,
+        fill_kv_cache: bool = False,
+    ) -> Tuple[Dict[int, Any], Dict, Any]:
+        """Returns (tensor guid -> value, new state, aux loss sum).
+        seq_length: iteration
+        truncation (FFIterationConfig) — static per distinct length.
+        decode_pos / fill_kv_cache: KV-cache serving paths (a traced scalar
+        position for incremental decoding / prefill cache capture)."""
         ctx = LoweringContext(self.config, mode, self.mesh, rng,
                               iter_seq_length=seq_length)
+        ctx.decode_pos = decode_pos
+        ctx.fill_kv_cache = fill_kv_cache
         # flatten state into ctx keyed by (op_name, var)
         for op_name, vars_ in state.items():
             for var, val in vars_.items():

@@ -1,0 +1,109 @@
+"""Autoregressive generation with KV caches (reference role: the
+incremental-decoding side of the Triton inference prototype,
+triton/src/model.cc — here TPU-native: one jitted prefill over the prompt
+window + one jitted decode step reused for every position, caches carried in
+the executor's functional state)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ffconst import CompMode, OpType
+
+
+class GenerativeSession:
+    """Incremental decoding session over a compiled causal-transformer
+    FFModel whose final tensor is a distribution over the vocabulary.
+
+    max_len: cache capacity (max prompt + generated tokens). The model's
+    declared input seq length is the PREFILL window; prompts are padded to
+    it (cache positions past the prompt are overwritten as decoding
+    proceeds)."""
+
+    def __init__(self, model, max_len: int):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.max_len = int(max_len)
+        window = model.input_ops[0].outputs[0].dims[1]
+        if self.max_len < window:
+            raise ValueError(
+                f"max_len={self.max_len} smaller than the model's prefill "
+                f"window ({window}); the cache must hold at least one "
+                "full prefill")
+        self.attn_ops = [op for op in model.graph.ops.values()
+                         if op.op_type == OpType.MULTIHEAD_ATTENTION]
+        if not self.attn_ops:
+            raise ValueError("generation needs multihead_attention ops")
+        from ..ops.common import matmul_dtype
+
+        b = model.config.batch_size
+        self._caches: Dict[str, Dict[str, object]] = {}
+        for op in self.attn_ops:
+            heads = op.params["num_heads"]
+            kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
+            vdim = op.params.get("vdim") or op.params["embed_dim"] // heads
+            # cache in the attention compute dtype (bf16 under mixed
+            # precision): the KV cache is the dominant serving memory
+            cdt = matmul_dtype(model.config,
+                               op.inputs[0].dtype.jnp_dtype)
+            self._caches[op.name] = {
+                "k_cache": jnp.zeros((b, self.max_len, heads, kdim), cdt),
+                "v_cache": jnp.zeros((b, self.max_len, heads, vdim), cdt),
+            }
+
+        executor = model.executor
+        final_guid = model.final_tensor.guid
+        input_name = model.input_ops[0].name
+
+        def prefill(params, state, tokens):
+            values, new_state, _ = executor.forward_values(
+                params, state, {input_name: tokens}, None,
+                CompMode.COMP_MODE_INFERENCE, fill_kv_cache=True)
+            return values[final_guid], new_state
+
+        def decode(params, state, token, pos):
+            values, new_state, _ = executor.forward_values(
+                params, state, {input_name: token}, None,
+                CompMode.COMP_MODE_INFERENCE, decode_pos=pos)
+            return values[final_guid], new_state
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> np.ndarray:
+        """Greedy decoding. prompt_ids: (batch, prompt_len) int tokens.
+        Returns (batch, generated) token ids."""
+        import jax.numpy as jnp
+
+        model = self.model
+        b = model.config.batch_size
+        window = model.input_ops[0].outputs[0].dims[1]
+        prompt_len = prompt_ids.shape[1]
+        assert prompt_ids.shape[0] == b, (prompt_ids.shape, b)
+        assert prompt_len <= window, "prompt longer than the prefill window"
+        assert prompt_len + max_new_tokens <= self.max_len, "cache too small"
+
+        padded = np.zeros((b, window), dtype=np.int32)
+        padded[:, :prompt_len] = prompt_ids
+        state = {**model.state, **self._caches}
+        probs, state = self._prefill(model.params, state, jnp.asarray(padded))
+        # next token from the last REAL prompt position
+        tok = jnp.argmax(probs[:, prompt_len - 1, :], axis=-1).astype(jnp.int32)
+
+        out = []
+        finished = np.zeros(b, dtype=bool)
+        for step in range(max_new_tokens):
+            out.append(np.asarray(tok))
+            if eos_id is not None:
+                finished |= out[-1] == eos_id
+                if finished.all():
+                    break
+            pos = jnp.asarray(prompt_len + step, jnp.int32)
+            probs, state = self._decode(
+                model.params, state, tok[:, None], pos)
+            tok = jnp.argmax(probs[:, 0, :], axis=-1).astype(jnp.int32)
+        return np.stack(out, axis=1)

@@ -55,13 +55,51 @@ struct Candidate {
   }
 };
 
+// best-first refinement over single-op strategy flips with alpha pruning
+// and the iteration budget (reference: base_optimize substitution.cc:2229;
+// mirrors unity.py GraphSearchHelper._best_first_flips) — shared by the
+// per-segment DP and the cross-segment pass
+template <typename CostFn>
+static void best_first_flips(const Graph& g,
+                             const std::vector<int64_t>& cand_guids, int dp,
+                             int tp, const Options& o, CostFn cost_fn,
+                             std::map<int64_t, Strategy>& best,
+                             double& best_cost) {
+  std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
+  uint64_t counter = 0;
+  pq.push({best_cost, counter++, best});
+  int pops = 0;
+  while (!pq.empty() && pops < o.budget) {
+    Candidate cur = pq.top();
+    pq.pop();
+    pops++;
+    if (cur.cost > best_cost * o.alpha) continue;
+    for (int64_t guid : cand_guids) {
+      const NodeDesc& n = g.nodes[g.index.at(guid)];
+      for (const auto& s : menu(n, dp, tp, o)) {
+        if (s == cur.strategies[n.guid]) continue;
+        auto cand = cur.strategies;
+        cand[n.guid] = s;
+        double c = cost_fn(cand);
+        if (c < best_cost) {
+          best = cand;
+          best_cost = c;
+        }
+        if (c < cur.cost * o.alpha) pq.push({c, counter++, std::move(cand)});
+      }
+    }
+  }
+}
+
 static std::map<int64_t, Strategy> optimize_segment(
     const Graph& g, const Simulator& sim, const std::vector<int>& seg,
     int dp, int tp, const Options& o) {
   std::map<int64_t, Strategy> best;
+  std::vector<int64_t> guids;
   // greedy seed: per-op best in isolation (menu order breaks ties)
   for (int i : seg) {
     const NodeDesc& n = g.nodes[i];
+    guids.push_back(n.guid);
     auto m = menu(n, dp, tp, o);
     Strategy pick = m[0];
     double pc = sim.cost().op_step_us(n, pick);
@@ -75,31 +113,11 @@ static std::map<int64_t, Strategy> optimize_segment(
     best[n.guid] = pick;
   }
   double best_cost = sim.simulate(best, &seg);
-  // best-first refinement over single-op strategy flips
-  std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
-  uint64_t counter = 0;
-  pq.push({best_cost, counter++, best});
-  int pops = 0;
-  while (!pq.empty() && pops < o.budget) {
-    Candidate cur = pq.top();
-    pq.pop();
-    pops++;
-    if (cur.cost > best_cost * o.alpha) continue;
-    for (int i : seg) {
-      const NodeDesc& n = g.nodes[i];
-      for (const auto& s : menu(n, dp, tp, o)) {
-        if (s == cur.strategies[n.guid]) continue;
-        auto cand = cur.strategies;
-        cand[n.guid] = s;
-        double c = sim.simulate(cand, &seg);
-        if (c < best_cost) {
-          best = cand;
-          best_cost = c;
-        }
-        if (c < cur.cost * o.alpha) pq.push({c, counter++, std::move(cand)});
-      }
-    }
-  }
+  best_first_flips(g, guids, dp, tp, o,
+                   [&](const std::map<int64_t, Strategy>& st) {
+                     return sim.simulate(st, &seg);
+                   },
+                   best, best_cost);
   return best;
 }
 
@@ -136,30 +154,11 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
   if (cand_order.empty()) return;
   auto best = strategies;
   double best_cost = sim.simulate(best);
-  std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
-  uint64_t counter = 0;
-  pq.push({best_cost, counter++, best});
-  int pops = 0;
-  while (!pq.empty() && pops < o.budget) {
-    Candidate cur = pq.top();
-    pq.pop();
-    pops++;
-    if (cur.cost > best_cost * o.alpha) continue;
-    for (int64_t guid : cand_order) {
-      const NodeDesc& n = g.nodes[g.index.at(guid)];
-      for (const auto& s : menu(n, dp, tp, o)) {
-        if (s == cur.strategies[n.guid]) continue;
-        auto cand = cur.strategies;
-        cand[n.guid] = s;
-        double c = sim.simulate(cand);
-        if (c < best_cost) {
-          best = cand;
-          best_cost = c;
-        }
-        if (c < cur.cost * o.alpha) pq.push({c, counter++, std::move(cand)});
-      }
-    }
-  }
+  best_first_flips(g, cand_order, dp, tp, o,
+                   [&](const std::map<int64_t, Strategy>& st) {
+                     return sim.simulate(st);
+                   },
+                   best, best_cost);
   strategies = std::move(best);
 }
 
