@@ -1,0 +1,121 @@
+"""Pipeline-parallel transformer (GPipe over a 'stage' mesh axis).
+
+New capability vs the reference, whose OP_PIPELINE is an unused enum
+(ffconst.h:159): homogeneous encoder stages — each a block of identical
+transformer layers — hold their slice of a stacked parameter tree; the
+kernels/pipeline.py GPipe loop streams microbatches between stages on
+neighbor ICI links. Combine with a 'data' mesh axis for dp x pp.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _layer(params: Dict, x):
+    """One post-LN encoder layer on (B, L, D): self-attention + FFN."""
+    d = x.shape[-1]
+
+    def ln(x, g, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    q = jnp.einsum("ble,ehd->blhd", x, params["wq"])
+    k = jnp.einsum("ble,ehd->blhd", x, params["wk"])
+    v = jnp.einsum("ble,ehd->blhd", x, params["wv"])
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(x.shape[0],
+                                                          x.shape[1], d)
+    x = ln(x + jnp.einsum("bqe,ef->bqf", ctx, params["wo"]),
+           params["g1"], params["b1"])
+    hdn = jax.nn.gelu(jnp.einsum("ble,ef->blf", x, params["w1"]))
+    x = ln(x + jnp.einsum("blf,fe->ble", hdn, params["w2"]),
+           params["g2"], params["b2"])
+    return x
+
+
+def _stage_fn(stage_params: Dict, x):
+    """Apply this stage's layers (leading dim = layers-per-stage) via scan."""
+
+    def body(x, layer_params):
+        return _layer(layer_params, x), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def init_pipeline_params(key, n_layers: int, hidden: int, heads: int,
+                         ffn_mult: int = 4, stages: int = 1,
+                         dtype=jnp.float32) -> Dict:
+    """Parameters stacked (stages, layers_per_stage, ...) — shard the
+    leading dim over the 'stage' mesh axis."""
+    assert n_layers % stages == 0, (n_layers, stages)
+    hd = hidden // heads
+    shapes = {
+        "wq": (hidden, heads, hd), "wk": (hidden, heads, hd),
+        "wv": (hidden, heads, hd), "wo": (hidden, hidden),
+        "w1": (hidden, ffn_mult * hidden), "w2": (ffn_mult * hidden, hidden),
+        "g1": (hidden,), "b1": (hidden,), "g2": (hidden,), "b2": (hidden,),
+    }
+    params = {}
+    for name, shp in shapes.items():
+        key, sub = jax.random.split(key)
+        full = (stages, n_layers // stages) + shp
+        if name.startswith(("g",)):
+            params[name] = jnp.ones(full, dtype)
+        elif name.startswith("b"):
+            params[name] = jnp.zeros(full, dtype)
+        else:
+            fan_in = shp[0]
+            params[name] = (jax.random.normal(sub, full, dtype)
+                            / np.sqrt(fan_in))
+    return params
+
+
+def pipeline_forward(params: Dict, x, mesh, microbatches: int = 4,
+                     axis_name: str = "stage"):
+    """GPipe forward over the mesh's stage axis. x: (B, L, hidden)."""
+    from ..kernels.pipeline import gpipe_apply
+
+    return gpipe_apply(_stage_fn, params, x, mesh, axis_name=axis_name,
+                       microbatches=microbatches)
+
+
+def sequential_forward(params: Dict, x):
+    """Reference: same stacked params applied stage-by-stage on one device."""
+    stages = jax.tree_util.tree_leaves(params)[0].shape[0]
+    for s in range(stages):
+        x = _stage_fn(jax.tree_util.tree_map(lambda p: p[s], params), x)
+    return x
+
+
+def make_train_step(mesh, microbatches: int = 4, lr: float = 1e-3):
+    """Jitted SGD train step over embedding + pipelined encoder + LM head:
+    step(params, emb, head, tokens, labels) -> (params, emb, head, loss)."""
+
+    def train_step(params, emb, head, tokens, labels):
+        def loss_fn(params, emb, head):
+            x = emb[tokens]
+            x = pipeline_forward(params, x, mesh, microbatches)
+            logits = jnp.einsum("ble,ev->blv", x, head)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[..., None],
+                                       axis=-1).mean()
+            return nll
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            params, emb, head)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
+                                        grads[0])
+        emb = emb - lr * grads[1]
+        head = head - lr * grads[2]
+        return params, emb, head, loss
+
+    return jax.jit(train_step)
