@@ -1,0 +1,52 @@
+/* C-embedded model building (reference: flexflow_c.h users, e.g. the
+ * Legion-side C bindings): build an MLP, run the native Unity search, and
+ * export the spec the Python runtime trains.
+ *
+ * Build:  gcc mlp.c -o mlp -L../../src/ffcore -lffcore \
+ *             -Wl,-rpath,'$ORIGIN/../../src/ffcore'
+ * Train:  python -c "from flexflow_tpu.native.c_model import model_from_spec;
+ *                    m = model_from_spec('mlp.json'); ..."
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* ffc_model_create(int batch_size);
+extern void ffc_model_destroy(void* h);
+extern const char* ffc_model_last_error(void* h);
+extern int64_t ffc_tensor_create(void* h, int ndims, const int64_t* dims,
+                                 const char* dtype);
+extern int64_t ffc_op(void* h, const char* type, int n_inputs,
+                      const int64_t* inputs, const char* params);
+extern char* ffc_model_export_json(void* h);
+extern char* ffc_model_optimize(void* h, int n_devices, int budget,
+                                double alpha);
+extern void ffc_free(char* p);
+
+int main(void) {
+  void* m = ffc_model_create(64);
+  int64_t dims[2] = {64, 784};
+  int64_t x = ffc_tensor_create(m, 2, dims, "float32");
+  int64_t t = ffc_op(m, "dense", 1, &x, "out_dim=512;activation=relu");
+  t = ffc_op(m, "dense", 1, &t, "out_dim=512;activation=relu");
+  t = ffc_op(m, "dense", 1, &t, "out_dim=10");
+  t = ffc_op(m, "softmax", 1, &t, "");
+  if (t < 0) {
+    fprintf(stderr, "build failed: %s\n", ffc_model_last_error(m));
+    return 1;
+  }
+
+  char* result = ffc_model_optimize(m, 8, 8, 1.2);
+  printf("native search over 8 chips:\n%s", result);
+  ffc_free(result);
+
+  char* spec = ffc_model_export_json(m);
+  FILE* f = fopen("mlp.json", "w");
+  fputs(spec, f);
+  fclose(f);
+  ffc_free(spec);
+  printf("wrote mlp.json (train with flexflow_tpu.native.c_model)\n");
+
+  ffc_model_destroy(m);
+  return 0;
+}

@@ -4,4 +4,14 @@ there; here ring attention (sequence/context parallelism over ICI) is a new
 capability required by BASELINE.md's north star."""
 from .ring_attention import ring_attention, ring_attention_sharded
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "ring_attention_sharded", "get_shard_map"]
+
+
+def get_shard_map():
+    """jax>=0.8 moved shard_map out of experimental — one shim for all
+    kernels."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    return shard_map

@@ -65,10 +65,9 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, mesh,
     """
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from . import get_shard_map
+
+    shard_map = get_shard_map()
 
     b = x.shape[0]
     assert b % microbatches == 0, (b, microbatches)

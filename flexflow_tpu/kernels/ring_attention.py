@@ -118,10 +118,9 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
     partitions L over `axis_name` and runs the ring. Call inside jit."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from . import get_shard_map
+
+    shard_map = get_shard_map()
 
     # keep the batch dim sharded over 'data' when that axis exists, so DP x SP
     # composes without an all-gather + redundant compute at the region edge
