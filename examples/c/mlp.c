@@ -42,6 +42,12 @@ int main(void) {
 
   char* spec = ffc_model_export_json(m);
   FILE* f = fopen("mlp.json", "w");
+  if (!f) {
+    fprintf(stderr, "cannot write mlp.json\n");
+    ffc_free(spec);
+    ffc_model_destroy(m);
+    return 1;
+  }
   fputs(spec, f);
   fclose(f);
   ffc_free(spec);

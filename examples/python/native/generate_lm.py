@@ -1,0 +1,44 @@
+"""KV-cache autoregressive generation on a causal transformer LM
+(serving/generate.py — the incremental-decoding role of the reference's
+Triton prototype)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import flexflow_tpu as ff
+from flexflow_tpu.serving.generate import GenerativeSession
+
+from _util import get_config
+
+
+def main():
+    config = get_config(batch_size=2, epochs=1)
+    vocab, hidden, heads, window = 100, 64, 4, 24
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([config.batch_size, window],
+                                 ff.DataType.DT_INT32)
+    t = model.embedding(tokens, vocab, hidden, ff.AggrMode.AGGR_MODE_NONE,
+                        name="emb")
+    for i in range(2):
+        attn = model.multihead_attention(t, t, t, hidden, heads, causal=True,
+                                         name=f"l{i}_attn")
+        t = model.layer_norm(model.add(t, attn), [-1], name=f"l{i}_ln1")
+        h = model.dense(t, hidden * 2, ff.ActiMode.AC_MODE_GELU,
+                        name=f"l{i}_ff1")
+        t = model.layer_norm(model.add(t, model.dense(h, hidden,
+                                                      name=f"l{i}_ff2")),
+                             [-1], name=f"l{i}_ln2")
+    model.softmax(model.dense(t, vocab, name="lm_head"))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    prompt = np.random.RandomState(0).randint(
+        1, vocab, size=(config.batch_size, 6)).astype(np.int32)
+    session = GenerativeSession(model, max_len=window)
+    out = session.generate(prompt, max_new_tokens=10)
+    print("prompt:", prompt.tolist())
+    print("generated:", out.tolist())
+
+
+if __name__ == "__main__":
+    main()

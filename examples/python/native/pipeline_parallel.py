@@ -1,0 +1,38 @@
+"""GPipe pipeline parallelism over a 'stage' mesh axis (new capability —
+the reference's OP_PIPELINE is an unused enum; kernels/pipeline.py)."""
+import numpy as np
+
+import _bootstrap  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from flexflow_tpu.models.pipeline_transformer import (
+    init_pipeline_params,
+    make_train_step,
+)
+
+
+def main():
+    stages = min(4, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:stages]), ("stage",))
+    vocab, hidden, heads, layers = 64, 32, 4, stages * 2
+    params = init_pipeline_params(jax.random.PRNGKey(0), layers, hidden,
+                                  heads, stages=stages)
+    emb = jax.random.normal(jax.random.PRNGKey(1), (vocab, hidden)) * 0.02
+    head = jax.random.normal(jax.random.PRNGKey(2), (hidden, vocab)) * 0.02
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (8, 12)))
+    labels = jnp.asarray(rng.randint(0, vocab, (8, 12)))
+
+    step = make_train_step(mesh, microbatches=4, lr=0.1)
+    for it in range(10):
+        params, emb, head, loss = step(params, emb, head, tokens, labels)
+        if it % 2 == 0:
+            print(f"iter {it}: loss {float(loss):.4f} "
+                  f"({stages} pipeline stages)")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,19 +45,28 @@ def model_from_spec(spec, config=None):
         def geti(key, dflt=0):
             return int(p.get(key, dflt))
 
+        act_key = p.get("activation", "")
+        if act_key not in _ACT:
+            raise ValueError(
+                f"op {name} ({t}): unsupported activation {act_key!r}")
+
         if t == "input":
-            out = model.create_tensor(
-                op["dims"], DataType(op.get("dtype", "float32")), name=name)
+            try:
+                dtype = DataType(op.get("dtype", "float32"))
+            except ValueError as e:
+                raise ValueError(
+                    f"op {name}: unsupported dtype {op.get('dtype')!r}"
+                ) from e
+            out = model.create_tensor(op["dims"], dtype, name=name)
         elif t == "dense":
-            out = model.dense(ins[0], geti("out_dim"),
-                              _ACT[p.get("activation", "")],
+            out = model.dense(ins[0], geti("out_dim"), _ACT[act_key],
                               bool(geti("use_bias", 1)), name=name)
         elif t == "conv2d":
             out = model.conv2d(ins[0], geti("out_channels"),
                                geti("kernel_h"), geti("kernel_w"),
                                geti("stride_h"), geti("stride_w"),
                                geti("padding_h"), geti("padding_w"),
-                               activation=_ACT[p.get("activation", "")],
+                               activation=_ACT[act_key],
                                groups=geti("groups", 1),
                                use_bias=bool(geti("use_bias", 1)), name=name)
         elif t == "pool2d":

@@ -124,6 +124,15 @@ static OpInfo infer(CModel& m, const COp& op) {
                                " < required " + std::to_string(r));
   };
   need_rank(1);
+  {
+    auto act = op.params.find("activation");
+    if (act != op.params.end() && act->second != "" &&
+        act->second != "none" && act->second != "relu" &&
+        act->second != "sigmoid" && act->second != "tanh" &&
+        act->second != "gelu")
+      throw std::runtime_error("op " + op.type +
+                               ": unsupported activation " + act->second);
+  }
   OpInfo r;
   if (op.type == "dense") {
     int64_t out = need("out_dim");
@@ -144,6 +153,10 @@ static OpInfo infer(CModel& m, const COp& op) {
     int64_t b = in0.dims[0], ic = in0.dims[1], h = in0.dims[2],
             w = in0.dims[3];
     int64_t oh = (h + 2 * ph - kh) / sh + 1, ow = (w + 2 * pw - kw) / sw + 1;
+    if (oh <= 0 || ow <= 0)
+      throw std::runtime_error("conv2d: kernel exceeds padded input (" +
+                               std::to_string(oh) + "x" +
+                               std::to_string(ow) + " output)");
     r.out_dims = {b, oc, oh, ow};
     r.flops = 2.0 * b * oc * oh * ow * (ic / groups) * kh * kw;
     r.weight_bytes = 4.0 * (oc * (ic / groups) * kh * kw + oc);
@@ -154,8 +167,10 @@ static OpInfo infer(CModel& m, const COp& op) {
             ph = geti("padding_h"), pw = geti("padding_w");
     int64_t b = in0.dims[0], c = in0.dims[1], h = in0.dims[2],
             w = in0.dims[3];
-    r.out_dims = {b, c, (h + 2 * ph - kh) / sh + 1,
-                  (w + 2 * pw - kw) / sw + 1};
+    int64_t oh = (h + 2 * ph - kh) / sh + 1, ow = (w + 2 * pw - kw) / sw + 1;
+    if (oh <= 0 || ow <= 0)
+      throw std::runtime_error("pool2d: kernel exceeds padded input");
+    r.out_dims = {b, c, oh, ow};
   } else if (op.type == "flat") {
     r.out_dims = {in0.dims[0], numel(in0.dims) / in0.dims[0]};
   } else if (op.type == "embedding") {
@@ -180,13 +195,23 @@ static OpInfo infer(CModel& m, const COp& op) {
     int64_t axis = geti("axis");
     r.out_dims = in0.dims;
     if (axis < 0) axis += (int64_t)r.out_dims.size();
+    if (axis < 0 || axis >= (int64_t)r.out_dims.size())
+      throw std::runtime_error("concat: axis out of range for rank " +
+                               std::to_string(r.out_dims.size()));
     int64_t total = 0;
-    for (int64_t g : op.inputs) total += m.tensor(g).dims[axis];
+    for (int64_t g : op.inputs) {
+      const auto& t = m.tensor(g);
+      if ((int64_t)t.dims.size() <= axis)
+        throw std::runtime_error("concat: input rank too small for axis");
+      total += t.dims[axis];
+    }
     r.out_dims[axis] = total;
   } else if (op.type == "batch_matmul") {
     need_inputs(2);
     need_rank(2);
     const auto& in1 = m.tensor(op.inputs[1]);
+    if (in1.dims.size() < 2)
+      throw std::runtime_error("batch_matmul: second input rank < 2");
     r.out_dims = in0.dims;
     r.out_dims.back() = in1.dims.back();
     int64_t batch = numel(in0.dims) / (in0.dims[in0.dims.size() - 2] *
@@ -340,9 +365,20 @@ int64_t ffc_tensor_create(void* h, int ndims, const int64_t* dims,
                           const char* dtype) {
   auto* m = (CModel*)h;
   try {
+    if (ndims < 1 || dims == nullptr)
+      throw std::runtime_error("tensor needs ndims >= 1 and a dims array");
+    for (int i = 0; i < ndims; ++i)
+      if (dims[i] <= 0)
+        throw std::runtime_error("tensor dim " + std::to_string(i) +
+                                 " must be > 0, got " +
+                                 std::to_string(dims[i]));
+    std::string dt = dtype ? dtype : "float32";
+    if (dt != "float32" && dt != "int32" && dt != "int64" &&
+        dt != "bfloat16" && dt != "bool")
+      throw std::runtime_error("unsupported dtype: " + dt);
     COp& op = m->add_op("input", {}, {});
-    int64_t t = m->add_tensor(std::vector<int64_t>(dims, dims + ndims),
-                              dtype ? dtype : "float32", op.guid);
+    int64_t t = m->add_tensor(std::vector<int64_t>(dims, dims + ndims), dt,
+                              op.guid);
     op.outputs.push_back(t);
     return t;
   } catch (const std::exception& e) {
