@@ -82,6 +82,13 @@ def main(argv=None):
         i = argv.index("--model")
         model_name = argv[i + 1]
         del argv[i:i + 2]
+    c_spec = None
+    if "--from-c-spec" in argv:  # train a model exported by the C API
+        i = argv.index("--from-c-spec")
+        if i + 1 >= len(argv):
+            raise SystemExit("missing value for --from-c-spec")
+        c_spec = argv[i + 1]
+        del argv[i:i + 2]
 
     import flexflow_tpu as ff
 
@@ -90,7 +97,31 @@ def main(argv=None):
     if rest:
         print(f"warning: unrecognized flags {rest}", file=sys.stderr)
 
-    model, xs, y = _synthetic(model_name, config)
+    if c_spec is not None:
+        from .ffconst import OpType
+        from .native.c_model import model_from_spec
+
+        # explicit CLI batch size wins over the spec's
+        cli_batch = (config.batch_size
+                     if "-b" in argv or "--batch-size" in argv else None)
+        model = model_from_spec(c_spec, config=config, batch_size=cli_batch)
+        model_name = c_spec
+        rng = np.random.RandomState(0)
+        b = model.config.batch_size
+        # valid synthetic id range: the smallest embedding vocabulary
+        vocab = min((op.params["num_entries"] for op in model.ops
+                     if op.op_type == OpType.EMBEDDING), default=100)
+        xs = []
+        for op in model.input_ops:
+            dims = (b * 4,) + op.outputs[0].dims[1:]
+            if op.outputs[0].dtype.value.startswith("int"):
+                xs.append(rng.randint(0, vocab, size=dims).astype(np.int32))
+            else:
+                xs.append(rng.randn(*dims).astype(np.float32))
+        out_dim = model.ops[-1].outputs[0].dims[-1]
+        y = rng.randint(0, out_dim, size=(b * 4, 1)).astype(np.int32)
+    else:
+        model, xs, y = _synthetic(model_name, config)
     model.compile(
         optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
         loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,

@@ -17,10 +17,11 @@ _ACT = {
 }
 
 
-def model_from_spec(spec, config=None):
+def model_from_spec(spec, config=None, batch_size=None):
     """spec: dict, JSON string, or path to a .json file. Returns a built
     (not yet compiled) FFModel; tensors keyed by the C-side guids are in
-    model._c_tensors."""
+    model._c_tensors. batch_size overrides the spec's (input tensors'
+    leading dim is rewritten accordingly)."""
     import flexflow_tpu as ff
 
     if isinstance(spec, str):
@@ -32,7 +33,8 @@ def model_from_spec(spec, config=None):
     assert spec.get("format") == "flexflow_tpu_c_model", spec.get("format")
 
     cfg = config or ff.FFConfig()
-    cfg.batch_size = int(spec["config"].get("batch_size", cfg.batch_size))
+    spec_batch = int(spec["config"].get("batch_size", cfg.batch_size))
+    cfg.batch_size = int(batch_size) if batch_size else spec_batch
     model = ff.FFModel(cfg)
     env: Dict[int, object] = {}
 
@@ -57,7 +59,10 @@ def model_from_spec(spec, config=None):
                 raise ValueError(
                     f"op {name}: unsupported dtype {op.get('dtype')!r}"
                 ) from e
-            out = model.create_tensor(op["dims"], dtype, name=name)
+            dims = list(op["dims"])
+            if dims and dims[0] == spec_batch:
+                dims[0] = cfg.batch_size  # batch override rewrites dim 0
+            out = model.create_tensor(dims, dtype, name=name)
         elif t == "dense":
             out = model.dense(ins[0], geti("out_dim"), _ACT[act_key],
                               bool(geti("use_bias", 1)), name=name)
