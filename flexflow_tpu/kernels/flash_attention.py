@@ -18,8 +18,10 @@ scores in VMEM with the standard online-softmax streaming:
 
 Nothing of size L x L ever touches HBM, and VMEM holds only
 O(block_q x block_k + block x d) — so sequence length is bounded by HBM
-(q/k/v themselves), not VMEM. All matmuls run on the MXU in f32
-(preferred_element_type), accumulators f32.
+(q/k/v themselves), not VMEM. MXU inputs stay in the stored dtype (bf16
+under mixed precision — f32 inputs would run the MXU at 1/4 rate); all
+accumulation and the softmax/normalization math are f32
+(preferred_element_type + f32 scratch).
 
 Layout is [batch, heads, len, head_dim] internally; the public wrapper takes
 the attention op's [batch, len, heads, head_dim] and transposes.
@@ -67,11 +69,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+    # MXU inputs stay in the stored dtype (bf16 under mixed precision —
+    # f32 inputs would run the MXU at 1/4 rate); accumulation is f32 via
+    # preferred_element_type, and the softmax/normalization math is f32.
+    q = q_ref[0, 0]                                       # (bq, d)
     k = k_ref[0, 0]                                       # (bk, d)
     v = v_ref[0, 0]
-    s = jnp.dot(q, k.astype(jnp.float32).T,
-                preferred_element_type=jnp.float32)       # (bq, bk)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # (bq, bk)
 
     q_pos = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -91,7 +95,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     m_ref[:] = m_new
     l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=1, keepdims=True)
     acc_ref[:] = acc_ref[:] * correction + jnp.dot(
-        p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_kb - 1)
     def _emit():
@@ -160,11 +164,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0]        # (bq, 1)
     delta = delta_ref[0, 0]    # (bq, 1)
-    kf = k_ref[0, 0].astype(jnp.float32)
+    kf = k_ref[0, 0]
     v = v_ref[0, 0]
 
     s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32) * scale
@@ -176,10 +180,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     if causal:
         mask = mask & (k_pos <= q_pos + q_offset)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-    dp = jnp.dot(do, v.astype(jnp.float32).T,
-                 preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
-    dq_acc[:] = dq_acc[:] + jnp.dot(ds, kf, preferred_element_type=jnp.float32)
+    dq_acc[:] = dq_acc[:] + jnp.dot(
+        ds.astype(kf.dtype), kf, preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_kb - 1)
     def _emit():
@@ -199,10 +203,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    k = k_ref[0, 0].astype(jnp.float32)                 # (bk, d)
-    v = v_ref[0, 0].astype(jnp.float32)
-    qf = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
-    dof = do_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]                                     # (bk, d)
+    v = v_ref[0, 0]
+    qf = q_ref[0, 0]                                    # (bq, d)
+    dof = do_ref[0, 0]
     lse = lse_ref[0, 0]        # (bq, 1)
     delta = delta_ref[0, 0]    # (bq, 1)
 
@@ -215,10 +219,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         mask = mask & (k_pos <= q_pos + q_offset)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)          # (bq, bk)
-    dv_acc[:] = dv_acc[:] + jnp.dot(p.T, dof, preferred_element_type=jnp.float32)
+    dv_acc[:] = dv_acc[:] + jnp.dot(
+        p.T.astype(dof.dtype), dof, preferred_element_type=jnp.float32)
     dp = jnp.dot(dof, v.T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
-    dk_acc[:] = dk_acc[:] + jnp.dot(ds.T, qf, preferred_element_type=jnp.float32)
+    dk_acc[:] = dk_acc[:] + jnp.dot(
+        ds.T.astype(qf.dtype), qf, preferred_element_type=jnp.float32)
 
     @pl.when(iq == n_qb - 1)
     def _emit():
@@ -233,8 +239,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
     block_q = min(block_q, max(lq, 1))
     block_k = min(block_k, max(kv_len, 1))
 
-    do = g.astype(jnp.float32)
-    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1,
+    do = g.astype(q.dtype)  # MXU input dtype; the kernels accumulate f32
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)                          # (b, h, lq, 1)
 
     qp = _pad_to(q, block_q, axis=2)

@@ -171,24 +171,29 @@ class BatchNormOp(Op):
         eps = self.params.get("eps", 1e-5)
         momentum = self.params.get("momentum", 0.1)
         axes = (0, 2, 3)
+        xf = x.astype(jnp.float32)  # f32 statistics under bf16 activations
         if ctx.mode == CompMode.COMP_MODE_TRAINING:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             rm = ctx.state.get((self.name, "running_mean"))
             rv = ctx.state.get((self.name, "running_var"))
             if rm is not None:
+                # keep the carried state in its declared dtype (the f32
+                # batch stats would otherwise promote non-f32 state and
+                # force a retrace of the donated train step)
                 ctx.state_updates[(self.name, "running_mean")] = (
                     (1 - momentum) * rm + momentum * mean
-                )
+                ).astype(rm.dtype)
                 ctx.state_updates[(self.name, "running_var")] = (
                     (1 - momentum) * rv + momentum * var
-                )
+                ).astype(rv.dtype)
         else:
             mean = ctx.state[(self.name, "running_mean")]
             var = ctx.state[(self.name, "running_var")]
         inv = jax.lax.rsqrt(var + eps)
-        y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
-        y = y * weights["gamma"][None, :, None, None] + weights["beta"][None, :, None, None]
+        y = (xf - mean[None, :, None, None]) * inv[None, :, None, None]
+        y = (y * weights["gamma"].astype(jnp.float32)[None, :, None, None]
+             + weights["beta"].astype(jnp.float32)[None, :, None, None])
         if self.params.get("relu", False):
             y = jax.nn.relu(y)
-        return [y]
+        return [y.astype(x.dtype)]

@@ -41,16 +41,20 @@ class LayerNormOp(Op):
         x = inputs[0]
         axes = tuple(self.params["axes"])
         eps = self.params.get("eps", 1e-5)
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.var(x, axis=axes, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        # statistics in f32 even when activations flow bf16; the result is
+        # stored back in the activation dtype
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
         if "gamma" in weights:
             # broadcast affine params over the normalized axes
             shape = [1] * x.ndim
             for a in axes:
                 shape[a] = x.shape[a]
-            y = y * weights["gamma"].reshape(shape) + weights["beta"].reshape(shape)
-        return [y]
+            y = (y * weights["gamma"].astype(jnp.float32).reshape(shape)
+                 + weights["beta"].astype(jnp.float32).reshape(shape))
+        return [y.astype(x.dtype)]
 
 
 @register_op
@@ -62,7 +66,9 @@ class SoftmaxOp(Op):
 
     def lower(self, ctx, inputs, weights):
         axis = self.params.get("axis", -1)
-        return [jax.nn.softmax(inputs[0], axis=axis)]
+        x = inputs[0]
+        # f32 exp/sum even for bf16 activations
+        return [jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)]
 
 
 @register_op

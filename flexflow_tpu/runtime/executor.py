@@ -21,6 +21,7 @@ import numpy as np
 from ..core.graph import Graph
 from ..core.op import LoweringContext, Op
 from ..ffconst import CompMode, OpType
+from ..ops.common import emit_dtype
 from .metrics import Metrics
 
 
@@ -107,6 +108,11 @@ class Executor:
             with jax.named_scope(f"{op.op_type.value}:{op.name}"):
                 outs = op.lower(ctx, ins, weights)
             for t, v in zip(op.outputs, outs):
+                # boundary storage dtype: under mixed precision f32
+                # activations are stored bf16 (XLA fuses the convert into
+                # the producing op, so no extra pass) — see ops/common.py
+                if hasattr(v, "astype"):
+                    v = v.astype(emit_dtype(self.config, t.dtype))
                 ctx.values[t.guid] = ctx.constrain(v, t)
         new_state = {
             op_name: {

@@ -242,12 +242,78 @@ ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "drop_identity": rule_drop_identity,
 }
 
+# no 'dtype': model.conv2d takes none (unlike dense), so it would never
+# discriminate and only inject a spurious params key into merged convs
+_CONV_MATCH_KEYS = ("kernel_h", "kernel_w", "stride_h", "stride_w",
+                    "padding_h", "padding_w", "groups", "activation",
+                    "use_bias")
+
+
+def rule_merge_parallel_convs(graph: Graph) -> List[Application]:
+    """Two CONV2D ops on the same input with identical window/stride/padding
+    ==> one conv with summed out_channels + channel split — the Inception
+    branch pattern (reference: the conv-merge rules in
+    substitutions/graph_subst_3_v2.json and create_combine_inception /
+    create_mapping_xfers<Conv2D>, substitution.cc:1771-1797). Activation may
+    be fused (elementwise: split∘act == act∘split).
+
+    Like merge_parallel_linears this is a *search action*: one wider conv
+    tiles the MXU better, but the merged out_channels constrains TP/attribute
+    strategies to divisors of the sum."""
+    apps = []
+    by_input: Dict[int, List[Op]] = {}
+    for op in graph.topo_order():
+        if op.op_type != OpType.CONV2D:
+            continue
+        if op.params.get("groups", 1) != 1:
+            continue
+        if op.params.get("kernel_initializer") or op.params.get("bias_initializer"):
+            continue  # user-pinned init: widths are load-bearing
+        by_input.setdefault(op.inputs[0].guid, []).append(op)
+    for ops in by_input.values():
+        for i in range(len(ops)):
+            for j in range(i + 1, len(ops)):
+                a, b = ops[i], ops[j]
+                if any(a.params.get(k) != b.params.get(k)
+                       for k in _CONV_MATCH_KEYS):
+                    continue
+
+                def apply(a=a, b=b):
+                    from ..core.op import OP_REGISTRY
+                    from ..ffconst import OpType as OT
+
+                    ca, cb = a.params["out_channels"], b.params["out_channels"]
+                    merged_params = {k: a.params.get(k) for k in _CONV_MATCH_KEYS}
+                    merged = OP_REGISTRY[OT.CONV2D](
+                        a.model, [a.inputs[0]], f"{a.name}+{b.name}",
+                        out_channels=ca + cb,
+                        kernel_initializer=None, bias_initializer=None,
+                        **merged_params,
+                    )
+                    split = OP_REGISTRY[OT.SPLIT](
+                        a.model, [merged.outputs[0]],
+                        f"{a.name}+{b.name}_split",
+                        sizes=[ca, cb], axis=1,  # NCHW channel axis
+                    )
+                    graph.add_op(merged)
+                    graph.add_op(split)
+                    _rewire(graph, a.outputs[0], split.outputs[0])
+                    _rewire(graph, b.outputs[0], split.outputs[1])
+                    graph.remove_op(a)
+                    graph.remove_op(b)
+
+                apps.append(Application("merge_parallel_convs", apply,
+                                        f"{a.name}+{b.name}"))
+    return apps
+
+
 # Trade-off rewrites: benefit depends on the parallelization chosen, so they
 # are *search actions* explored by unity._joint_optimize (reference:
 # candidate graphs in base_optimize, substitution.cc:2229-2311), never part
 # of the greedy fixed-point pass above.
 SEARCH_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "merge_parallel_linears": rule_merge_parallel_linears,
+    "merge_parallel_convs": rule_merge_parallel_convs,
 }
 
 

@@ -195,6 +195,8 @@ def xfer_templates_from_rules(rules: List[Rule]) -> List[str]:
     - merge_parallel_linears: rules fusing two OP_LINEARs through an
       OP_CONCAT (38 such rules in graph_subst_3_v2.json — the TASO
       matmul-fusion family).
+    - merge_parallel_convs: rules fusing two OP_CONV2Ds through an
+      OP_CONCAT (the Inception branch-merge family).
     """
     templates: List[str] = []
     for r in rules:
@@ -206,6 +208,10 @@ def xfer_templates_from_rules(rules: List[Rule]) -> List[str]:
                 and OpType.CONCAT in all_types
                 and "merge_parallel_linears" not in templates):
             templates.append("merge_parallel_linears")
+        if (src_types.count(OpType.CONV2D) >= 2
+                and OpType.CONCAT in all_types
+                and "merge_parallel_convs" not in templates):
+            templates.append("merge_parallel_convs")
     return templates
 
 

@@ -78,3 +78,55 @@ def test_taso_file_activates_merge_template():
     assert "merge_parallel_linears" in templates
     active = search_rules_from_spec(spec, True)
     assert "merge_parallel_linears" in active
+
+
+def _branch_convs(joint: bool):
+    """Inception-style branch: three same-window 1x1 convs on one input,
+    channel-concatenated — the merge_parallel_convs pattern."""
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.search_budget = 8
+    config.joint_search = joint
+    config.use_native_search = False
+    model = ff.FFModel(config)
+    inp = model.create_tensor([8, 16, 8, 8])
+    b1 = model.conv2d(inp, 8, 1, 1, 1, 1, 0, 0, name="br1")
+    b2 = model.conv2d(inp, 12, 1, 1, 1, 1, 0, 0, name="br2")
+    b3 = model.conv2d(inp, 4, 1, 1, 1, 1, 0, 0, name="br3")
+    cat = model.concat([b1, b2, b3], axis=1, name="cat")
+    t = model.flat(cat)
+    model.softmax(model.dense(t, 4, name="cls"))
+    return model, config
+
+
+def test_joint_search_explores_conv_merge():
+    model, config = _branch_convs(joint=True)
+    machine = make_machine_model(config, 8)
+    res = unity_optimize(Graph(model.ops), config, machine, 8, 8)
+    assert any("merge_parallel_convs" in l for l in res.log), res.log
+
+
+def test_conv_merge_trains_after_rewrite():
+    """The rewritten graph (merged conv + channel split) executes end to
+    end when the joint search picks it."""
+    from flexflow_tpu.search.substitution import (
+        apply_substitutions, rule_merge_parallel_convs)
+
+    model, config = _branch_convs(joint=True)
+    g = Graph(model.ops)
+    apps = rule_merge_parallel_convs(g)
+    assert len(apps) == 3, [a.description for a in apps]  # 3 pairs
+    apps[0].apply()
+    # merged conv + split present, shapes consistent
+    merged = [o for o in g.ops.values() if o.name == "br1+br2"]
+    assert merged and merged[0].params["out_channels"] == 20
+    model.ops = list(g.topo_order())  # compile rebuilds its graph from ops
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    x = np.random.RandomState(0).randn(8, 16, 8, 8).astype(np.float32)
+    y = np.zeros((8, 1), dtype=np.int32)
+    hist = model.fit([x], y, batch_size=8, epochs=1)
+    assert np.isfinite(hist[-1]["loss"])
