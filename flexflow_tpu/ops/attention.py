@@ -18,7 +18,7 @@ import numpy as np
 from ..core.op import Op, WeightSpec, register_op
 from ..ffconst import CompMode, DataType, OpType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
-from .common import matmul_dtype
+from .common import emit_dtype, matmul_dtype
 
 
 @register_op
@@ -178,13 +178,14 @@ class MultiHeadAttentionOp(Op):
             # way, and a bf16 output halves the HBM write
             ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cdt), v)
 
+        odt = emit_dtype(ctx.config, self.outputs[0].dtype)
         out = jnp.einsum(
             "bqhd,hde->bqe",
             ctxv.astype(cdt),
             weights["wo"].astype(cdt),
-        ).astype(self.outputs[0].dtype.jnp_dtype)
+        ).astype(odt)
         if "bo" in weights:
-            out = out + weights["bo"]
+            out = out + weights["bo"].astype(odt)
         if out.shape[1] < full_q_len:  # truncated: pad back to declared shape
             out = jnp.pad(out, [(0, 0), (0, full_q_len - out.shape[1]), (0, 0)])
         return [out]

@@ -19,7 +19,7 @@ import numpy as np
 from ..core.op import Op, WeightSpec, register_op
 from ..ffconst import ActiMode, DataType, OpType, PoolType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
-from .common import apply_activation, matmul_dtype
+from .common import apply_activation, emit_dtype, matmul_dtype
 
 
 def _out_size(size, pad, kernel, stride):
@@ -79,9 +79,9 @@ class Conv2DOp(Op):
             padding=[(p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=p.get("groups", 1),
-        ).astype(self.outputs[0].dtype.jnp_dtype)
+        ).astype(emit_dtype(ctx.config, self.outputs[0].dtype))
         if "bias" in weights:
-            y = y + weights["bias"][None, :, None, None]
+            y = y + weights["bias"].astype(y.dtype)[None, :, None, None]
         return [apply_activation(y, p.get("activation", ActiMode.AC_MODE_NONE))]
 
     def flops(self) -> float:

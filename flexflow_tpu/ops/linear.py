@@ -15,7 +15,7 @@ import numpy as np
 from ..core.op import Op, WeightSpec, register_op
 from ..ffconst import ActiMode, DataType, OpType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
-from .common import apply_activation, matmul_dtype
+from .common import apply_activation, emit_dtype, matmul_dtype
 
 
 @register_op
@@ -55,11 +55,16 @@ class LinearOp(Op):
         x = inputs[0]
         k = weights["kernel"]
         cdt = matmul_dtype(ctx.config, x.dtype)
+        # the bias+activation epilogue runs in the boundary storage dtype:
+        # under mixed precision the pre-activation residual autodiff saves
+        # for the activation's backward is then bf16, not f32 — at BERT
+        # scale that is ~64 MB of f32 per FFN layer otherwise
+        odt = emit_dtype(ctx.config, self.outputs[0].dtype)
         y = jnp.dot(
             x.astype(cdt), k.astype(cdt), preferred_element_type=jnp.float32
-        ).astype(self.outputs[0].dtype.jnp_dtype)
+        ).astype(odt)
         if "bias" in weights:
-            y = y + weights["bias"]
+            y = y + weights["bias"].astype(odt)
         y = apply_activation(y, self.params.get("activation", ActiMode.AC_MODE_NONE))
         return [y]
 

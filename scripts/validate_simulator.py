@@ -8,6 +8,11 @@ separate update tasks priced by grad-sync comm only — simulator.cc:815+), so
 the measured comparator here is the grad step (forward+backward), with the
 full train step reported alongside for context.
 
+The BERT model/config is IMPORTED from bench.py (same BENCH_* env knobs,
+same builder) so the simulator is validated against exactly the benched
+model. Sync is a scalar fetch, not block_until_ready — tunneled buffers
+return immediately from the latter (bench.py module docstring).
+
 Usage: python scripts/validate_simulator.py [--skip-inception]
 Prints one JSON line per model plus a summary.
 """
@@ -23,35 +28,18 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from bench import BATCH, SEQ, VOCAB, _build_model  # noqa: E402
+
 ITERS = int(os.environ.get("BENCH_ITERS", 10))
-BATCH = int(os.environ.get("BENCH_BATCH", 8))
-SEQ = int(os.environ.get("BENCH_SEQ", 512))
-HIDDEN = int(os.environ.get("BENCH_HIDDEN", 1024))
-LAYERS = int(os.environ.get("BENCH_LAYERS", 12))
-HEADS = int(os.environ.get("BENCH_HEADS", 16))
-VOCAB = int(os.environ.get("BENCH_VOCAB", 30522))
 
 
-def build_bert(batch=BATCH, seq=SEQ, hidden=HIDDEN, layers=LAYERS,
-               heads=HEADS, vocab=VOCAB):
-    import flexflow_tpu as ff
-    from flexflow_tpu.models import TransformerConfig, build_bert_encoder
-
-    config = ff.FFConfig()
-    config.num_devices = 1
-    config.batch_size = batch
-    model = ff.FFModel(config)
-    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
-    cfg = TransformerConfig(hidden_size=hidden, embedding_size=hidden,
-                            num_heads=heads, num_layers=layers,
-                            sequence_length=seq, vocab_size=vocab)
-    build_bert_encoder(model, tokens, cfg)
-    model.compile(optimizer=ff.AdamOptimizer(model, alpha=1e-4),
-                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
-                  metrics=[])
-    x = np.random.RandomState(0).randint(0, vocab, size=(batch, seq))
-    y = np.random.RandomState(1).randint(0, 2, size=(batch, seq, 1))
-    return model, x.astype(np.int32), y.astype(np.int32)
+def build_bert():
+    model = _build_model(use_flash=None)  # the auto attention policy
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+    y = np.random.RandomState(1).randint(
+        0, 2, size=(BATCH, SEQ, 1)).astype(np.int32)
+    return model, x, y
 
 
 def build_inception(batch=8, num_classes=10):
@@ -82,14 +70,19 @@ def measure_steps(model, x, y):
     label = jnp.asarray(y)
     key = model._next_rng()
 
+    def sync_grad(g):
+        # scalar fetch forces completion of the whole chain (tunnel-safe;
+        # block_until_ready returns immediately for tunneled buffers)
+        float(np.asarray(jax.tree_util.tree_leaves(g)[0].ravel()[0]))
+
     gstep = model._grad_step
     for _ in range(5):  # warmup: compile + stabilize (first windows run hot)
         g = gstep(model.params, model.state, inputs, label, key)
-    jax.tree_util.tree_map(lambda a: a.block_until_ready(), g)
+    sync_grad(g)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         g = gstep(model.params, model.state, inputs, label, key)
-    jax.tree_util.tree_map(lambda a: a.block_until_ready(), g)
+    sync_grad(g)
     grad_ms = (time.perf_counter() - t0) / ITERS * 1e3
 
     step = model._train_step

@@ -109,8 +109,7 @@ def test_joint_search_explores_conv_merge():
 def test_conv_merge_trains_after_rewrite():
     """The rewritten graph (merged conv + channel split) executes end to
     end when the joint search picks it."""
-    from flexflow_tpu.search.substitution import (
-        apply_substitutions, rule_merge_parallel_convs)
+    from flexflow_tpu.search.substitution import rule_merge_parallel_convs
 
     model, config = _branch_convs(joint=True)
     g = Graph(model.ops)

@@ -1,0 +1,117 @@
+"""Mixed-precision (bf16 activation storage) numerics guard.
+
+The exact-parity align tests run with allow_mixed_precision=False; this file
+covers the DEFAULT path: bf16 activations at op boundaries
+(ops/common.py emit_dtype, applied in runtime/executor.py) with f32
+parameters, statistics, and losses. Training under the bf16 path must track
+the f32 path closely — this is the regression guard for the precision
+decisions in linear/conv epilogues, layernorm/batchnorm statistics, and the
+attention core.
+"""
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def _train_losses(mixed: bool, steps: int = 8):
+    config = ff.FFConfig()
+    config.batch_size = 16
+    config.allow_mixed_precision = mixed
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([16, 32], ff.DataType.DT_INT32)
+    t = model.embedding(tokens, 100, 64, ff.AggrMode.AGGR_MODE_NONE,
+                        name="emb")
+    attn = model.multihead_attention(t, t, t, 64, 4, name="attn")
+    t = model.layer_norm(model.add(t, attn), [-1], name="ln1")
+    h = model.dense(t, 128, ff.ActiMode.AC_MODE_GELU, name="ff1")
+    h = model.dense(h, 64, name="ff2")
+    t = model.layer_norm(model.add(t, h), [-1], name="ln2")
+    model.softmax(model.dense(t, 4, name="cls"))
+    model.compile(optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 100, size=(16, 32)).astype(np.int32)
+    y = (x[..., None] % 4).astype(np.int32)  # learnable token->class map
+    losses = []
+    for _ in range(steps):
+        hist = model.fit([x], y, batch_size=16, epochs=1, verbose=False)
+        losses.append(hist[-1]["loss"])
+    return losses
+
+
+def test_bf16_path_tracks_f32_losses():
+    """Same seed, same data: the bf16-activation path's loss curve stays
+    within a small relative band of exact f32 (both fall)."""
+    f32 = _train_losses(mixed=False)
+    bf16 = _train_losses(mixed=True)
+    assert f32[-1] < f32[0] and bf16[-1] < bf16[0], (f32, bf16)
+    for a, b in zip(f32, bf16):
+        assert abs(a - b) / max(abs(a), 1e-6) < 0.05, (f32, bf16)
+
+
+def test_bf16_activations_actually_bf16():
+    """The executor's boundary cast is live: under mixed precision a dense
+    output value traced through the PCG is bf16."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ffconst import CompMode
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    config.allow_mixed_precision = True
+    model = ff.FFModel(config)
+    x = model.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    t = model.dense(x, 16, ff.ActiMode.AC_MODE_RELU, name="d1")
+    model.softmax(model.dense(t, 2, name="d2"))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.01),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+
+    seen = {}
+
+    def probe(params, state, inputs):
+        values, _, _ = model.executor.forward_values(
+            params, state, inputs, jax.random.PRNGKey(0),
+            CompMode.COMP_MODE_INFERENCE)
+        for op in model.ops:
+            for tt in op.outputs:
+                seen[op.name] = values[tt.guid].dtype
+        return 0
+
+    inputs = {model.input_ops[0].name: jnp.zeros((4, 8), jnp.float32)}
+    jax.eval_shape(probe, model.params, model.state, inputs)
+    assert seen["d1"] == jnp.bfloat16, seen
+
+
+def test_adam_bf16_moments_tracks_f32():
+    """moments_dtype=bfloat16 (TPU bandwidth option) trains within a small
+    band of the default f32-moments Adam."""
+    import jax.numpy as jnp
+
+    def losses(moments_dtype):
+        config = ff.FFConfig()
+        config.batch_size = 32
+        model = ff.FFModel(config)
+        x = model.create_tensor([32, 16], ff.DataType.DT_FLOAT)
+        t = model.dense(x, 64, ff.ActiMode.AC_MODE_RELU)
+        model.softmax(model.dense(t, 4))
+        model.compile(
+            optimizer=ff.AdamOptimizer(model, alpha=3e-3,
+                                       moments_dtype=moments_dtype),
+            loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[])
+        rng = np.random.RandomState(0)
+        X = rng.randn(256, 16).astype(np.float32)
+        Y = np.argmax(X @ rng.randn(16, 4), axis=1).astype(np.int32)[:, None]
+        out = []
+        for _ in range(6):
+            hist = model.fit(x=X, y=Y, epochs=1, verbose=False)
+            out.append(hist[-1]["loss"])
+        return out
+
+    f32 = losses(None)
+    b16 = losses(jnp.bfloat16)
+    assert f32[-1] < f32[0] and b16[-1] < b16[0]
+    assert abs(f32[-1] - b16[-1]) / abs(f32[-1]) < 0.1, (f32, b16)
