@@ -171,7 +171,59 @@ def main():
         from flexflow_tpu.runtime.platform import force_platform
 
         force_platform(platform)
+
+    # Hang watchdog: a wedged tunnel backend (e.g. the chip lease held by a
+    # previously killed client) hangs inside backend-init RPCs, which the
+    # exception-based retry below can never see. A daemon thread re-execs a
+    # fresh interpreter (same backoff counter) if the first device
+    # computation hasn't completed in time. 0 disables.
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 900))
+    backend_ready = []
+
+    if init_timeout > 0:
+        import threading
+
+        def _watchdog():
+            deadline = time.time() + init_timeout
+            while time.time() < deadline:
+                if backend_ready:
+                    return
+                time.sleep(5)
+            if backend_ready:  # init finished during the final sleep
+                return
+            attempt = int(os.environ.get("_BENCH_ATTEMPT", 0))
+            if attempt < MAX_RETRIES:
+                print(
+                    f"bench: backend init hung >{init_timeout:.0f}s, "
+                    f"re-exec retry {attempt + 1}/{MAX_RETRIES}",
+                    file=sys.stderr, flush=True,
+                )
+                env = dict(os.environ, _BENCH_ATTEMPT=str(attempt + 1))
+                os.execve(sys.executable, list(sys.orig_argv), env)
+            print(
+                json.dumps(
+                    {
+                        "metric": "bert_base_train_throughput",
+                        "value": 0.0,
+                        "unit": "samples/sec/chip",
+                        "vs_baseline": 0.0,
+                        "error": f"backend init hung >{init_timeout:.0f}s",
+                        "attempts": attempt + 1,
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(2)
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax  # noqa: F401  (backend init happens here)
+
+    # first real device computation proves the backend is alive
+    import jax.numpy as jnp
+
+    float(np.asarray((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0]))
+    backend_ready.append(True)
 
     # persistent compilation cache: repeat bench runs (and the driver's
     # end-of-round run) skip the multi-minute remote compiles when the code

@@ -15,6 +15,8 @@ the same FFModel/PCG core instead of a parallel re-implementation:
 """
 from .model import InferenceModel
 from .batcher import DynamicBatcher
-from .server import InferenceServer
+from .server import InferenceServer, ModelMetrics
+from .repository import ModelRepository
 
-__all__ = ["InferenceModel", "DynamicBatcher", "InferenceServer"]
+__all__ = ["InferenceModel", "DynamicBatcher", "InferenceServer",
+           "ModelMetrics", "ModelRepository"]

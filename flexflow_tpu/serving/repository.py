@@ -1,0 +1,127 @@
+"""Model repository: directory-of-models loading for the inference server.
+
+reference parity: Triton's model repository is its primary UX — a directory
+per model with a config file and the model artifact; the server scans it,
+loads every model, and serves them by name (triton/src/model.cc loads
+strategy+onnx per model dir; triton/README.md). Here a repository is:
+
+    repo/
+      <model_name>/
+        config.json          required
+        model.onnx | model_spec.json   (per config["format"])
+        weights.npz | ckpt/            optional checkpoint
+
+config.json fields:
+  format         "onnx" (ONNX graph via the onnx importer) or
+                 "ff_cspec" (a model spec exported by the C API's
+                 ffc_model_export_json)
+  file           artifact filename inside the model dir
+  inputs         [{"dims": [...], "dtype": "float32"|"int32"}, ...]
+                 (onnx only — the importer needs built input tensors;
+                 dims include the serving max batch)
+  checkpoint     optional weights file/dir restored after build
+  batch_buckets  optional, default (1, 4, 16, 64)
+  max_batch_size optional, default 64
+  max_delay_ms   optional batching delay, default 2.0
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+
+_DTYPES = {"float32": "DT_FLOAT", "float": "DT_FLOAT", "int32": "DT_INT32",
+           "int64": "DT_INT64", "int": "DT_INT32"}
+
+
+def _build_onnx(model_dir: str, cfg: dict):
+    import flexflow_tpu as ff
+    from ..onnx.model import ONNXModel
+
+    config = ff.FFConfig()
+    inputs_spec = cfg.get("inputs")
+    if not inputs_spec:
+        raise ValueError(f"{model_dir}: onnx models need config 'inputs'")
+    config.batch_size = int(inputs_spec[0]["dims"][0])
+    model = ff.FFModel(config)
+    tensors = []
+    for spec in inputs_spec:
+        dt = getattr(ff.DataType,
+                     _DTYPES.get(str(spec.get("dtype", "float32")).lower(),
+                                 "DT_FLOAT"))
+        tensors.append(model.create_tensor(list(spec["dims"]), dt))
+    onnx_model = ONNXModel(os.path.join(model_dir, cfg["file"]))
+    outs = onnx_model.apply(model, tensors)
+    model.final_tensor = outs[-1] if isinstance(outs, (list, tuple)) else outs
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    onnx_model.transfer_weights(model)
+    return model
+
+
+def _build_cspec(model_dir: str, cfg: dict):
+    import flexflow_tpu as ff
+    from ..native.c_model import model_from_spec
+
+    model = model_from_spec(os.path.join(model_dir, cfg["file"]))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    return model
+
+
+_BUILDERS = {"onnx": _build_onnx, "ff_cspec": _build_cspec}
+
+
+class ModelRepository:
+    """Scans a repository directory and loads/unloads models on a server."""
+
+    def __init__(self, path: str):
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"model repository {path!r} not found")
+        self.path = path
+
+    def model_names(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.path)
+            if os.path.isfile(os.path.join(self.path, d, "config.json"))
+        )
+
+    def config(self, name: str) -> dict:
+        with open(os.path.join(self.path, name, "config.json")) as f:
+            return json.load(f)
+
+    def build(self, name: str):
+        """Build + compile (+ restore checkpoint) one model by name."""
+        model_dir = os.path.join(self.path, name)
+        cfg = self.config(name)
+        fmt = cfg.get("format")
+        if fmt not in _BUILDERS:
+            raise ValueError(
+                f"{name}: unknown format {fmt!r} (have {sorted(_BUILDERS)})")
+        model = _BUILDERS[fmt](model_dir, cfg)
+        ckpt = cfg.get("checkpoint")
+        if ckpt:
+            from ..runtime.checkpoint import restore_checkpoint
+
+            restore_checkpoint(os.path.join(model_dir, ckpt), model)
+        return model
+
+    def load(self, server, names: Optional[List[str]] = None) -> List[str]:
+        """Build and register models (all by default) on an InferenceServer.
+        Returns the loaded names."""
+        loaded = []
+        for name in names if names is not None else self.model_names():
+            cfg = self.config(name)
+            server.register(
+                name,
+                self.build(name),
+                max_batch_size=int(cfg.get("max_batch_size", 64)),
+                max_delay_ms=float(cfg.get("max_delay_ms", 2.0)),
+                batch_buckets=tuple(cfg.get("batch_buckets", (1, 4, 16, 64))),
+            )
+            loaded.append(name)
+        return loaded
+
+    def unload(self, server, name: str) -> None:
+        server.unregister(name)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -18,9 +19,42 @@ from .batcher import DynamicBatcher
 from .model import InferenceModel
 
 
+class ModelMetrics:
+    """Per-model request metrics (the Triton metrics-endpoint role):
+    request/failure counts and latency aggregates, exported as JSON stats
+    and Prometheus-style text."""
+
+    def __init__(self):
+        self.requests = 0
+        self.failures = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float, ok: bool) -> None:
+        with self._lock:
+            self.requests += 1
+            if not ok:
+                self.failures += 1
+            else:
+                self.total_ms += ms
+                self.max_ms = max(self.max_ms, ms)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            done = self.requests - self.failures
+            return {
+                "requests": self.requests,
+                "failures": self.failures,
+                "avg_latency_ms": round(self.total_ms / done, 3) if done else 0.0,
+                "max_latency_ms": round(self.max_ms, 3),
+            }
+
+
 class InferenceServer:
     def __init__(self):
         self._models: Dict[str, DynamicBatcher] = {}
+        self._metrics: Dict[str, ModelMetrics] = {}
 
     def register(self, name: str, model, max_batch_size: int = 64,
                  max_delay_ms: float = 2.0,
@@ -31,9 +65,11 @@ class InferenceServer:
                                  max_delay_ms=max_delay_ms)
         batcher.start()
         self._models[name] = batcher
+        self._metrics[name] = ModelMetrics()
 
     def unregister(self, name: str) -> None:
         b = self._models.pop(name, None)
+        self._metrics.pop(name, None)
         if b:
             b.stop()
 
@@ -42,9 +78,45 @@ class InferenceServer:
 
     def infer(self, name: str, inputs: Dict[str, np.ndarray],
               timeout: Optional[float] = None) -> np.ndarray:
-        if name not in self._models:
+        batcher = self._models.get(name)
+        if batcher is None:
             raise KeyError(f"model {name!r} not registered; have {self.models()}")
-        return self._models[name].infer(inputs, timeout=timeout)
+        # captured up front: a concurrent unregister() must not turn a
+        # completed request into a KeyError at record time
+        metrics = self._metrics.get(name)
+        t0 = time.perf_counter()
+        try:
+            out = batcher.infer(inputs, timeout=timeout)
+        except Exception:
+            if metrics is not None:
+                metrics.record(0.0, ok=False)
+            raise
+        if metrics is not None:
+            metrics.record((time.perf_counter() - t0) * 1e3, ok=True)
+        return out
+
+    def stats(self, name: Optional[str] = None):
+        if name is not None:
+            return self._metrics[name].stats()
+        return {n: m.stats() for n, m in sorted(self._metrics.items())}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition-format metrics (the Triton /metrics role)."""
+        lines = [
+            "# TYPE ff_inference_requests_total counter",
+            "# TYPE ff_inference_failures_total counter",
+            "# TYPE ff_inference_avg_latency_ms gauge",
+        ]
+        def esc(v: str) -> str:  # Prometheus label-value escaping
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+        for n, m in sorted(self._metrics.items()):
+            s = m.stats()
+            n = esc(n)
+            lines.append(f'ff_inference_requests_total{{model="{n}"}} {s["requests"]}')
+            lines.append(f'ff_inference_failures_total{{model="{n}"}} {s["failures"]}')
+            lines.append(f'ff_inference_avg_latency_ms{{model="{n}"}} {s["avg_latency_ms"]}')
+        return "\n".join(lines) + "\n"
 
     def shutdown(self):
         for name in list(self._models):
@@ -72,8 +144,22 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                parts = self.path.strip("/").split("/")
                 if self.path == "/v2/models":
                     self._reply(200, {"models": server_ref.models()})
+                elif self.path == "/metrics":
+                    body = server_ref.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (len(parts) == 4 and parts[0] == "v2"
+                        and parts[1] == "models" and parts[3] == "stats"):
+                    try:
+                        self._reply(200, server_ref.stats(parts[2]))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
                 else:
                     self._reply(404, {"error": "not found"})
 

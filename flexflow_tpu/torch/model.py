@@ -151,10 +151,15 @@ def _fold(target: str, args, kwargs):
         if target == "zeros":
             return wrap(np.zeros(shape_args(a), dtype=_np_dtype(k.get("dtype"))))
         if target == "full":
-            return wrap(np.full(tuple(a[0]), a[1],
+            # fill may be positional or the fill_value kwarg (the HF T5/mt5
+            # causal-mask trace passes it by keyword, with token-dict
+            # dtype/device kwargs from tensor introspection)
+            fill = a[1] if len(a) > 1 else k["fill_value"]
+            return wrap(np.full(shape_args([a[0]]), fill,
                                 dtype=_np_dtype(k.get("dtype"))))
         if target == "full_like":
-            return wrap(np.full_like(a[0], a[1]))
+            return wrap(np.full_like(a[0], a[1] if len(a) > 1
+                                     else k["fill_value"]))
         if target == "zeros_like":
             return wrap(np.zeros_like(a[0]))
         if target == "ones_like":
@@ -169,6 +174,17 @@ def _fold(target: str, args, kwargs):
                 idx = tuple(x if isinstance(x, (slice, int)) else _npv(x)
                             for x in idx)
             return wrap(a[0][idx])
+        if target == "setitem":
+            # trace-time mask surgery (e.g. the T5/mt5 causal-mask window
+            # writes). fx uses the setitem NODE's result downstream, so
+            # copy-on-fold preserves value semantics.
+            arr = np.array(a[0])
+            idx = args[1]
+            if isinstance(idx, list):
+                idx = tuple(x if isinstance(x, (slice, int)) else _npv(x)
+                            for x in idx)
+            arr[idx] = a[2]
+            return wrap(arr)
         if target == "getattr":
             return wrap(getattr(a[0], args[1]))
         if target in ("to", "type_as"):
@@ -284,7 +300,10 @@ class PyTorchModel:
                 return env[a["node"]]
             if "slice" in a:
                 return slice(*[self._decode(x, env) for x in a["slice"]])
-            if "dtype" in a or "repr" in a:
+            # token leaves are exactly {"dtype": str} / {"repr": str}
+            # (fx._encode_arg); a kwargs dict merely CONTAINING a
+            # dtype/repr key must still recurse so node refs resolve
+            if len(a) == 1 and ("dtype" in a or "repr" in a):
                 return a
             return {k: self._decode(v, env) for k, v in a.items()}
         if isinstance(a, list):

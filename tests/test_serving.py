@@ -99,3 +99,119 @@ def test_server_http_roundtrip():
     finally:
         httpd.shutdown()
         server.shutdown()
+
+
+def test_server_metrics_and_stats():
+    server = InferenceServer()
+    server.register("m", make_model(), max_batch_size=8, max_delay_ms=0.5)
+    try:
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        inp = {server._models["m"].model.input_names[0]: x}
+        server.infer("m", inp, timeout=30.0)
+        server.infer("m", inp, timeout=30.0)
+        s = server.stats("m")
+        assert s["requests"] == 2 and s["failures"] == 0
+        assert s["avg_latency_ms"] > 0
+        text = server.prometheus_text()
+        assert 'ff_inference_requests_total{model="m"} 2' in text
+    finally:
+        server.shutdown()
+
+
+def test_model_repository_loads_and_serves(tmp_path):
+    """Triton's primary UX: a directory per model (config + artifact) that
+    the server scans and loads (reference: triton/src/model.cc per-dir
+    loading)."""
+    pytest.importorskip("onnx")
+    import onnx.helper as oh
+    import onnx.numpy_helper as nph
+
+    from flexflow_tpu.serving import ModelRepository
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(6, 12).astype(np.float32)
+    w2 = rng.randn(12, 3).astype(np.float32)
+    nodes = [
+        oh.make_node("MatMul", ["x", "w1"], ["h"], name="fc1"),
+        oh.make_node("Relu", ["h"], ["hr"], name="relu1"),
+        oh.make_node("MatMul", ["hr", "w2"], ["y"], name="fc2"),
+    ]
+    graph = oh.make_graph(
+        nodes, "mlp",
+        [oh.make_tensor_value_info("x", 1, [8, 6])],
+        [oh.make_tensor_value_info("y", 1, [8, 3])],
+        initializer=[nph.from_array(w1, "w1"), nph.from_array(w2, "w2")],
+    )
+    proto = oh.make_model(graph)
+
+    mdir = tmp_path / "mlp"
+    mdir.mkdir()
+    import onnx
+
+    onnx.save(proto, str(mdir / "model.onnx"))
+    (mdir / "config.json").write_text(json.dumps({
+        "format": "onnx",
+        "file": "model.onnx",
+        "inputs": [{"dims": [8, 6], "dtype": "float32"}],
+        "max_batch_size": 8,
+        "batch_buckets": [1, 4, 8],
+    }))
+
+    repo = ModelRepository(str(tmp_path))
+    assert repo.model_names() == ["mlp"]
+    server = InferenceServer()
+    try:
+        assert repo.load(server) == ["mlp"]
+        x = rng.randn(2, 6).astype(np.float32)
+        out = np.asarray(server.infer(
+            "mlp", {server._models["mlp"].model.input_names[0]: x},
+            timeout=30.0))
+        ref = np.maximum(x @ w1, 0.0) @ w2
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+        repo.unload(server, "mlp")
+        assert server.models() == []
+    finally:
+        server.shutdown()
+
+
+def test_model_repository_cspec_format(tmp_path):
+    """ff_cspec repository entry: a model spec exported by the C API
+    (ffc_model_export_json) served by name."""
+    from flexflow_tpu.serving import ModelRepository
+
+    spec = {
+        "format": "flexflow_tpu_c_model",
+        "config": {"batch_size": 8},
+        "ops": [
+            {"type": "input", "name": "x", "dims": [8, 6],
+             "dtype": "float32", "inputs": [], "outputs": [1]},
+            {"type": "dense", "name": "fc1", "inputs": [1], "outputs": [2],
+             "params": {"out_dim": 12, "activation": "relu"}},
+            {"type": "dense", "name": "fc2", "inputs": [2], "outputs": [3],
+             "params": {"out_dim": 3}},
+            {"type": "softmax", "name": "sm", "inputs": [3], "outputs": [4],
+             "params": {}},
+        ],
+    }
+    mdir = tmp_path / "cmodel"
+    mdir.mkdir()
+    (mdir / "model_spec.json").write_text(json.dumps(spec))
+    (mdir / "config.json").write_text(json.dumps({
+        "format": "ff_cspec", "file": "model_spec.json",
+        "max_batch_size": 8, "batch_buckets": [1, 8],
+    }))
+
+    repo = ModelRepository(str(tmp_path))
+    server = InferenceServer()
+    try:
+        assert repo.load(server) == ["cmodel"]
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+        out = np.asarray(server.infer(
+            "cmodel", {server._models["cmodel"].model.input_names[0]: x},
+            timeout=30.0))
+        assert out.shape == (2, 3)
+        # repository models serve with mixed precision on (bf16 rounding)
+        np.testing.assert_allclose(out.sum(axis=-1), 1.0, atol=1e-2)
+        assert server.stats("cmodel")["requests"] == 1
+    finally:
+        server.shutdown()
