@@ -336,6 +336,7 @@ class FFModel:
         add_zero_attn: bool = False,
         causal: bool = False,
         sequence_parallel: bool = False,
+        sequence_parallel_mode: str = "ring",
         use_flash: Optional[bool] = None,
         kernel_initializer=None,
         name: str = "",
@@ -354,6 +355,7 @@ class FFModel:
             add_zero_attn=add_zero_attn,
             causal=causal,
             sequence_parallel=sequence_parallel,
+            sequence_parallel_mode=sequence_parallel_mode,
             use_flash=use_flash,
             kernel_initializer=kernel_initializer,
         ).outputs[0]

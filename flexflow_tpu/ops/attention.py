@@ -143,15 +143,37 @@ class MultiHeadAttentionOp(Op):
                     vc, v.astype(vc.dtype), (0, 0, 0, 0)))
 
         if seq_parallel_active:
-            # sequence/context parallelism: ring attention over the 'seq'
-            # mesh axis (kernels/ring_attention.py) — K/V blocks rotate on
-            # ICI neighbor links instead of materializing the full L x L
-            # score matrix per chip
-            from ..kernels.ring_attention import ring_attention_sharded
+            # sequence/context parallelism over the 'seq' mesh axis — two
+            # designs (SURVEY §5): "ring" (default) rotates K/V blocks on
+            # ICI neighbor links with an online softmax
+            # (kernels/ring_attention.py); "ulysses" all_to_alls to
+            # head-sharding, runs exact local attention on full sequences,
+            # and all_to_alls back (kernels/ulysses_attention.py — needs
+            # num_heads divisible by the axis size)
+            mode = p.get("sequence_parallel_mode", "ring")
+            if mode in ("ulysses", "all_to_all"):
+                from ..kernels.ulysses_attention import ulysses_attention_sharded
 
-            ctxv = ring_attention_sharded(
-                q, k, v, ctx.mesh, axis_name="seq", causal=causal, scale=scale,
-            )
+                ctxv = ulysses_attention_sharded(
+                    q, k, v, ctx.mesh, axis_name="seq", causal=causal,
+                    scale=scale,
+                    # the local core is an ordinary dense attention, so the
+                    # same measured auto-policy picks flash vs einsum
+                    use_flash=(self._use_flash(ctx) and not dropout_active
+                               and kdim == vdim),
+                    interpret=jax.default_backend() != "tpu",
+                )
+            elif mode == "ring":
+                from ..kernels.ring_attention import ring_attention_sharded
+
+                ctxv = ring_attention_sharded(
+                    q, k, v, ctx.mesh, axis_name="seq", causal=causal,
+                    scale=scale,
+                )
+            else:
+                raise ValueError(
+                    f"unknown sequence_parallel_mode {mode!r}: "
+                    "expected 'ring' or 'ulysses'")
         elif self._use_flash(ctx) and not dropout_active and kdim == vdim:
             # hot path: Pallas flash attention — VMEM-tiled online softmax,
             # no L x L score matrix in HBM (kernels/flash_attention.py)

@@ -20,8 +20,9 @@ config.json fields:
                  (onnx only — the importer needs built input tensors;
                  dims include the serving max batch)
   checkpoint     optional weights file/dir restored after build
-  batch_buckets  optional, default (1, 4, 16, 64)
-  max_batch_size optional, default 64
+  max_batch_size optional; defaults to the batch the model was built for
+  batch_buckets  optional; defaults to (1, 4, 16, ...) clamped to
+                 max_batch_size — requests never pad past the built batch
   max_delay_ms   optional batching delay, default 2.0
 """
 from __future__ import annotations
@@ -91,10 +92,11 @@ class ModelRepository:
         with open(os.path.join(self.path, name, "config.json")) as f:
             return json.load(f)
 
-    def build(self, name: str):
+    def build(self, name: str, cfg: Optional[dict] = None):
         """Build + compile (+ restore checkpoint) one model by name."""
         model_dir = os.path.join(self.path, name)
-        cfg = self.config(name)
+        if cfg is None:
+            cfg = self.config(name)
         fmt = cfg.get("format")
         if fmt not in _BUILDERS:
             raise ValueError(
@@ -113,12 +115,22 @@ class ModelRepository:
         loaded = []
         for name in names if names is not None else self.model_names():
             cfg = self.config(name)
+            model = self.build(name, cfg)
+            # batching defaults derive from the batch the model was BUILT
+            # for — padding a request to a bucket larger than the declared
+            # batch would run the executor at a shape the graph never had
+            built_batch = int(model.config.batch_size)
+            max_bs = int(cfg.get("max_batch_size", built_batch))
+            buckets = cfg.get("batch_buckets")
+            if buckets is None:
+                buckets = [b for b in (1, 4, 16, 64) if b < max_bs] + [max_bs]
+            buckets = [min(int(b), max_bs) for b in buckets]
             server.register(
                 name,
-                self.build(name),
-                max_batch_size=int(cfg.get("max_batch_size", 64)),
+                model,
+                max_batch_size=max_bs,
                 max_delay_ms=float(cfg.get("max_delay_ms", 2.0)),
-                batch_buckets=tuple(cfg.get("batch_buckets", (1, 4, 16, 64))),
+                batch_buckets=tuple(buckets),
             )
             loaded.append(name)
         return loaded

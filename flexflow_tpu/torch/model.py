@@ -176,13 +176,18 @@ def _fold(target: str, args, kwargs):
             return wrap(a[0][idx])
         if target == "setitem":
             # trace-time mask surgery (e.g. the T5/mt5 causal-mask window
-            # writes). fx uses the setitem NODE's result downstream, so
-            # copy-on-fold preserves value semantics.
-            arr = np.array(a[0])
+            # writes). Python never rebinds on __setitem__, so downstream
+            # nodes keep referencing the ORIGINAL tensor node — mutating
+            # the stored array in place (the same object in env via
+            # _Const.value) serves both it and the setitem node, matching
+            # eager/fx-Interpreter semantics.
             idx = args[1]
             if isinstance(idx, list):
                 idx = tuple(x if isinstance(x, (slice, int)) else _npv(x)
                             for x in idx)
+            arr = a[0]
+            if not (isinstance(arr, np.ndarray) and arr.flags.writeable):
+                arr = np.array(arr)
             arr[idx] = a[2]
             return wrap(arr)
         if target == "getattr":

@@ -267,3 +267,30 @@ def test_squeeze_semantics():
     with torch.no_grad():
         theirs = module(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_setitem_aliasing_matches_torch():
+    """__setitem__ never rebinds in Python, so downstream uses of the
+    ORIGINAL tensor must see the mutation (fold mutates the stored array
+    in place, matching eager semantics)."""
+
+    class MaskAdd(nn.Module):
+        def forward(self, x):
+            m = torch.zeros(4)
+            m[0] = 1.0
+            return x + m  # references the original zeros node
+
+    m = MaskAdd().eval()
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    config = make_config(2)
+    model = ff.FFModel(config)
+    t = model.create_tensor([2, 4])
+    pt = PyTorchModel(m)
+    outs = pt.apply(model, [t])
+    model.final_tensor = outs[0]
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    ours = model.predict(x)
+    with torch.no_grad():
+        theirs = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
