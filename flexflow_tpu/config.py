@@ -64,6 +64,10 @@ class FFConfig:
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
+    # sequence/context parallelism as a SEARCH axis (NEW vs the reference):
+    # the Unity search may shard the position dim over a 'seq' mesh axis
+    # (ring attention) when enabled
+    enable_sequence_parallel: bool = False
     enable_inplace_optimizations: bool = False
     # collectives overlap compute in the simulator's two-stream schedule
     # (XLA's latency-hiding scheduler does this on TPU); False = collectives
@@ -150,6 +154,8 @@ class FFConfig:
                 self.enable_parameter_parallel = True
             elif a == "--enable-attribute-parallel":
                 self.enable_attribute_parallel = True
+            elif a == "--enable-sequence-parallel":
+                self.enable_sequence_parallel = True
             elif a == "--search-overlap-backward-update":
                 self.search_overlap_backward_update = True
             elif a == "--memory-search":

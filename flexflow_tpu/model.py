@@ -724,7 +724,8 @@ class FFModel:
         tp = axes.get("model", 1)
         view = MachineView(axes=tuple(axes.items()))
         ap_axis = axes.get("attr", 1)
-        from .search.simulator import AP_CAPABLE
+        sp_axis = axes.get("seq", 1)
+        from .search.simulator import AP_CAPABLE, sp_shardable
 
         for op in self.graph.topo_order():
             # per-op search result overrides the mesh-wide default
@@ -732,7 +733,27 @@ class FFModel:
             op_dp = min(s.dp, dp) if s else dp
             op_tp = min(s.tp, tp) if s else tp
             op_ap = min(s.ap, ap_axis) if s else ap_axis
+            op_sp = min(s.sp, sp_axis) if s else sp_axis
             spatial = (op_ap > 1 and op.op_type in AP_CAPABLE)
+            # search-selected sequence parallelism: position dims shard over
+            # 'seq' and attention switches to the ring kernel (the manual
+            # sequence_parallel=True op param is the same machinery)
+            seq_sharded = op_sp > 1 and sp_shardable(op, op_sp)
+            if seq_sharded and op.op_type == OpType.MULTIHEAD_ATTENTION:
+                if op.params.get("dropout", 0.0) > 0:
+                    # the SP kernels have no attention-prob dropout
+                    # (ops/attention.py fails loudly on the explicit
+                    # combination) — this op stays unsharded rather than
+                    # silently changing regularization
+                    seq_sharded = False
+                else:
+                    # a 'seq' axis with sp>1 on this op means SP executes
+                    # here: the attention must run its sequence-parallel
+                    # kernel (the builder default is False; the axis only
+                    # exists when the user passed parallel_axes={'seq': n}
+                    # or the search chose SP, both of which own this
+                    # decision)
+                    op.params["sequence_parallel"] = True
             op.machine_view = view
             for t in list(op.outputs):
                 dims = []
@@ -740,6 +761,15 @@ class FFModel:
                     if i == 0 and op_dp > 1 and size == batch and size % op_dp == 0:
                         dims.append(
                             ParallelDim(size, op_dp, "data", kind=ParallelDimKind.SAMPLE)
+                        )
+                    elif (i == 1 and seq_sharded and len(t.dims) >= 3
+                          and size % op_sp == 0):
+                        # sequence/context parallelism: position dim over
+                        # 'seq' (attention runs the ring kernel; GSPMD keeps
+                        # position-wise ops local)
+                        dims.append(
+                            ParallelDim(size, op_sp, "seq",
+                                        kind=ParallelDimKind.SEQUENCE)
                         )
                     elif (i == 2 and spatial and len(t.dims) == 4
                           and size % op_ap == 0):

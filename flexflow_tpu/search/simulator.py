@@ -50,6 +50,12 @@ class OpStrategy:
     tp: int = 1
     ep: int = 1
     ap: int = 1
+    # sequence/context parallelism (NEW vs the reference, which has no SP —
+    # SURVEY §5): the activations' position dim shards over a 'seq' mesh
+    # axis; attention runs the ring kernel whose K/V rotation the cost
+    # model prices (sp_collective_time_us). Uniform across the graph per
+    # factorization — per-op sp flips would reshard at every edge.
+    sp: int = 1
     # reduction/"parameter" parallelism (LINEAR only): the kernel shards on
     # the INPUT-feature dim; the output is a partial sum all-reduced by
     # GSPMD — the Megatron row-parallel half, paired with a column-parallel
@@ -59,7 +65,7 @@ class OpStrategy:
 
     @property
     def degree(self) -> int:
-        return self.dp * self.tp * self.ep * self.ap
+        return self.dp * self.tp * self.ep * self.ap * self.sp
 
 
 # ops whose weights/channels can shard over the model axis (reference:
@@ -97,6 +103,16 @@ TP_WEIGHT_SHARD_DIMS = {
 _MEMORY_BOUND_BWD_FACTOR = 2.0  # bwd ≈ 2x fwd cost (two grad GEMMs per GEMM)
 
 
+def sp_shardable(op: Op, sp: int) -> bool:
+    """Sequence sharding applies to ops whose output carries a position dim
+    (ndim >= 3, dim 1 divisible). EXPERTS excluded: its expert-axis
+    shard_map owns the token layout."""
+    if sp <= 1 or not op.outputs or op.op_type == OpType.EXPERTS:
+        return False
+    t = op.outputs[0]
+    return len(t.dims) >= 3 and t.dims[1] > 1 and t.dims[1] % sp == 0
+
+
 class CostModel:
     """Analytic per-op + per-edge costs under a strategy."""
 
@@ -115,6 +131,11 @@ class CostModel:
             shards *= s.ep
         if op.op_type in AP_CAPABLE:
             shards *= s.ap
+        if sp_shardable(op, s.sp):
+            # position-wise compute divides by sp; the attention core's
+            # L x L work also divides (each chip attends its L/sp queries
+            # against the full rotated K/V)
+            shards *= s.sp
         flops = op.flops() / max(1, shards)
         bytes_ = op.bytes_accessed() / max(1, shards)
         return self.machine.compute_time_us(flops, bytes_, self.op_dtype_bytes(op))
@@ -163,6 +184,25 @@ class CostModel:
             self.op_dtype_bytes(op)
         # exchanged once fwd + mirrored bwd
         return 2.0 * self.machine.p2p_time_us(halo_bytes)
+
+    def sp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
+        """Ring-attention K/V rotation cost under sequence parallelism:
+        (sp-1) neighbor ppermutes of the local K and V blocks, forward, and
+        the mirrored rotation of their gradients in backward (the ring scan
+        reverses). Non-attention ops pay nothing — GSPMD keeps their
+        position-sharded activations local."""
+        if s.sp <= 1 or op.op_type != OpType.MULTIHEAD_ATTENTION:
+            return 0.0
+        if not op.inputs or len(op.inputs[0].dims) < 3:
+            return 0.0
+        k_in = op.inputs[1] if len(op.inputs) > 1 else op.inputs[0]
+        heads = op.params.get("num_heads", 1)
+        kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
+        b = k_in.dims[0] / max(1, s.dp)
+        l_local = k_in.dims[1] / s.sp
+        kv_bytes = 2.0 * b * l_local * heads * kdim * self.op_dtype_bytes(op)
+        # fwd rotation + mirrored bwd rotation of dK/dV
+        return 2.0 * (s.sp - 1) * self.machine.p2p_time_us(kv_bytes)
 
     def ep_collective_time_us(self, op: Op, s: OpStrategy) -> float:
         """Token routing cost of expert parallelism: all_to_all of the
@@ -286,6 +326,8 @@ class CostModel:
                          and not s.tp_row else 1)
         if op.op_type in AP_CAPABLE:
             ashard *= s.ap
+        if sp_shardable(op, s.sp):
+            ashard *= s.sp
         ab /= max(1, ashard)
         return self.opt_state_factor * wb + ab
 
@@ -561,6 +603,14 @@ class Simulator:
             fwd, bwd = self.measured.measure_us(op, s)
             if fwd < 0:
                 self.analytic_fallbacks += 1
+            elif sp_shardable(op, s.sp):
+                # measured at the (dp, tp) local shape with the full
+                # sequence; per-chip work under sp divides by sp exactly —
+                # position-wise ops scale with L, and the attention core's
+                # per-chip share is (L/sp) x L
+                fwd /= s.sp
+                if bwd > 0:
+                    bwd /= s.sp
         if fwd < 0:
             fwd = self.cost.forward_time_us(op, s)
         if bwd < 0:
@@ -578,7 +628,8 @@ class Simulator:
         fwd, bwd = self.fwd_bwd_time_us(op, s)
         return (fwd + bwd + self.cost.tp_collective_time_us(op, s)
                 + self.cost.ep_collective_time_us(op, s)
-                + self.cost.ap_halo_time_us(op, s))
+                + self.cost.ap_halo_time_us(op, s)
+                + self.cost.sp_collective_time_us(op, s))
 
     def simulate(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         """Per-iteration time (us): event-driven schedule of the
@@ -637,10 +688,11 @@ class Simulator:
                 ready = max(ready, e)
             fin = run_compute(fwd, ready)
             # op-internal fwd collectives gate the op's output: expert
-            # all_to_all, conv halos, and the row-parallel linear's
-            # partial-sum allreduce
+            # all_to_all, conv halos, the ring K/V rotation, and the
+            # row-parallel linear's partial-sum allreduce
             intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
-                           + self.cost.ap_halo_time_us(op, s))
+                           + self.cost.ap_halo_time_us(op, s)
+                           + self.cost.sp_collective_time_us(op, s))
             if s.tp_row:
                 intra += 0.5 * self.cost.tp_collective_time_us(op, s)
             out_ready[op.guid] = run_comm(intra, fin)
@@ -669,7 +721,8 @@ class Simulator:
                                      bwd_end[con.guid]))
             fin = run_compute(bwd, ready)
             intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
-                           + self.cost.ap_halo_time_us(op, s))
+                           + self.cost.ap_halo_time_us(op, s)
+                           + self.cost.sp_collective_time_us(op, s))
             if s.tp_row:  # bwd allreduce at the Megatron pair entry
                 intra += 0.5 * self.cost.tp_collective_time_us(op, s)
             fin = run_comm(intra, fin)

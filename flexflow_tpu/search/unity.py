@@ -47,9 +47,15 @@ def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
 
 
 def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
-                     config, ep: int = 1, ap: int = 1) -> List[OpStrategy]:
-    """Strategy menu for one op under a (dp, tp[, ep]) mesh (reference:
-    get_valid_machine_views, graph.h:205-210)."""
+                     config, ep: int = 1, ap: int = 1,
+                     sp: int = 1) -> List[OpStrategy]:
+    """Strategy menu for one op under a (dp, tp[, ep, ap, sp]) mesh
+    (reference: get_valid_machine_views, graph.h:205-210). sp is uniform —
+    sequence sharding is graph-wide per factorization, so sp-shardable ops
+    carry it unconditionally rather than as a per-op choice."""
+    from .simulator import sp_shardable
+
+    op_sp = sp if sp_shardable(op, sp) else 1
     menu = []
     dps = [d for d in (dp, 1) if batch_size % max(d, 1) == 0]
     if not dps:
@@ -90,7 +96,7 @@ def valid_strategies(op: Op, dp: int, tp: int, batch_size: int,
             for e in eps:
                 for a in aps:
                     menu.append(OpStrategy(dp=d, tp=t, ep=e, ap=a,
-                                           tp_row=row))
+                                           sp=op_sp, tp_row=row))
     return menu
 
 
@@ -188,8 +194,10 @@ class GraphSearchHelper:
 
     def _optimize_segment(self, seg: List[Op], dp: int, tp: int,
                           batch: int, ep: int = 1, ap: int = 1,
+                          sp: int = 1,
                           lam: float = 0.0) -> Dict[int, OpStrategy]:
-        key = (tuple(op.guid for op in seg), dp, tp, ep, ap, round(lam, 15))
+        key = (tuple(op.guid for op in seg), dp, tp, ep, ap, sp,
+               round(lam, 15))
         if key in self._memo:
             return self._memo[key]
         seg_graph = Graph(seg)
@@ -197,7 +205,7 @@ class GraphSearchHelper:
         strategies = {}
         for op in seg:
             menu = [s for s in valid_strategies(op, dp, tp, batch, self.config,
-                                                ep=ep, ap=ap)
+                                                ep=ep, ap=ap, sp=sp)
                     if self._tp_ok(op, s)]
             strategies[op.guid] = min(
                 menu, key=lambda s: (self.sim.op_step_time_us(op, s)
@@ -207,14 +215,15 @@ class GraphSearchHelper:
         best = self._best_first_flips(
             seg, strategies,
             lambda st: self._segment_cost(seg_graph, st, lam),
-            dp, tp, batch, ep, ap)
+            dp, tp, batch, ep, ap, sp)
         self._memo[key] = best
         return best
 
     def _best_first_flips(self, ops: List[Op],
                           strategies: Dict[int, OpStrategy],
                           cost_fn, dp: int, tp: int, batch: int,
-                          ep: int, ap: int) -> Dict[int, OpStrategy]:
+                          ep: int, ap: int,
+                          sp: int = 1) -> Dict[int, OpStrategy]:
         """Best-first refinement over single-op strategy flips with alpha
         pruning and the iteration budget (reference: base_optimize,
         substitution.cc:2229-2311) — shared by the per-segment DP and the
@@ -235,7 +244,7 @@ class GraphSearchHelper:
                 continue  # prune (reference: substitution.cc:2278)
             for op in ops:
                 for s in valid_strategies(op, dp, tp, batch, self.config,
-                                          ep=ep, ap=ap):
+                                          ep=ep, ap=ap, sp=sp):
                     if s == cur.get(op.guid):
                         continue
                     if not self._tp_ok(op, s):
@@ -331,39 +340,72 @@ class GraphSearchHelper:
         has_spatial = (self.config.enable_attribute_parallel
                        and any(op.op_type in AP_CAPABLE
                                for op in graph.ops.values()))
-        quads = []
+        # sequence parallelism is searchable only where it can execute
+        # (--enable-sequence-parallel; NEW vs the reference, which has no
+        # SP axis at all): every attention op's q AND k/v sequence lengths
+        # must divide each candidate sp (cross-attention has distinct
+        # lengths), the Ulysses mode additionally needs divisible heads,
+        # and attention-prob dropout has no SP kernel
+        attn_seq_lens = set()
+        sp_head_caps = []  # per-op extra divisibility (ulysses heads)
+        sp_blocked = False
+        for op in graph.ops.values():
+            if op.op_type != OpType.MULTIHEAD_ATTENTION:
+                continue
+            if not op.inputs or len(op.inputs[0].dims) < 3:
+                continue
+            if op.params.get("dropout", 0.0) > 0:
+                sp_blocked = True  # SP kernels have no attention dropout
+            for t in op.inputs[:3]:
+                if len(t.dims) >= 3:
+                    attn_seq_lens.add(t.dims[1])
+            if op.params.get("sequence_parallel_mode") in ("ulysses",
+                                                           "all_to_all"):
+                sp_head_caps.append(op.params.get("num_heads", 1))
+        sp_enabled = (getattr(self.config, "enable_sequence_parallel", False)
+                      and attn_seq_lens
+                      and not sp_blocked
+                      and not self.config.only_data_parallel)
+
+        def sp_feasible(sp: int) -> bool:
+            return (all(l % sp == 0 for l in attn_seq_lens)
+                    and all(h % sp == 0 for h in sp_head_caps))
+        tuples = []
         for dp, rest in _divisor_pairs(n_devices):
             for tp, rest2 in _divisor_pairs(rest):
-                for ep, ap in _divisor_pairs(rest2):
-                    if ep > 1 and not (expert_counts and all(
-                            n % ep == 0 for n in expert_counts)):
-                        continue
-                    if ap > 1 and not has_spatial:
-                        continue
-                    quads.append((dp, tp, ep, ap))
+                for ep, rest3 in _divisor_pairs(rest2):
+                    for ap, sp in _divisor_pairs(rest3):
+                        if ep > 1 and not (expert_counts and all(
+                                n % ep == 0 for n in expert_counts)):
+                            continue
+                        if ap > 1 and not has_spatial:
+                            continue
+                        if sp > 1 and not (sp_enabled and sp_feasible(sp)):
+                            continue
+                        tuples.append((dp, tp, ep, ap, sp))
         if self.config.only_data_parallel:
-            quads = [(n_devices, 1, 1, 1)]
-        for dp, tp, ep, ap in quads:
+            tuples = [(n_devices, 1, 1, 1, 1)]
+        for dp, tp, ep, ap, sp in tuples:
             if batch_size % dp != 0:
                 continue
             strategies: Dict[int, OpStrategy] = {}
             for seg in self._segments(graph):
                 strategies.update(
                     self._optimize_segment(seg, dp, tp, batch_size,
-                                           ep=ep, ap=ap, lam=lam))
+                                           ep=ep, ap=ap, sp=sp, lam=lam))
             # cross-segment refinement: per-segment DP cannot see reshard
             # costs across segment boundaries (e.g. the column->row TP
             # pairing on a chain, where every node is its own segment) —
             # re-optimize single-op flips against the FULL-graph simulate
             strategies = self._refine_global(graph, strategies, dp, tp,
-                                             batch_size, ep, ap, lam)
+                                             batch_size, ep, ap, lam, sp=sp)
             cost = self.sim.simulate(graph, strategies)
             mem = self.sim.memory_bytes(graph, strategies)
             candidates.append(
                 SearchResult(strategies,
-                             self._axes(dp, tp, strategies, ep, ap),
+                             self._axes(dp, tp, strategies, ep, ap, sp),
                              cost, mem,
-                             [f"dp={dp} tp={tp} ep={ep} ap={ap} "
+                             [f"dp={dp} tp={tp} ep={ep} ap={ap} sp={sp} "
                               f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
             )
         if not candidates:
@@ -404,7 +446,8 @@ class GraphSearchHelper:
 
     def _refine_global(self, graph: Graph, strategies: Dict[int, OpStrategy],
                        dp: int, tp: int, batch: int, ep: int = 1,
-                       ap: int = 1, lam: float = 0.0) -> Dict[int, OpStrategy]:
+                       ap: int = 1, lam: float = 0.0,
+                       sp: int = 1) -> Dict[int, OpStrategy]:
         """Whole-graph best-first refinement, costed by the event-driven
         full-graph simulate — the pass that sees cross-segment edge
         interactions the per-segment DP cannot (reference: base_optimize
@@ -417,8 +460,8 @@ class GraphSearchHelper:
         ops = self._boundary_ops(graph)
         if budget == 0 or not ops:
             return strategies
-        key = (tuple(sorted(graph.ops)), dp, tp, ep, ap, round(lam, 15),
-               "global")
+        key = (tuple(sorted(graph.ops)), dp, tp, ep, ap, sp,
+               round(lam, 15), "global")
         if key in self._memo:
             return self._memo[key]
 
@@ -429,7 +472,7 @@ class GraphSearchHelper:
             return c
 
         best = self._best_first_flips(ops, strategies, cost_of,
-                                      dp, tp, batch, ep, ap)
+                                      dp, tp, batch, ep, ap, sp)
         self._memo[key] = best
         return best
 
@@ -566,7 +609,7 @@ class GraphSearchHelper:
         return None
 
     def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],
-              ep: int = 1, ap: int = 1) -> Dict[str, int]:
+              ep: int = 1, ap: int = 1, sp: int = 1) -> Dict[str, int]:
         axes = {}
         if dp > 1 and any(s.dp > 1 for s in strategies.values()):
             axes["data"] = dp
@@ -576,6 +619,8 @@ class GraphSearchHelper:
             axes["expert"] = ep
         if ap > 1 and any(s.ap > 1 for s in strategies.values()):
             axes["attr"] = ap
+        if sp > 1 and any(s.sp > 1 for s in strategies.values()):
+            axes["seq"] = sp
         return axes
 
 
@@ -646,6 +691,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
             and not wants_attr and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
             and not config.enable_parameter_parallel  # row-TP is Python-only
+            and not getattr(config, "enable_sequence_parallel", False)  # SP too
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -673,7 +719,8 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         "memory_bytes": result.memory_bytes,
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
-                                   "ap": s.ap, "tp_row": s.tp_row}
+                                   "ap": s.ap, "sp": s.sp,
+                                   "tp_row": s.tp_row}
             for guid, s in result.strategies.items()
             if guid in graph.ops
         },
@@ -692,5 +739,5 @@ def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dic
         if name in by_name:
             strategies[by_name[name].guid] = OpStrategy(
                 dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1),
-                tp_row=s.get("tp_row", False))
+                sp=s.get("sp", 1), tp_row=s.get("tp_row", False))
     return strategies, data.get("mesh_axes", {})

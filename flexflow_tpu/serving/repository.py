@@ -120,7 +120,10 @@ class ModelRepository:
             # for — padding a request to a bucket larger than the declared
             # batch would run the executor at a shape the graph never had
             built_batch = int(model.config.batch_size)
-            max_bs = int(cfg.get("max_batch_size", built_batch))
+            # an explicit max_batch_size is clamped too: the executor runs
+            # the graph at the shapes it was built for
+            max_bs = min(int(cfg.get("max_batch_size", built_batch)),
+                         built_batch)
             buckets = cfg.get("batch_buckets")
             if buckets is None:
                 buckets = [b for b in (1, 4, 16, 64) if b < max_bs] + [max_bs]

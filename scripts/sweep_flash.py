@@ -1,0 +1,88 @@
+"""Flash-attention block-size sweep at the bench config (PROFILE.md's
+"next measurements wanted"). Times fwd+bwd of the Pallas kernel across
+block_q x block_k combinations against the einsum reference, on the real
+chip. Prints one JSON line with the per-config ms and the winner.
+
+Usage: python scripts/sweep_flash.py
+Env: SWEEP_B/H/L/D shape knobs; SWEEP_BLOCKS comma list (default 128,256,512).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+B = int(os.environ.get("SWEEP_B", 8))
+H = int(os.environ.get("SWEEP_H", 16))
+L = int(os.environ.get("SWEEP_L", 512))
+D = int(os.environ.get("SWEEP_D", 64))
+BLOCKS = [int(x) for x in os.environ.get("SWEEP_BLOCKS", "128,256,512").split(",")]
+ITERS = int(os.environ.get("SWEEP_ITERS", 20))
+
+
+def main():
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        from flexflow_tpu.runtime.platform import force_platform
+
+        force_platform(platform)
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.flash_attention import flash_attention
+
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+
+    def timeit(f):
+        g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2),
+                             argnums=(0, 1, 2)))
+        r = g(q, k, v)
+        float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))  # warm + sync
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            r = g(q, k, v)
+        float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))
+        return (time.perf_counter() - t0) / ITERS * 1e3
+
+    results = {}
+    for bq, bk in itertools.product(BLOCKS, BLOCKS):
+        if bq > L or bk > L:
+            continue
+
+        def fa(q, k, v, bq=bq, bk=bk):
+            return flash_attention(q, k, v, block_q=bq, block_k=bk,
+                                   interpret=interpret)
+
+        try:
+            results[f"flash_{bq}x{bk}"] = round(timeit(fa), 3)
+        except Exception as e:  # a tiling the backend rejects: record, move on
+            results[f"flash_{bq}x{bk}"] = f"error: {type(e).__name__}"
+        print(f"flash {bq}x{bk}: {results[f'flash_{bq}x{bk}']}", file=sys.stderr)
+
+    def einsum_attn(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(D)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+    results["einsum"] = round(timeit(einsum_attn), 3)
+    numeric = {k2: v2 for k2, v2 in results.items() if isinstance(v2, float)}
+    print(json.dumps({
+        "shape": {"B": B, "H": H, "L": L, "D": D},
+        "fwd_bwd_ms": results,
+        "best": min(numeric, key=numeric.get) if numeric else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Sequence parallelism as a Unity SEARCH axis (--enable-sequence-parallel,
+NEW vs the reference which has no SP at all): the search may shard the
+position dim over a 'seq' mesh axis, priced by the ring-attention K/V
+rotation cost, and the chosen strategy executes on the mesh."""
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.search.machine_model import make_machine_model
+from flexflow_tpu.search.unity import unity_optimize
+
+
+def build_transformer(batch=2, seq=32, hidden=32, heads=4, sp_flag=True):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.search_budget = 8
+    config.enable_sequence_parallel = sp_flag
+    config.use_native_search = False
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    t = model.embedding(tokens, 100, hidden, ff.AggrMode.AGGR_MODE_NONE,
+                        name="emb")
+    attn = model.multihead_attention(t, t, t, hidden, heads, name="attn")
+    t = model.layer_norm(model.add(t, attn), [-1], name="ln1")
+    h = model.dense(t, hidden * 4, ff.ActiMode.AC_MODE_GELU, name="ff1")
+    h = model.dense(h, hidden, name="ff2")
+    t = model.layer_norm(model.add(t, h), [-1], name="ln2")
+    model.softmax(model.dense(t, 4, name="cls"))
+    return model, config
+
+
+def test_search_considers_sp_factorizations():
+    """With batch 2 on 8 devices, dp tops out at 2 — the sp factorizations
+    are enumerated and costed alongside dp/tp."""
+    model, config = build_transformer()
+    machine = make_machine_model(config, 8)
+    res = unity_optimize(Graph(model.ops), config, machine, 2, 8)
+    assert any("sp=4" in l or "sp=2" in l or "sp=8" in l for l in res.log), \
+        res.log
+
+
+def test_sp_wins_at_long_sequence():
+    """At long sequence the attention core dominates and sequence sharding
+    divides it across chips: the simulator must prefer dp x sp over the
+    dp-only strategy that leaves the seq axis idle."""
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.simulator import OpStrategy, Simulator
+
+    model, config = build_transformer(batch=2, seq=8192, hidden=64, heads=4)
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), config)
+    dp_only = {op.guid: OpStrategy(dp=2) for op in model.ops}
+    dp_sp = {op.guid: OpStrategy(dp=2, sp=4) for op in model.ops}
+    assert sim.simulate(graph, dp_sp) < sim.simulate(graph, dp_only)
+
+
+def test_sp_disabled_without_flag():
+    model, config = build_transformer(sp_flag=False)
+    machine = make_machine_model(config, 8)
+    res = unity_optimize(Graph(model.ops), config, machine, 2, 8)
+    assert "seq" not in res.mesh_axes, res.mesh_axes
+    assert not any("sp=2" in l or "sp=4" in l for l in res.log
+                   if "sp=1" not in l), res.log
+
+
+def test_searched_sp_strategy_trains():
+    """compile() with the SP search enabled executes the chosen strategy
+    (seq-sharded activations + ring attention) on the 8-device mesh."""
+    model, config = build_transformer()
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    x = np.random.RandomState(0).randint(0, 100, size=(2, 32)).astype(np.int32)
+    y = np.zeros((2, 32, 1), dtype=np.int32)
+    hist = model.fit([x], y, batch_size=2, epochs=2)
+    assert np.isfinite(hist[-1]["loss"])
+    assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-3
+
+
+def test_sp_memory_shards_activations():
+    """The memory model sees sequence sharding: per-chip activation bytes
+    fall with sp, steering the lambda memory search toward SP for long
+    sequences."""
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.simulator import OpStrategy, Simulator
+
+    model, config = build_transformer()
+    graph = Graph(model.ops)
+    sim = Simulator(TpuPodModel(8), config)
+    s1 = {op.guid: OpStrategy(dp=2, sp=1) for op in model.ops}
+    s4 = {op.guid: OpStrategy(dp=2, sp=4) for op in model.ops}
+    assert sim.memory_bytes(graph, s4) < sim.memory_bytes(graph, s1)
