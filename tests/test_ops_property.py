@@ -1,0 +1,157 @@
+"""Property-based per-op tests (hypothesis): algebraic invariants that must
+hold for ANY shape/seed, complementing the fixed-case align-vs-torch tests
+(reference analog: tests/ops/ per-op numerical harness, SURVEY §4).
+
+All properties run the REAL op lowerings through a jitted forward on the CPU
+backend with mixed precision off (exact f32).
+"""
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import flexflow_tpu as ff
+
+SETTINGS = dict(max_examples=20, deadline=None)
+
+
+def run_ops(build, *inputs):
+    """Build a model with `build(model, tensors)` and run forward on inputs."""
+    config = ff.FFConfig()
+    config.batch_size = inputs[0].shape[0]
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    tensors = [
+        model.create_tensor(list(x.shape),
+                            ff.DataType.DT_INT32 if x.dtype == np.int32
+                            else ff.DataType.DT_FLOAT)
+        for x in inputs
+    ]
+    build(model, tensors)
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    return model.predict(list(inputs) if len(inputs) > 1 else inputs[0])
+
+
+@st.composite
+def small_tensor(draw, min_dims=2, max_dims=4):
+    ndim = draw(st.integers(min_dims, max_dims))
+    shape = tuple(draw(st.integers(1, 6)) for _ in range(ndim))
+    data = draw(st.integers(0, 2**31 - 1))
+    return np.random.RandomState(data % 2**31).randn(*shape).astype(np.float32)
+
+
+@given(x=small_tensor())
+@settings(**SETTINGS)
+def test_transpose_involution(x):
+    """transpose(transpose(x, p), argsort(p)) == x for a random permutation."""
+    rng = np.random.RandomState(int(abs(x.flat[0]) * 1e6) % 2**31)
+    perm = list(rng.permutation(x.ndim))
+    inv = list(np.argsort(perm))
+
+    def build(m, ts):
+        t = m.transpose(ts[0], perm)
+        m.transpose(t, inv)
+
+    out = run_ops(build, x)
+    np.testing.assert_allclose(out, x, atol=0, rtol=0)
+
+
+@given(x=small_tensor(min_dims=2, max_dims=3),
+       nsplit=st.integers(2, 3))
+@settings(**SETTINGS)
+def test_concat_of_split_is_identity(x, nsplit):
+    """concat(split(x, sizes, axis), axis) == x."""
+    axis = x.ndim - 1
+    total = x.shape[axis]
+    if total < nsplit:
+        return
+    base = total // nsplit
+    sizes = [base] * (nsplit - 1) + [total - base * (nsplit - 1)]
+
+    def build(m, ts):
+        parts = m.split(ts[0], sizes, axis)
+        m.concat(parts, axis)
+
+    out = run_ops(build, x)
+    np.testing.assert_allclose(out, x, atol=0, rtol=0)
+
+
+@given(x=small_tensor(min_dims=3, max_dims=3))
+@settings(**SETTINGS)
+def test_layer_norm_statistics(x):
+    """LayerNorm output has mean ~0 and var ~1 over the normalized axis
+    (affine is identity at init)."""
+    if x.shape[-1] < 2:
+        return
+
+    def build(m, ts):
+        m.layer_norm(ts[0], [-1])
+
+    out = np.asarray(run_ops(build, x), np.float32)
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-4)
+    # biased variance, eps=1e-5 skews tiny-variance rows: loose bound
+    row_var = out.var(-1)
+    assert np.all(row_var < 1.05), row_var.max()
+
+
+@given(x=small_tensor(min_dims=2, max_dims=4))
+@settings(**SETTINGS)
+def test_softmax_rows_sum_to_one(x):
+    def build(m, ts):
+        m.softmax(ts[0])
+
+    out = np.asarray(run_ops(build, x), np.float32)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+    assert np.all(out >= 0)
+
+
+@given(x=small_tensor(min_dims=2, max_dims=4))
+@settings(**SETTINGS)
+def test_relu_exp_pointwise(x):
+    """Elementwise lowerings match numpy exactly in f32."""
+
+    def build(m, ts):
+        m.exp(m.relu(ts[0]))
+
+    out = run_ops(build, x)
+    np.testing.assert_allclose(out, np.exp(np.maximum(x, 0.0)), rtol=1e-6)
+
+
+@given(b=st.integers(1, 4), cin=st.integers(1, 4), cout=st.integers(1, 4),
+       hw=st.integers(3, 8), k=st.integers(1, 3), stride=st.integers(1, 2),
+       pad=st.integers(0, 1))
+@settings(**SETTINGS)
+def test_conv2d_output_shape_formula(b, cin, cout, hw, k, stride, pad):
+    """Output spatial size matches the reference formula
+    (h + 2p - k)//s + 1 for every legal config (conv_2d.cc shape rule)."""
+    if hw + 2 * pad < k:
+        return
+    x = np.random.RandomState(0).randn(b, cin, hw, hw).astype(np.float32)
+
+    def build(m, ts):
+        m.conv2d(ts[0], cout, k, k, stride, stride, pad, pad)
+
+    out = np.asarray(run_ops(build, x))
+    expect = (hw + 2 * pad - k) // stride + 1
+    assert out.shape == (b, cout, expect, expect), out.shape
+
+
+@given(x=small_tensor(min_dims=2, max_dims=2), w=st.integers(1, 8))
+@settings(**SETTINGS)
+def test_dense_linearity(x, w):
+    """dense(a*x) == a*dense(x) for bias-free linear (homogeneity)."""
+
+    def build(m, ts):
+        m.dense(ts[0], w, use_bias=False)
+
+    config = ff.FFConfig()
+    config.batch_size = x.shape[0]
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    t = model.create_tensor(list(x.shape), ff.DataType.DT_FLOAT)
+    build(model, [t])
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    y1 = np.asarray(model.predict(x), np.float32)
+    y2 = np.asarray(model.predict(2.0 * x), np.float32)
+    np.testing.assert_allclose(y2, 2.0 * y1, rtol=1e-5, atol=1e-5)
