@@ -5,3 +5,9 @@ import sys
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+# Honor an explicit JAX_PLATFORMS=cpu (the TPU site hook otherwise
+# overrides the env var), with the tests' 8-device virtual CPU mesh.
+from flexflow_tpu.runtime.platform import honor_env_platform
+
+honor_env_platform()

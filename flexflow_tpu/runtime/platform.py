@@ -5,8 +5,9 @@ hook, so setting JAX_PLATFORMS in os.environ alone is ignored once jax is
 imported — the platform must also be forced through jax.config, which takes
 effect any time before the first backend client is created.
 
-Used by tests/conftest.py, __graft_entry__.py, and bench.py (the three
-places that must steer backend choice).
+Used by tests/conftest.py, __graft_entry__.py, bench.py, the perf scripts,
+and the example bootstraps (via honor_env_platform) — everywhere backend
+choice must be steered.
 """
 from __future__ import annotations
 
@@ -42,3 +43,14 @@ def force_platform(name: str, n_host_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", name)
+
+
+def honor_env_platform(n_host_devices: int = 8) -> None:
+    """Honor an explicit non-TPU JAX_PLATFORMS env var (the TPU site hook
+    otherwise overrides it). CPU gets the same virtual device count the
+    tests use, so mesh examples exercise real sharding. No-op when the env
+    var is unset or requests the TPU."""
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and "axon" not in plat and "tpu" not in plat:
+        force_platform(plat, n_host_devices=n_host_devices
+                       if "cpu" in plat else None)
