@@ -60,8 +60,22 @@ def _load():
         lib.ffc_run.restype = ctypes.c_void_p
         lib.ffc_free.argtypes = [ctypes.c_void_p]
         lib.ffc_version.restype = ctypes.c_char_p
+        # native batch loader (dataloader.cc)
+        lib.ffdl_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.ffdl_create.restype = ctypes.c_void_p
+        lib.ffdl_next.argtypes = [ctypes.c_void_p]
+        lib.ffdl_next.restype = ctypes.c_void_p
+        lib.ffdl_epoch.argtypes = [ctypes.c_void_p]
+        lib.ffdl_epoch.restype = ctypes.c_int64
+        lib.ffdl_reset.argtypes = [ctypes.c_void_p]
+        lib.ffdl_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale .so predating newer symbols, with no
+        # toolchain to rebuild — fall back to the pure-Python paths
         _load_error = str(e)
         _lib = None
     return _lib
@@ -207,3 +221,70 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
     if mesh_tp > 1 and any(s.tp > 1 for s in strategies.values()):
         axes["model"] = mesh_tp
     return SearchResult(strategies, axes, cost, mem, log)
+
+
+# ------------------------------------------------------------- batch loader
+class BatchStream:
+    """Native prefetching batch stream over a host numpy array
+    (src/ffcore/dataloader.cc; reference: src/dataloader/dataloader.cc's
+    staged zero-copy dataset + per-batch copy tasks). A C++ producer thread
+    gathers (optionally shuffled) sample rows into a ring of contiguous
+    batch buffers ahead of the consumer.
+
+    The array returned by next_batch() is a view of a ring slot — valid
+    until the FOLLOWING next_batch() call (device_put/jnp.asarray copies it
+    immediately in normal use).
+    """
+
+    def __init__(self, data, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, prefetch_depth: int = 3):
+        import numpy as np
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"libffcore unavailable: {_load_error}")
+        self._lib = lib
+        self.data = np.ascontiguousarray(data)  # keeps the source alive
+        self.batch_size = int(batch_size)
+        n = self.data.shape[0]
+        sample_bytes = int(self.data.nbytes // max(n, 1))
+        self._sample_shape = self.data.shape[1:]
+        self._dtype = self.data.dtype
+        self._h = lib.ffdl_create(
+            self.data.ctypes.data_as(ctypes.c_void_p),
+            n, sample_bytes, self.batch_size,
+            1 if shuffle else 0, seed, int(prefetch_depth),
+        )
+        if not self._h:
+            raise ValueError(
+                f"ffdl_create rejected n={n} batch={batch_size} "
+                f"depth={prefetch_depth}")
+        self.num_batches = n // self.batch_size
+
+    def next_batch(self):
+        import numpy as np
+
+        ptr = self._lib.ffdl_next(self._h)
+        buf = (ctypes.c_char * (self.batch_size
+                                * int(np.prod(self._sample_shape, dtype=int))
+                                * self._dtype.itemsize)).from_address(ptr)
+        return np.frombuffer(buf, dtype=self._dtype).reshape(
+            (self.batch_size,) + self._sample_shape)
+
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.ffdl_epoch(self._h))
+
+    def reset(self) -> None:
+        self._lib.ffdl_reset(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ffdl_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort: stop the producer thread
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -184,21 +184,37 @@ class MultiHeadAttentionOp(Op):
                 interpret=jax.default_backend() != "tpu",
             )
         else:
-            logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-            ) * scale
-            if causal:
-                lq, lk = logits.shape[-2], logits.shape[-1]
-                mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
-                logits = jnp.where(mask, logits, -1e30)
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            if dropout_active:
-                keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - rate, probs.shape)
-                probs = jnp.where(keep, probs / (1.0 - rate), 0.0)
-            # scores/softmax stay f32 (stability); the context matmul emits
-            # the compute dtype — the MXU accumulates f32 internally either
-            # way, and a bf16 output halves the HBM write
-            ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cdt), v)
+            drop_key = ctx.next_rng() if dropout_active else None
+
+            def attn_core(q, k, v, drop_key):
+                logits = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32
+                ) * scale
+                if causal:
+                    lq, lk = logits.shape[-2], logits.shape[-1]
+                    mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+                    logits = jnp.where(mask, logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                if drop_key is not None:
+                    keep = jax.random.bernoulli(drop_key, 1.0 - rate,
+                                                probs.shape)
+                    probs = jnp.where(keep, probs / (1.0 - rate), 0.0)
+                # scores/softmax stay f32 (stability); the context matmul
+                # emits the compute dtype — the MXU accumulates f32
+                # internally either way, and a bf16 output halves the HBM
+                # write
+                return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cdt), v)
+
+            if ctx.mode == CompMode.COMP_MODE_TRAINING:
+                # rematerialize in backward: recomputing logits+softmax
+                # (~1/3 extra attention-core FLOPs) beats saving the f32
+                # L x L probs to HBM — the same trade the flash kernel
+                # makes structurally
+                attn_core = jax.checkpoint(
+                    attn_core,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            ctxv = attn_core(q, k, v, drop_key)
 
         odt = emit_dtype(ctx.config, self.outputs[0].dtype)
         out = jnp.einsum(

@@ -1,0 +1,120 @@
+"""Native prefetching batch loader (src/ffcore/dataloader.cc via
+flexflow_tpu.native.BatchStream) — reference parity for the C++
+SingleDataLoader (src/dataloader/dataloader.cc): batch tiling, per-epoch
+shuffling, reset, and the SingleDataLoader integration."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="libffcore not built")
+
+
+def make_data(n=32, f=5):
+    return (np.arange(n * f, dtype=np.float32).reshape(n, f) + 1.0)
+
+
+def test_sequential_batches_match_slices():
+    data = make_data()
+    bs = 8
+    s = native.BatchStream(data, bs, shuffle=False)
+    try:
+        for epoch in range(3):
+            for i in range(s.num_batches):
+                np.testing.assert_array_equal(
+                    s.next_batch(), data[i * bs:(i + 1) * bs])
+    finally:
+        s.close()
+
+
+def test_shuffled_epoch_is_permutation_and_deterministic():
+    data = make_data(n=24)
+    bs = 6
+    def epoch_rows(stream):
+        rows = []
+        for _ in range(stream.num_batches):
+            rows.extend(stream.next_batch()[:, 0].tolist())
+        return rows
+
+    s1 = native.BatchStream(data, bs, shuffle=True, seed=7)
+    s2 = native.BatchStream(data, bs, shuffle=True, seed=7)
+    s3 = native.BatchStream(data, bs, shuffle=True, seed=8)
+    try:
+        e0 = epoch_rows(s1)
+        assert sorted(e0) == sorted(data[:, 0].tolist())  # a permutation
+        assert e0 != data[:, 0].tolist()  # actually shuffled (n=24: ~certain)
+        assert epoch_rows(s2) == e0  # deterministic per seed
+        assert epoch_rows(s3) != e0  # seed-sensitive
+        e1 = epoch_rows(s1)
+        assert e1 != e0 and sorted(e1) == sorted(e0)  # reshuffles per epoch
+    finally:
+        s1.close(); s2.close(); s3.close()
+
+
+def test_reset_restarts_epoch_zero():
+    data = make_data(n=16)
+    s = native.BatchStream(data, 4, shuffle=True, seed=3)
+    try:
+        first = s.next_batch().copy()
+        s.next_batch()
+        s.reset()
+        np.testing.assert_array_equal(s.next_batch(), first)
+        assert s.epoch == 0
+    finally:
+        s.close()
+
+
+def test_buffer_stable_until_next_call():
+    """The handed-out buffer must not be overwritten by the prefetching
+    producer before the consumer's NEXT call (the ring keeps a one-slot
+    margin), even when the consumer is slow."""
+    import time
+
+    data = make_data(n=64, f=3)
+    s = native.BatchStream(data, 4, shuffle=False, prefetch_depth=3)
+    try:
+        for i in range(s.num_batches):
+            b = s.next_batch()
+            expect = data[i * 4:(i + 1) * 4]
+            np.testing.assert_array_equal(b, expect)
+            if i < 3:
+                time.sleep(0.02)  # let the producer run ahead
+                np.testing.assert_array_equal(b, expect)  # still intact
+    finally:
+        s.close()
+
+
+def test_single_dataloader_native_backend():
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    x = model.create_tensor([8, 5])
+    t = model.dense(x, 4)
+    model.softmax(t)
+    data = make_data(n=32)
+    loader = ff.SingleDataLoader(model, x, data)
+    assert loader.backend == "native"
+    np.testing.assert_array_equal(loader.next_batch(), data[:8])
+    np.testing.assert_array_equal(loader.next_batch(), data[8:16])
+    loader.reset()
+    np.testing.assert_array_equal(loader.next_batch(), data[:8])
+
+
+def test_single_dataloader_numpy_fallback_matches():
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    model = ff.FFModel(config)
+    x = model.create_tensor([8, 5])
+    model.softmax(model.dense(x, 4))
+    data = make_data(n=32)
+    nat = ff.SingleDataLoader(model, x, data, prefetch=True)
+    py = ff.SingleDataLoader(model, x, data, prefetch=False)
+    assert py.backend == "numpy"
+    for _ in range(2 * nat.num_batches):  # across an epoch wrap
+        np.testing.assert_array_equal(nat.next_batch(), py.next_batch())
