@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.op import Op, WeightSpec, register_op
-from ..ffconst import CompMode, DataType, OpType
+from ..ffconst import CompMode, OpType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
 from .common import emit_dtype, matmul_dtype
 

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.op import Op, WeightSpec, register_op
-from ..ffconst import ActiMode, DataType, OpType, PoolType
+from ..ffconst import ActiMode, OpType, PoolType
 from ..runtime.initializers import DefaultInitializer, ZeroInitializer
 from .common import apply_activation, emit_dtype, matmul_dtype
 

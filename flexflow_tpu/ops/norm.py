@@ -10,10 +10,9 @@ from typing import List
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.op import Op, WeightSpec, register_op
-from ..ffconst import CompMode, DataType, OpType
+from ..ffconst import CompMode, OpType
 from ..runtime.initializers import ConstantInitializer, ZeroInitializer
 
 

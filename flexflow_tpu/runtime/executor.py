@@ -11,15 +11,13 @@ collectives the reference got from NCCL/Legion copies.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.graph import Graph
-from ..core.op import LoweringContext, Op
+from ..core.op import LoweringContext
 from ..ffconst import CompMode, OpType
 from ..ops.common import emit_dtype
 from .metrics import Metrics

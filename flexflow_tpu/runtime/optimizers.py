@@ -9,7 +9,7 @@ batch is sharded over the mesh — the update itself is a pure elementwise map
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -28,8 +28,6 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
 _log = logging.getLogger("flexflow_tpu.search")
 
 from ..core.graph import Graph

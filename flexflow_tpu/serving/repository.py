@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 
 _DTYPES = {"float32": "DT_FLOAT", "float": "DT_FLOAT", "int32": "DT_INT32",

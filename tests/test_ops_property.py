@@ -6,7 +6,6 @@ All properties run the REAL op lowerings through a jitted forward on the CPU
 backend with mixed precision off (exact f32).
 """
 import numpy as np
-import pytest
 from hypothesis import assume, given, settings, strategies as st
 
 import flexflow_tpu as ff

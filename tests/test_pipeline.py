@@ -1,7 +1,6 @@
 """GPipe pipeline parallelism over a 'stage' mesh axis (new capability —
 reference OP_PIPELINE is an unused enum, ffconst.h:159)."""
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -2,7 +2,6 @@
 config.h:162-167 threading into batch_matmul.cc:77-90 and attention):
 forward(seq_length=L) computes the first L positions only."""
 import numpy as np
-import pytest
 
 import flexflow_tpu as ff
 

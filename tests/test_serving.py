@@ -2,7 +2,6 @@
 triton/qa/L0_e2e — the only mocked-infra tests in the reference; here the
 real executor runs on the CPU mesh)."""
 import json
-import threading
 import urllib.request
 
 import numpy as np
