@@ -177,7 +177,7 @@ def main():
     # exception-based retry below can never see. A daemon thread re-execs a
     # fresh interpreter (same backoff counter) if the first device
     # computation hasn't completed in time. 0 disables.
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 900))
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 600))
     backend_ready = []
 
     if init_timeout > 0:

@@ -1,0 +1,81 @@
+"""Long-context training with sequence/context parallelism.
+
+Shards a transformer's position dim over a 'seq' mesh axis; attention runs
+the ring kernel (K/V blocks rotating on neighbor ICI links) or the Ulysses
+all-to-all variant (--ulysses). With --search, the Unity search chooses the
+parallelization itself under --enable-sequence-parallel.
+
+Run on the CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python longcontext_sp.py [--ulysses | --search]
+"""
+import sys
+
+import _bootstrap  # noqa: F401
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import ActiMode
+
+from _util import get_config, train_and_report
+
+
+def main():
+    ulysses = "--ulysses" in sys.argv
+    searched = "--search" in sys.argv
+    for flag in ("--ulysses", "--search"):
+        if flag in sys.argv:
+            sys.argv.remove(flag)
+
+    import jax
+
+    n_dev = jax.device_count()
+    sp = min(4, n_dev)
+    batch, seq, hidden, heads = 2, 64 * sp, 64, sp
+
+    config = get_config(batch_size=batch, epochs=2)
+    if searched:
+        config.enable_sequence_parallel = True
+        config.search_budget = max(config.search_budget, 8)
+        config.use_native_search = False
+
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    t = model.embedding(tokens, 1000, hidden, ff.AggrMode.AGGR_MODE_NONE,
+                        name="emb")
+    for i in range(2):
+        attn = model.multihead_attention(
+            t, t, t, hidden, heads,
+            sequence_parallel=not searched,
+            sequence_parallel_mode="ulysses" if ulysses else "ring",
+            name=f"l{i}_attn")
+        t = model.layer_norm(model.add(t, attn), [-1], name=f"l{i}_ln1")
+        h = model.dense(t, hidden * 4, ActiMode.AC_MODE_GELU, name=f"l{i}_ff1")
+        t = model.layer_norm(model.add(t, model.dense(h, hidden,
+                                                      name=f"l{i}_ff2")),
+                             [-1], name=f"l{i}_ln2")
+    model.softmax(model.dense(t, 4, name="cls"))
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 1000, size=(batch, seq)).astype(np.int32)
+    y = (x[..., None] % 4).astype(np.int32)
+
+    kwargs = {} if searched else {"parallel_axes": {"seq": sp}}
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+        **kwargs,
+    )
+    mode = ("searched" if searched
+            else "ulysses" if ulysses else "ring")
+    print(f"[longcontext_sp] mode={mode} seq={seq} devices={n_dev} "
+          f"axes={model.search_result.mesh_axes if searched else {'seq': sp}}")
+    hist = model.fit([x], y, batch_size=batch, epochs=config.epochs)
+    print(f"[longcontext_sp] loss {hist[0]['loss']:.4f} -> "
+          f"{hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

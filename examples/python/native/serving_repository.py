@@ -1,0 +1,65 @@
+"""Serving model repository + metrics walk-through (reference role: the
+Triton prototype's model-repository UX). Builds a model-spec repository on
+disk, loads it onto an InferenceServer, serves over HTTP, and reads the
+Prometheus metrics endpoint."""
+import json
+import os
+import tempfile
+import urllib.request
+
+import _bootstrap  # noqa: F401
+
+import numpy as np
+
+from flexflow_tpu.serving import InferenceServer, ModelRepository
+
+
+def main():
+    repo_dir = tempfile.mkdtemp(prefix="ff_repo_")
+    mdir = os.path.join(repo_dir, "mlp")
+    os.makedirs(mdir)
+    spec = {
+        "format": "flexflow_tpu_c_model",
+        "config": {"batch_size": 8},
+        "ops": [
+            {"type": "input", "name": "x", "dims": [8, 16],
+             "dtype": "float32", "inputs": [], "outputs": [1]},
+            {"type": "dense", "name": "fc1", "inputs": [1], "outputs": [2],
+             "params": {"out_dim": 32, "activation": "relu"}},
+            {"type": "dense", "name": "fc2", "inputs": [2], "outputs": [3],
+             "params": {"out_dim": 4}},
+            {"type": "softmax", "name": "sm", "inputs": [3], "outputs": [4],
+             "params": {}},
+        ],
+    }
+    with open(os.path.join(mdir, "model_spec.json"), "w") as f:
+        json.dump(spec, f)
+    with open(os.path.join(mdir, "config.json"), "w") as f:
+        json.dump({"format": "ff_cspec", "file": "model_spec.json",
+                   "max_batch_size": 8}, f)
+
+    server = InferenceServer()
+    repo = ModelRepository(repo_dir)
+    print("repository models:", repo.model_names())
+    print("loaded:", repo.load(server))
+
+    httpd = server.serve_http(port=0)  # ephemeral port
+    port = httpd.server_address[1]
+    x = np.random.RandomState(0).randn(3, 16).astype(np.float32)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/models/mlp/infer",
+        data=json.dumps({"inputs": {"x": x.tolist()}}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    print("http infer output shape:",
+          np.asarray(out["outputs"]).shape)
+    metrics = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    print("metrics:\n" + metrics.strip())
+    httpd.shutdown()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()
