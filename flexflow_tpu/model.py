@@ -15,7 +15,7 @@ import numpy as np
 
 from .config import FFConfig
 from .core.graph import Graph
-from .core.machine import MachineView, data_parallel_view, make_mesh
+from .core.machine import MachineView, make_mesh
 from .core.op import OP_REGISTRY, Op
 from .core.tensor import ParallelDim, ParallelTensorShape, Tensor
 from .ffconst import (
@@ -30,7 +30,7 @@ from .ffconst import (
     PoolType,
 )
 from .runtime.executor import Executor
-from .runtime.losses import Loss, loss_fn_for
+from .runtime.losses import Loss
 from .runtime.metrics import Metrics, PerfMetrics
 from .runtime.optimizers import Optimizer, SGDOptimizer
 

@@ -1,8 +1,6 @@
 """PCG source/sink ops: Input, Weight, NoOp (reference: src/ops/noop.cc)."""
 from __future__ import annotations
 
-from typing import List, Tuple
-
 from ..core.op import Op, register_op
 from ..ffconst import DataType, OpType
 

@@ -14,11 +14,8 @@ formulation expert-parallel all_to_all dispatch wants.
 from __future__ import annotations
 
 import math
-from typing import List, Tuple
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.op import Op, register_op
 from ..ffconst import DataType, OpType

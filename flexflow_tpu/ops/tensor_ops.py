@@ -5,8 +5,6 @@ topk,batch_matmul}.cc with CUDA kernels; all are direct jax/lax primitives here.
 """
 from __future__ import annotations
 
-from typing import List
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -24,8 +24,8 @@ outside our modeled set are parsed but reported unsupported.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 from ..ffconst import OpType
 

@@ -10,16 +10,13 @@ import flexflow_tpu as ff
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.ffconst import ActiMode, OpType
 from flexflow_tpu.search.machine_model import (
-    CHIP_SPECS,
     NetworkedMachineModel,
-    SimpleMachineModel,
     TpuPodModel,
 )
 from flexflow_tpu.search.mcmc import mcmc_optimize
-from flexflow_tpu.search.simulator import CostModel, OpStrategy, Simulator
+from flexflow_tpu.search.simulator import OpStrategy, Simulator
 from flexflow_tpu.search.substitution import apply_substitutions
 from flexflow_tpu.search.unity import (
-    GraphSearchHelper,
     export_strategy,
     import_strategy,
     unity_optimize,
@@ -345,8 +342,7 @@ def test_event_driven_sim_overlaps_collectives():
     """The two-stream schedule hides grad-sync allreduces under the
     remaining backward when overlap is on; serializing them must cost more
     (replaces the old sequential-sum + 0.8 fudge)."""
-    import dataclasses
-
+    
     from flexflow_tpu.search.machine_model import TpuPodModel
 
     model = build_mlp(batch=64, din=512, hidden=2048, classes=10)
