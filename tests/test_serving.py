@@ -214,3 +214,61 @@ def test_model_repository_cspec_format(tmp_path):
         assert server.stats("cmodel")["requests"] == 1
     finally:
         server.shutdown()
+
+
+def test_model_repository_checkpoint_restore(tmp_path):
+    """The repository's `checkpoint` field: a trained model's weights are
+    restored into the repo-built model, and serving returns the TRAINED
+    predictions (the full train -> checkpoint -> serve user flow)."""
+    from flexflow_tpu.runtime.checkpoint import save_checkpoint
+    from flexflow_tpu.serving import ModelRepository
+
+    spec = {
+        "format": "flexflow_tpu_c_model",
+        "config": {"batch_size": 8},
+        "ops": [
+            {"type": "input", "name": "x", "dims": [8, 6],
+             "dtype": "float32", "inputs": [], "outputs": [1]},
+            {"type": "dense", "name": "fc1", "inputs": [1], "outputs": [2],
+             "params": {"out_dim": 12, "activation": "relu"}},
+            {"type": "dense", "name": "fc2", "inputs": [2], "outputs": [3],
+             "params": {"out_dim": 3}},
+            {"type": "softmax", "name": "sm", "inputs": [3], "outputs": [4],
+             "params": {}},
+        ],
+    }
+
+    # train a model built from the SAME spec (same op names -> checkpoint
+    # keys line up)
+    from flexflow_tpu.native.c_model import model_from_spec
+
+    trained = model_from_spec(json.dumps(spec))
+    trained.config.allow_mixed_precision = False
+    trained.compile(
+        optimizer=ff.SGDOptimizer(trained, lr=0.1),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[])
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    Y = rng.randint(0, 3, size=(64, 1)).astype(np.int32)
+    trained.fit(x=X, y=Y, epochs=3, verbose=False)
+    expected = np.asarray(trained.predict(X[:8]), np.float32)
+
+    mdir = tmp_path / "cmodel"
+    mdir.mkdir()
+    (mdir / "model_spec.json").write_text(json.dumps(spec))
+    save_checkpoint(str(mdir / "weights"), trained)
+    (mdir / "config.json").write_text(json.dumps({
+        "format": "ff_cspec", "file": "model_spec.json",
+        "checkpoint": "weights.npz", "max_batch_size": 8,
+    }))
+
+    repo = ModelRepository(str(tmp_path))
+    server = InferenceServer()
+    try:
+        repo.load(server)
+        out = np.asarray(server.infer(
+            "cmodel", {"x": X[:8]}, timeout=30.0), np.float32)
+        np.testing.assert_allclose(out, expected, atol=2e-2, rtol=2e-2)
+    finally:
+        server.shutdown()
