@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.graph import Graph
 from ..core.op import LoweringContext
@@ -195,7 +196,13 @@ class Executor:
         return jax.jit(grad_step)
 
     def shard_batch(self, arr, batch_axis: int = 0):
-        """Place a host batch on the mesh, sharded over the data axis."""
+        """Place a host batch on the mesh, sharded over the data axis.
+
+        Multi-host (jax.process_count() > 1): every process passes the SAME
+        global batch; each host materializes only its addressable shards
+        (device_put cannot address remote devices, so the array is assembled
+        per-device via make_array_from_callback — the launch contract in
+        MULTI-NODE.md)."""
         if self.mesh is None or "data" not in self.mesh.axis_names:
             return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec
@@ -205,6 +212,9 @@ class Executor:
         # short final eval batch) instead of failing the device_put
         if arr.shape[batch_axis] % self.mesh.shape["data"] == 0:
             spec[batch_axis] = "data"
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, PartitionSpec(*spec))
-        )
+        sharding = NamedSharding(self.mesh, PartitionSpec(*spec))
+        if jax.process_count() > 1:
+            arr = np.asarray(arr)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+        return jax.device_put(arr, sharding)
