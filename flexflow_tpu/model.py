@@ -896,6 +896,11 @@ class FFModel:
         verbose: bool = False,
     ) -> List[Dict[str, float]]:
         assert self._compiled, "call compile() first"
+        if getattr(self, "_inference_only", None):
+            raise RuntimeError(
+                f"model was optimized for inference "
+                f"({self._inference_only}); training is no longer valid — "
+                "rebuild and compile a fresh model to train")
         if x is None:
             x, y = self._dataloader_arrays()
         if isinstance(x, np.ndarray):
@@ -1015,6 +1020,11 @@ class FFModel:
     def backward(self, seq_length: Optional[int] = None):
         import jax.numpy as jnp
 
+        if getattr(self, "_inference_only", None):
+            raise RuntimeError(
+                f"model was optimized for inference "
+                f"({self._inference_only}); training is no longer valid — "
+                "rebuild and compile a fresh model to train")
         label = jnp.asarray(self._manual["label"])
         rng = self._manual.get("rng")
         if rng is None:

@@ -17,6 +17,7 @@ from .model import InferenceModel
 from .batcher import DynamicBatcher
 from .server import InferenceServer, ModelMetrics
 from .repository import ModelRepository
+from .optimize import fold_batchnorm
 
 __all__ = ["InferenceModel", "DynamicBatcher", "InferenceServer",
-           "ModelMetrics", "ModelRepository"]
+           "ModelMetrics", "ModelRepository", "fold_batchnorm"]

@@ -1,0 +1,104 @@
+"""Inference-graph optimizations applied at serving time.
+
+reference parity: deployment-grade inference stacks (the role of the
+reference's Triton prototype) fold batchnorm into the preceding conv for
+serving; training keeps BN live. fold_batchnorm() rewrites BOTH the graph
+(BN dropped, consumers rewired) and the parameters (conv kernel/bias scaled
+with the BN's eval-mode statistics):
+
+    y = gamma * (conv(x) - mean) / sqrt(var + eps) + beta
+      = conv'(x)   with  k' = k * s,  b' = (b - mean) * s + beta,
+                         s = gamma / sqrt(var + eps)   (per out-channel)
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..ffconst import OpType
+
+
+def fold_batchnorm(model) -> List[str]:
+    """Fold eval-mode BatchNorm into the preceding Conv2D. Call on a
+    COMPILED model before serving; rebuilds the executor. Returns the names
+    of the folded BN ops. BNs whose conv has other consumers, or that
+    follow a non-conv, are left alone. A BN with relu=True transfers its
+    relu to the conv's activation."""
+    from ..core.graph import Graph
+    from ..ffconst import ActiMode
+
+    graph = model.graph
+    folded: List[str] = []
+    for bn in list(graph.ops.values()):
+        if bn.op_type != OpType.BATCHNORM:
+            continue
+        conv = bn.inputs[0].owner_op
+        if (conv is None or conv.op_type != OpType.CONV2D
+                or conv.guid not in graph.ops):
+            continue
+        # the conv must feed ONLY this BN (its output disappears)
+        consumers = [
+            o for o in graph.ops.values()
+            if any(t.guid == conv.outputs[0].guid for t in o.inputs)
+        ]
+        if consumers != [bn]:
+            continue
+        if conv.params.get("activation",
+                           ActiMode.AC_MODE_NONE) != ActiMode.AC_MODE_NONE:
+            continue  # activation between conv and BN: not foldable
+
+        cp = model.params[conv.name]
+        bp = model.params.get(bn.name, {})
+        st = model.state.get(bn.name, {})
+        eps = bn.params.get("eps", 1e-5)
+        gamma = np.asarray(bp.get("gamma"), np.float32)
+        beta = np.asarray(bp.get("beta"), np.float32)
+        mean = np.asarray(st.get("running_mean"), np.float32)
+        var = np.asarray(st.get("running_var"), np.float32)
+        scale = gamma / np.sqrt(var + eps)  # (C_out,)
+
+        kernel = np.asarray(cp["kernel"], np.float32)  # OIHW
+        new_kernel = kernel * scale[:, None, None, None]
+        bias = np.asarray(cp.get("bias", np.zeros(kernel.shape[0])), np.float32)
+        new_bias = (bias - mean) * scale + beta
+
+        import jax.numpy as jnp
+
+        kdt = cp["kernel"].dtype
+        cp["kernel"] = jnp.asarray(new_kernel).astype(kdt)
+        cp["bias"] = jnp.asarray(new_bias).astype(kdt)
+        conv.params["use_bias"] = True
+        if bn.params.get("relu", False):
+            conv.params["activation"] = ActiMode.AC_MODE_RELU
+
+        # rewire BN consumers onto the conv output and drop the BN
+        for o in graph.ops.values():
+            for i, t in enumerate(o.inputs):
+                if t.guid == bn.outputs[0].guid:
+                    o.inputs[i] = conv.outputs[0]
+        graph.tensor_aliases[bn.outputs[0].guid] = conv.outputs[0]
+        if model.final_tensor is not None \
+                and model.final_tensor.guid == bn.outputs[0].guid:
+            model.final_tensor = conv.outputs[0]
+        graph.remove_op(bn)
+        model.ops = [op for op in model.ops if op.guid != bn.guid]
+        model.params.pop(bn.name, None)
+        model.state.pop(bn.name, None)
+        folded.append(bn.name)
+
+    if folded:
+        # rebuild every inference-mode path over the folded graph (predict,
+        # eval, and the manual forward); training steps are invalidated —
+        # training on a folded model is nonsense (BN semantics baked in),
+        # and fit()/backward() refuse via the flag
+        from ..runtime.executor import Executor
+
+        model.executor = Executor(graph, model.config, model.mesh)
+        model._build_step_functions()  # all paths rebuilt over the new graph
+        if getattr(model, "_manual", None):
+            model._manual.pop("seq_fns", None)
+        # then disarm the training paths: fit()/backward() refuse via the flag
+        model._train_step = model._grad_step = None
+        model._inference_only = "fold_batchnorm"
+    return folded

@@ -272,3 +272,52 @@ def test_model_repository_checkpoint_restore(tmp_path):
         np.testing.assert_allclose(out, expected, atol=2e-2, rtol=2e-2)
     finally:
         server.shutdown()
+
+
+def test_fold_batchnorm_preserves_inference():
+    """Serving-time conv+BN folding: after a few training steps (non-trivial
+    running stats), the folded graph's eval-mode predictions match the
+    unfolded model."""
+    from flexflow_tpu.serving.optimize import fold_batchnorm
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.allow_mixed_precision = False
+    model = ff.FFModel(config)
+    x = model.create_tensor([8, 3, 8, 8])
+    t = model.conv2d(x, 6, 3, 3, 1, 1, 1, 1, name="conv")
+    t = model.batch_norm(t, relu=True, name="bn")
+    t = model.flat(t)
+    model.softmax(model.dense(t, 4, name="cls"))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.05),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 3, 8, 8).astype(np.float32)
+    Y = rng.randint(0, 4, size=(32, 1)).astype(np.int32)
+    model.fit(x=X, y=Y, epochs=2, verbose=False)
+
+    before = np.asarray(model.predict(X[:8]), np.float32)
+    folded = fold_batchnorm(model)
+    assert folded == ["bn"], folded
+    assert all(op.name != "bn" for op in model.ops)
+    after = np.asarray(model.predict(X[:8]), np.float32)
+    np.testing.assert_allclose(after, before, atol=1e-5, rtol=1e-4)
+    # eval works post-fold; training refuses with a clear error
+    m = model.eval(x=X[:8], y=Y[:8])
+    assert np.isfinite(m["loss"])
+    with pytest.raises(RuntimeError, match="optimized for inference"):
+        model.fit(x=X, y=Y, epochs=1)
+
+    # the folded model serves
+    server = InferenceServer()
+    try:
+        server.register("folded", model, max_batch_size=8,
+                        batch_buckets=[8])
+        out = np.asarray(server.infer("folded", {
+            model.input_ops[0].name: X[:8]}, timeout=30.0), np.float32)
+        np.testing.assert_allclose(out, before, atol=1e-5, rtol=1e-4)
+    finally:
+        server.shutdown()
