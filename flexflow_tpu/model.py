@@ -887,6 +887,13 @@ class FFModel:
             )
         return out
 
+    def _assert_trainable(self) -> None:
+        if getattr(self, "_inference_only", None):
+            raise RuntimeError(
+                f"model was optimized for inference "
+                f"({self._inference_only}); training is no longer valid — "
+                "rebuild and compile a fresh model to train")
+
     def fit(
         self,
         x: Union[np.ndarray, Sequence[np.ndarray], None] = None,
@@ -896,11 +903,7 @@ class FFModel:
         verbose: bool = False,
     ) -> List[Dict[str, float]]:
         assert self._compiled, "call compile() first"
-        if getattr(self, "_inference_only", None):
-            raise RuntimeError(
-                f"model was optimized for inference "
-                f"({self._inference_only}); training is no longer valid — "
-                "rebuild and compile a fresh model to train")
+        self._assert_trainable()
         if x is None:
             x, y = self._dataloader_arrays()
         if isinstance(x, np.ndarray):
@@ -1020,11 +1023,7 @@ class FFModel:
     def backward(self, seq_length: Optional[int] = None):
         import jax.numpy as jnp
 
-        if getattr(self, "_inference_only", None):
-            raise RuntimeError(
-                f"model was optimized for inference "
-                f"({self._inference_only}); training is no longer valid — "
-                "rebuild and compile a fresh model to train")
+        self._assert_trainable()
         label = jnp.asarray(self._manual["label"])
         rng = self._manual.get("rng")
         if rng is None:

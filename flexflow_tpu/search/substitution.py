@@ -234,12 +234,83 @@ def rule_merge_parallel_linears(graph: Graph) -> List[Application]:
     return apps
 
 
+def rule_cancel_split_concat(graph: Graph) -> List[Application]:
+    """concat(split(x, sizes, axis), axis) in original order ==> x
+    (the reference's combine/partition cancellation family)."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type != OpType.CONCAT:
+            continue
+        srcs = [t.owner_op for t in op.inputs]
+        if not srcs or any(s is None or s.op_type != OpType.SPLIT
+                           or s.guid not in graph.ops for s in srcs):
+            continue
+        split = srcs[0]
+        if any(s is not split for s in srcs):
+            continue
+        if op.params.get("axis") != split.params.get("axis"):
+            continue
+        # every split output consumed exactly once, in order, by this concat
+        if [t.guid for t in op.inputs] != [t.guid for t in split.outputs]:
+            continue
+        if any(c is not op for c in _consumers(graph, split)):
+            continue
+
+        def apply(op=op, split=split):
+            _rewire(graph, op.outputs[0], split.inputs[0])
+            graph.remove_op(op)
+            graph.remove_op(split)
+
+        apps.append(Application("cancel_split_concat", apply,
+                                f"{split.name}->{op.name}"))
+    return apps
+
+
+def rule_drop_zero_dropout(graph: Graph) -> List[Application]:
+    """dropout(x, rate=0) ==> x (a no-op in both train and eval)."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type != OpType.DROPOUT or op.params.get("rate", 0.5) > 0.0:
+            continue
+        if op.inputs[0].owner_op is None:
+            continue
+
+        def apply(op=op):
+            _rewire(graph, op.outputs[0], op.inputs[0])
+            graph.remove_op(op)
+
+        apps.append(Application("drop_zero_dropout", apply, op.name))
+    return apps
+
+
+def rule_drop_noop_cast(graph: Graph) -> List[Application]:
+    """cast(x, dtype_of_x) ==> x."""
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type != OpType.CAST:
+            continue
+        if op.params.get("dtype") != op.inputs[0].dtype:
+            continue
+        if op.inputs[0].owner_op is None:
+            continue
+
+        def apply(op=op):
+            _rewire(graph, op.outputs[0], op.inputs[0])
+            graph.remove_op(op)
+
+        apps.append(Application("drop_noop_cast", apply, op.name))
+    return apps
+
+
 ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "fuse_linear_activation": rule_fuse_linear_activation,
     "merge_adjacent_reshape": rule_merge_adjacent_reshape,
     "cancel_transpose_pair": rule_cancel_transpose_pair,
     "merge_scalar_chain": rule_merge_scalar_chain,
     "drop_identity": rule_drop_identity,
+    "cancel_split_concat": rule_cancel_split_concat,
+    "drop_zero_dropout": rule_drop_zero_dropout,
+    "drop_noop_cast": rule_drop_noop_cast,
 }
 
 # no 'dtype': model.conv2d takes none (unlike dense), so it would never

@@ -25,9 +25,10 @@ def fold_batchnorm(model) -> List[str]:
     of the folded BN ops. BNs whose conv has other consumers, or that
     follow a non-conv, are left alone. A BN with relu=True transfers its
     relu to the conv's activation."""
-    from ..core.graph import Graph
     from ..ffconst import ActiMode
+    from ..search.substitution import _rewire
 
+    assert getattr(model, "_compiled", False), "call compile() first"
     graph = model.graph
     folded: List[str] = []
     for bn in list(graph.ops.values()):
@@ -73,11 +74,7 @@ def fold_batchnorm(model) -> List[str]:
             conv.params["activation"] = ActiMode.AC_MODE_RELU
 
         # rewire BN consumers onto the conv output and drop the BN
-        for o in graph.ops.values():
-            for i, t in enumerate(o.inputs):
-                if t.guid == bn.outputs[0].guid:
-                    o.inputs[i] = conv.outputs[0]
-        graph.tensor_aliases[bn.outputs[0].guid] = conv.outputs[0]
+        _rewire(graph, bn.outputs[0], conv.outputs[0])
         if model.final_tensor is not None \
                 and model.final_tensor.guid == bn.outputs[0].guid:
             model.final_tensor = conv.outputs[0]

@@ -417,3 +417,61 @@ def test_unity_optimize_uses_measured_when_configured():
     cache = sim_mod.get_op_cost_cache(model.config)
     assert cache.misses > 0  # real measurements happened
     sim_mod._GLOBAL_CACHE = None
+
+
+def test_cancel_split_concat_rule():
+    from flexflow_tpu.search.substitution import apply_substitutions
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    x = model.create_tensor([4, 12])
+    t = model.dense(x, 12, name="d1")
+    parts = model.split(t, [4, 8], -1, name="sp")
+    cat = model.concat(parts, -1, name="cat")
+    model.softmax(model.dense(cat, 3, name="d2"))
+    g = Graph(model.ops)
+    n_before = len(g.ops)
+    applied = apply_substitutions(g)
+    assert any("cancel_split_concat" in a for a in applied), applied
+    assert len(g.ops) == n_before - 2
+    # d2 now consumes d1's output directly
+    d2 = next(op for op in g.ops.values() if op.name == "d2")
+    assert d2.inputs[0].owner_op.name == "d1"
+
+
+def test_drop_zero_dropout_and_noop_cast_rules():
+    from flexflow_tpu.search.substitution import apply_substitutions
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    x = model.create_tensor([4, 8])
+    t = model.dense(x, 8, name="d1")
+    t = model.dropout(t, 0.0, name="dr")
+    t = model.cast(t, ff.DataType.DT_FLOAT, name="c")  # same dtype
+    model.softmax(model.dense(t, 3, name="d2"))
+    g = Graph(model.ops)
+    applied = apply_substitutions(g)
+    assert any("drop_zero_dropout" in a for a in applied), applied
+    assert any("drop_noop_cast" in a for a in applied), applied
+    names = {op.name for op in g.ops.values()}
+    assert "dr" not in names and "c" not in names
+
+
+def test_split_consumed_elsewhere_not_cancelled():
+    """split outputs with an extra consumer must NOT cancel (the rewrite
+    would orphan that consumer's input)."""
+    from flexflow_tpu.search.substitution import rule_cancel_split_concat
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    x = model.create_tensor([4, 12])
+    t = model.dense(x, 12, name="d1")
+    parts = model.split(t, [6, 6], -1, name="sp")
+    cat = model.concat(parts, -1, name="cat")
+    extra = model.dense(parts[0], 3, name="extra")  # second consumer
+    model.softmax(model.add(model.dense(cat, 3, name="d2"), extra))
+    g = Graph(model.ops)
+    assert rule_cancel_split_concat(g) == []
