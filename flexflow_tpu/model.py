@@ -61,6 +61,7 @@ class FFModel:
         self._op_strategies = None
         self.search_result = None
         self._dataloaders: List[Any] = []
+        self._accum_grad = self._accum_add = self._accum_update = None
         # (op_name, weight_name, fn) regularization terms added to the loss
         self.weight_regularizers: List[Tuple[str, str, Any]] = []
         # node-key cache (reference: get_or_create_node, model.h:678-706)
@@ -654,6 +655,8 @@ class FFModel:
             self._export_task_graph(self.config.export_strategy_task_graph_file)
 
     def _build_step_functions(self) -> None:
+        # stale accumulation closures would capture the OLD executor/optimizer
+        self._accum_grad = self._accum_add = self._accum_update = None
         input_names = [op.name for op in self.input_ops]
         self._train_step = self.executor.build_train_step(
             self.optimizer, self.loss.fn, self.metrics, self.final_tensor,
@@ -667,6 +670,36 @@ class FFModel:
         self._infer_fn = self.executor.build_forward(self.final_tensor)
         self._grad_step = self.executor.build_grad_step(
             self.loss.fn, self.final_tensor)
+
+    def _build_accum_fns(self) -> None:
+        """Jitted pieces of gradient accumulation: the executor's shared
+        grad+metrics core, a (donating) tree add, and a
+        divide-then-optimizer-update (fit(accum_steps=k))."""
+        import jax
+
+        optimizer = self.optimizer
+        gstep = self.executor.build_grad_metrics_step(
+            self.loss.fn, self.metrics, self.final_tensor, self._reg_fn)
+        self._accum_grad_state = jax.jit(gstep)
+
+        def accum_grad(params, state, inputs, label, rng):
+            grads, mvals, new_state = self._accum_grad_state(
+                params, state, inputs, label, rng)
+            self.state = new_state  # BN running stats advance per microbatch
+            return grads, mvals
+
+        self._accum_grad = accum_grad
+        # donate the accumulator / the consumed params+grads+opt_state:
+        # accumulation is used when memory is tight
+        self._accum_add = jax.jit(
+            lambda a, b: jax.tree.map(lambda x, y: x + y, a, b),
+            donate_argnums=(0,))
+
+        def upd(params, grads, opt_state, k):
+            grads = jax.tree.map(lambda g: g / k, grads)
+            return optimizer.update(params, grads, opt_state)
+
+        self._accum_update = jax.jit(upd, donate_argnums=(0, 1, 2))
 
     def invalidate_compiled_steps(self) -> None:
         """Rebuild the jitted step functions after a graph/op-param mutation
@@ -900,10 +933,18 @@ class FFModel:
         y: Optional[np.ndarray] = None,
         batch_size: Optional[int] = None,
         epochs: Optional[int] = None,
+        accum_steps: int = 1,
         verbose: bool = False,
     ) -> List[Dict[str, float]]:
+        """accum_steps > 1: gradient accumulation — each optimizer update
+        averages the gradients of `accum_steps` consecutive microbatches of
+        the compiled batch size (static shapes stay fixed; effective batch =
+        batch_size * accum_steps). The per-microbatch loss mean makes the
+        accumulated average exactly the full-effective-batch gradient."""
         assert self._compiled, "call compile() first"
         self._assert_trainable()
+        if accum_steps > 1 and self._accum_update is None:
+            self._build_accum_fns()
         if x is None:
             x, y = self._dataloader_arrays()
         if isinstance(x, np.ndarray):
@@ -916,10 +957,10 @@ class FFModel:
             if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
             else DataType.DT_FLOAT
         )
-        if n < bs:
+        if n < bs * accum_steps:
             raise ValueError(
-                f"dataset has {n} samples but batch_size is {bs}; "
-                "fit needs at least one full batch"
+                f"dataset has {n} samples but batch_size*accum_steps is "
+                f"{bs * accum_steps}; fit needs at least one full update"
             )
         history = []
         timer = None
@@ -931,26 +972,50 @@ class FFModel:
             self.reset_metrics()
             t0 = time.time()
             mvals: Dict[str, float] = {}
-            for it in range(n // bs):
-                if timer is not None:
-                    timer.tick()
+            def load(it):
                 lo, hi = it * bs, (it + 1) * bs
                 inputs = self._prep_inputs(x, lo, hi)
                 label = self.executor.shard_batch(
                     np.ascontiguousarray(y[lo:hi]).astype(label_dtype.np_dtype)
                 )
+                return inputs, label
+
+            # with accumulation, each update consumes accum_steps microbatches
+            for step_i in range(n // (bs * accum_steps)):
+                if timer is not None:
+                    timer.tick()
                 if self._recompile_state is not None:
                     self._recompile_state.step(self)
-                self.params, self.opt_state, self.state, mvals = self._train_step(
-                    self.params, self.opt_state, self.state, inputs, label,
-                    self._next_rng(),
-                )
-                mvals = {k: float(v) for k, v in mvals.items()}
-                self.perf_metrics.update(hi - lo, mvals)
+                base = step_i * accum_steps
+                inputs, label = load(base)
+                if accum_steps > 1:
+                    grads, mvals = self._accum_grad(
+                        self.params, self.state, inputs, label,
+                        self._next_rng())
+                    for k in range(1, accum_steps):
+                        inputs, label = load(base + k)
+                        g2, mv2 = self._accum_grad(
+                            self.params, self.state, inputs, label,
+                            self._next_rng())
+                        grads = self._accum_add(grads, g2)
+                        mvals = {k2: mvals[k2] + mv2[k2] for k2 in mvals}
+                    self.params, self.opt_state = self._accum_update(
+                        self.params, grads, self.opt_state,
+                        float(accum_steps))
+                    mvals = {k2: float(v) / accum_steps
+                             for k2, v in mvals.items()}
+                    self.perf_metrics.update(accum_steps * bs, mvals)
+                else:
+                    self.params, self.opt_state, self.state, mvals = self._train_step(
+                        self.params, self.opt_state, self.state, inputs, label,
+                        self._next_rng(),
+                    )
+                    mvals = {k: float(v) for k, v in mvals.items()}
+                    self.perf_metrics.update(bs, mvals)
             dt = time.time() - t0
             summ = self.perf_metrics.summary()
             summ["epoch"] = epoch
-            summ["throughput"] = (n // bs) * bs / dt
+            summ["throughput"] = (n // (bs * accum_steps)) * bs * accum_steps / dt
             history.append(summ)
             if verbose:
                 print(

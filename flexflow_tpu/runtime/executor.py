@@ -124,9 +124,13 @@ class Executor:
         return ctx.values, new_state, aux_loss
 
     # -- step builders -----------------------------------------------------
-    def build_train_step(self, optimizer, loss_fn, metrics: Metrics,
-                         final_tensor, input_names: List[str], reg_fn=None):
-        def train_step(params, opt_state, state, inputs, label, rng):
+    def build_grad_metrics_step(self, loss_fn, metrics: Metrics,
+                                final_tensor, reg_fn=None):
+        """UNJITTED core shared by the fused train step and gradient
+        accumulation: (params, state, inputs, label, rng) ->
+        (grads, metric values incl. loss, new op state)."""
+
+        def gstep(params, state, inputs, label, rng):
             def loss_and_aux(p):
                 values, new_state, aux = self.forward_values(
                     p, state, inputs, rng, CompMode.COMP_MODE_TRAINING
@@ -141,9 +145,20 @@ class Executor:
             (loss, (mvals, new_state)), grads = jax.value_and_grad(
                 loss_and_aux, has_aux=True
             )(params)
-            new_params, new_opt_state = optimizer.update(params, grads, opt_state)
             mvals = dict(mvals)
             mvals["loss"] = loss
+            return grads, mvals, new_state
+
+        return gstep
+
+    def build_train_step(self, optimizer, loss_fn, metrics: Metrics,
+                         final_tensor, input_names: List[str], reg_fn=None):
+        gstep = self.build_grad_metrics_step(loss_fn, metrics, final_tensor,
+                                             reg_fn)
+
+        def train_step(params, opt_state, state, inputs, label, rng):
+            grads, mvals, new_state = gstep(params, state, inputs, label, rng)
+            new_params, new_opt_state = optimizer.update(params, grads, opt_state)
             return new_params, new_opt_state, new_state, mvals
 
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))

@@ -91,3 +91,43 @@ def test_dataloader_fit():
     ff.SingleDataLoader(model, model.label_tensor, y, 256)
     history = model.fit()
     assert len(history) == 2
+
+
+def test_gradient_accumulation_matches_large_batch():
+    """SGD with fit(accum_steps=2) at microbatch 4 must match one batch-8
+    step exactly (per-batch mean losses: the accumulated average IS the
+    full-batch gradient)."""
+    import flexflow_tpu as ff
+
+    def build(bs):
+        config = ff.FFConfig()
+        config.batch_size = bs
+        config.allow_mixed_precision = False
+        config.seed = 7
+        model = ff.FFModel(config)
+        x = model.create_tensor([bs, 6])
+        t = model.dense(x, 8, ff.ActiMode.AC_MODE_RELU)
+        model.softmax(model.dense(t, 3))
+        model.compile(optimizer=ff.SGDOptimizer(model, lr=0.1),
+                      loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      metrics=[])
+        return model
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 6).astype(np.float32)
+    Y = rng.randint(0, 3, size=(8, 1)).astype(np.int32)
+
+    big = build(8)
+    small = build(4)
+    # same seed => identical init
+    big.fit(x=X, y=Y, epochs=1)
+    small.fit(x=X, y=Y, epochs=1, accum_steps=2)
+
+    import jax
+
+    assert (jax.tree_util.tree_structure(big.params)
+            == jax.tree_util.tree_structure(small.params))
+    for a, b in zip(jax.tree_util.tree_leaves(big.params),
+                    jax.tree_util.tree_leaves(small.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
