@@ -1166,6 +1166,32 @@ class FFModel:
     def get_parameter_by_id(self, op_name: str, weight_name: str):
         return np.asarray(self.params[op_name][weight_name])
 
+    def summary(self, line_length: int = 72, print_fn=print) -> str:
+        """Keras-style model summary: one row per op with output shape and
+        parameter count (reference analog: the layer listing FFModel prints
+        under verbose compile)."""
+        rows = [("Op (type)", "Output shape", "Params")]
+        total = 0
+        for op in self.ops:
+            if op.op_type == OpType.INPUT:
+                shape = str(tuple(op.outputs[0].dims))
+                rows.append((f"{op.name} (input)", shape, "0"))
+                continue
+            n = sum(int(np.prod(w.dims)) for w in op.weights)
+            total += n
+            shape = str(tuple(op.outputs[0].dims)) if op.outputs else "-"
+            rows.append((f"{op.name} ({op.op_type.value})", shape, f"{n:,}"))
+        w0 = max(len(r[0]) for r in rows) + 2
+        w1 = max(len(r[1]) for r in rows) + 2
+        lines = [f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:>10}" for r in rows]
+        sep = "=" * max(line_length, w0 + w1 + 10)
+        out = "\n".join(
+            [sep, lines[0], sep] + lines[1:]
+            + [sep, f"Total params: {total:,}", sep])
+        if print_fn is not None:
+            print_fn(out)
+        return out
+
     def get_layers(self) -> List[Op]:
         return list(self.ops)
 

@@ -255,3 +255,17 @@ def test_lstm_numerics_vs_reference():
         outs.append(h)
     ref = np.stack(outs, axis=1)
     np.testing.assert_allclose(pred, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_model_summary():
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    model = ff.FFModel(config)
+    x = model.create_tensor([8, 16])
+    t = model.dense(x, 32, ff.ActiMode.AC_MODE_RELU, name="fc1")
+    model.softmax(model.dense(t, 4, name="fc2"))
+    out = model.summary(print_fn=None)
+    assert "fc1 (linear)" in out and "(8, 32)" in out
+    assert "Total params: 676" in out  # 16*32+32 + 32*4+4
