@@ -1120,7 +1120,10 @@ class FFModel:
             hi = min(lo + bs, n)
             inputs = self._prep_inputs(x, lo, hi)
             pred, _ = self._infer_fn(self.params, self.state, inputs, self._next_rng())
-            outs.append(np.asarray(pred))
+            arr = np.asarray(pred)
+            if arr.dtype.kind == "V":  # bf16 (ml_dtypes) under mixed precision
+                arr = arr.astype(np.float32)
+            outs.append(arr)
         return np.concatenate(outs, axis=0)
 
     def reset_metrics(self):
@@ -1166,10 +1169,10 @@ class FFModel:
     def get_parameter_by_id(self, op_name: str, weight_name: str):
         return np.asarray(self.params[op_name][weight_name])
 
-    def summary(self, line_length: int = 72, print_fn=print) -> str:
+    def summary(self, print_fn=print) -> str:
         """Keras-style model summary: one row per op with output shape and
-        parameter count (reference analog: the layer listing FFModel prints
-        under verbose compile)."""
+        parameter count; columns size to content (reference analog: the
+        layer listing FFModel prints under verbose compile)."""
         rows = [("Op (type)", "Output shape", "Params")]
         total = 0
         for op in self.ops:
@@ -1177,14 +1180,14 @@ class FFModel:
                 shape = str(tuple(op.outputs[0].dims))
                 rows.append((f"{op.name} (input)", shape, "0"))
                 continue
-            n = sum(int(np.prod(w.dims)) for w in op.weights)
+            n = sum(w.num_elements() for w in op.weights)
             total += n
             shape = str(tuple(op.outputs[0].dims)) if op.outputs else "-"
             rows.append((f"{op.name} ({op.op_type.value})", shape, f"{n:,}"))
         w0 = max(len(r[0]) for r in rows) + 2
         w1 = max(len(r[1]) for r in rows) + 2
         lines = [f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:>10}" for r in rows]
-        sep = "=" * max(line_length, w0 + w1 + 10)
+        sep = "=" * (w0 + w1 + 10)
         out = "\n".join(
             [sep, lines[0], sep] + lines[1:]
             + [sep, f"Total params: {total:,}", sep])
