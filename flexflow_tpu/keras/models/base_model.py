@@ -92,7 +92,8 @@ class BaseModel:
     # -- training -------------------------------------------------------
     def fit(self, x=None, y=None, epochs: int = 1, batch_size: Optional[int] = None,
             callbacks: Optional[Sequence[Callback]] = None,
-            validation_data=None, verbose: bool = False) -> History:
+            validation_data=None, accum_steps: int = 1,
+            verbose: bool = False) -> History:
         assert self.ffmodel is not None, "call compile() first"
         history = History()
         cbs = CallbackList([history] + list(callbacks or []), model=self)
@@ -101,7 +102,8 @@ class BaseModel:
         for epoch in range(epochs):
             cbs.on_epoch_begin(epoch)
             logs = self.ffmodel.fit(
-                x, y, batch_size=batch_size, epochs=1, verbose=verbose
+                x, y, batch_size=batch_size, epochs=1,
+                accum_steps=accum_steps, verbose=verbose
             )[0]
             if validation_data is not None:
                 vx, vy = validation_data
