@@ -475,3 +475,19 @@ def test_split_consumed_elsewhere_not_cancelled():
     model.softmax(model.add(model.dense(cat, 3, name="d2"), extra))
     g = Graph(model.ops)
     assert rule_cancel_split_concat(g) == []
+
+
+def test_strategy_roundtrip_preserves_sp(tmp_path):
+    """The exported strategy file carries the sp (sequence-parallel) field
+    and round-trips it (older files without it default to 1)."""
+    from flexflow_tpu.search.unity import SearchResult
+
+    model = build_mlp()
+    graph = Graph(model.ops)
+    strategies = {op.guid: OpStrategy(dp=2, sp=4) for op in model.ops}
+    res = SearchResult(strategies, {"data": 2, "seq": 4}, 1.0, 0.0, [])
+    path = str(tmp_path / "sp_strategy.json")
+    export_strategy(res, graph, path)
+    loaded, axes = import_strategy(graph, path)
+    assert axes == {"data": 2, "seq": 4}
+    assert all(s.sp == 4 and s.dp == 2 for s in loaded.values())
