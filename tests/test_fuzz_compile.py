@@ -80,3 +80,65 @@ def test_random_graph_compiles_and_trains(seed):
     assert np.isfinite(hist[-1]["loss"]), hist
     pred = model.predict(X[: model.config.batch_size])
     assert np.all(np.isfinite(np.asarray(pred, np.float32)))
+
+
+@pytest.mark.parametrize("axes,kind", [
+    ({"data": 8}, "mlp"),
+    ({"data": 2, "model": 4}, "mlp"),
+    ({"model": 8}, "mlp"),
+    ({"data": 2, "seq": 4}, "attn_ring"),
+    ({"data": 2, "seq": 4}, "attn_ulysses"),
+    ({"data": 4, "attr": 2}, "conv"),
+    ({"data": 2, "model": 2, "seq": 2}, "attn_ring"),
+])
+def test_explicit_axes_compile_and_train(axes, kind):
+    """Every advertised mesh-axis combination compiles and trains with
+    compatible shapes (dp x tp, dp x sp, dp x attr, and dp x tp x sp)."""
+    config = ff.FFConfig()
+    batch = 8
+    config.batch_size = batch
+    config.allow_mixed_precision = False
+    if "attr" in axes:
+        config.enable_attribute_parallel = True
+    model = ff.FFModel(config)
+
+    if kind == "mlp":
+        x = model.create_tensor([batch, 32])
+        t = model.dense(x, 64, ff.ActiMode.AC_MODE_RELU)
+        t = model.dense(t, 32)
+        X = np.random.RandomState(0).randn(2 * batch, 32).astype(np.float32)
+        Y = np.random.RandomState(1).randint(
+            0, 4, size=(2 * batch, 1)).astype(np.int32)
+    elif kind.startswith("attn"):
+        seq, hidden, heads = 16, 32, 4
+        x = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+        t = model.embedding(x, 50, hidden, ff.AggrMode.AGGR_MODE_NONE)
+        attn = model.multihead_attention(
+            t, t, t, hidden, heads, sequence_parallel=True,
+            sequence_parallel_mode=("ulysses" if kind.endswith("ulysses")
+                                    else "ring"))
+        t = model.layer_norm(model.add(t, attn), [-1])
+        t = model.dense(t, hidden, ff.ActiMode.AC_MODE_GELU)
+        X = np.random.RandomState(0).randint(
+            0, 50, size=(2 * batch, seq)).astype(np.int32)
+        Y = np.random.RandomState(1).randint(
+            0, 4, size=(2 * batch, seq, 1)).astype(np.int32)
+    else:  # conv
+        x = model.create_tensor([batch, 3, 8, 8])
+        t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+        t = model.flat(t)
+        t = model.dense(t, 16, ff.ActiMode.AC_MODE_RELU)
+        X = np.random.RandomState(0).randn(
+            2 * batch, 3, 8, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randint(
+            0, 4, size=(2 * batch, 1)).astype(np.int32)
+
+    model.softmax(model.dense(t, 4))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        parallel_axes=axes,
+    )
+    hist = model.fit(x=X, y=Y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss"]), (axes, kind, hist)
