@@ -1198,6 +1198,16 @@ class FFModel:
     def get_layers(self) -> List[Op]:
         return list(self.ops)
 
+    def get_layer_by_id(self, layer_id: int) -> Op:
+        """reference: FFModel.get_layer_by_id (flexflow_cffi.py)."""
+        return self.ops[layer_id]
+
+    def get_layer_by_name(self, name: str) -> Op:
+        for op in self.ops:
+            if op.name == name:
+                return op
+        raise KeyError(f"no layer named {name!r}")
+
     def _attach_dataloader(self, dl) -> None:
         self._dataloaders.append(dl)
 
