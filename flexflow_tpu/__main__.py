@@ -69,6 +69,11 @@ def _synthetic(model_name, config):
 
 
 def main(argv=None):
+    # an explicit JAX_PLATFORMS=cpu must win over the TPU site hook (same
+    # contract as the example bootstraps), BEFORE any backend touch
+    from .runtime.platform import honor_env_platform
+
+    honor_env_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
     # script mode: first non-flag arg ending in .py
     script = next((a for a in argv if a.endswith(".py")), None)
