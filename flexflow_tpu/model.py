@@ -945,13 +945,34 @@ class FFModel:
         self._assert_trainable()
         if accum_steps > 1 and self._accum_update is None:
             self._build_accum_fns()
-        if x is None:
-            x, y = self._dataloader_arrays()
-        if isinstance(x, np.ndarray):
-            x = [x]
         bs = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
-        n = x[0].shape[0]
+        dls = y_dl = None
+        if x is None:
+            # dataloader-driven fit: batches are PULLED through next_batch()
+            # so the native prefetch ring overlaps the gather with compute
+            # and shuffle=True is honored (loaders sharing a seed shuffle in
+            # lockstep — the seed+epoch reseeding scheme keeps x/y aligned)
+            dls, y_dl = self._dataloader_handles()
+            if y_dl is None:
+                raise RuntimeError(
+                    "fit() without x/y requires a dataloader attached to the "
+                    "label tensor")
+            if bs != dls[0].batch_size:
+                raise ValueError(
+                    f"fit(batch_size={bs}) differs from the attached "
+                    f"dataloaders' batch size {dls[0].batch_size}")
+            sizes = {dl.num_samples for dl in dls + [y_dl]}
+            if len(sizes) > 1:
+                # mismatched loader lengths would silently decorrelate x/y
+                # (each loader shuffles/wraps over its OWN num_samples)
+                raise ValueError(
+                    f"attached dataloaders disagree on num_samples: {sizes}")
+            n = sizes.pop()
+        else:
+            if isinstance(x, np.ndarray):
+                x = [x]
+            n = x[0].shape[0]
         label_dtype = (
             DataType.DT_INT32
             if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
@@ -973,6 +994,17 @@ class FFModel:
             t0 = time.time()
             mvals: Dict[str, float] = {}
             def load(it):
+                if dls is not None:
+                    # sequential pull — load() is called exactly once per
+                    # batch index in order, so the streams stay aligned
+                    inputs = {
+                        op.name: self.executor.shard_batch(
+                            dl.next_batch().astype(op.outputs[0].dtype.np_dtype))
+                        for op, dl in zip(self.input_ops, dls)
+                    }
+                    label = self.executor.shard_batch(
+                        y_dl.next_batch().astype(label_dtype.np_dtype))
+                    return inputs, label
                 lo, hi = it * bs, (it + 1) * bs
                 inputs = self._prep_inputs(x, lo, hi)
                 label = self.executor.shard_batch(
@@ -1211,21 +1243,24 @@ class FFModel:
     def _attach_dataloader(self, dl) -> None:
         self._dataloaders.append(dl)
 
-    def _dataloader_arrays(self):
-        """fit() without x/y: pull full arrays from attached SingleDataLoaders
-        (reference: dataloaders created per tensor, flexflow_cffi.py:2451)."""
+    def _dataloader_handles(self):
+        """fit() without x/y: the attached SingleDataLoaders ordered by input
+        op, plus the label loader (reference: dataloaders created per tensor,
+        flexflow_cffi.py:2451). fit() pulls batches through next_batch()."""
         if not self._dataloaders:
             raise RuntimeError("fit() without x/y requires attached dataloaders")
-        xs, y = [], None
         by_tensor = {dl.input_tensor.guid: dl for dl in self._dataloaders}
+        xs = []
         for op in self.input_ops:
             dl = by_tensor.get(op.outputs[0].guid)
-            if dl is not None:
-                xs.append(dl.data[: dl.num_samples])
-        if self.label_tensor is not None and self.label_tensor.guid in by_tensor:
-            dl = by_tensor[self.label_tensor.guid]
-            y = dl.data[: dl.num_samples]
-        return xs, y
+            if dl is None:
+                raise RuntimeError(
+                    f"no dataloader attached for input {op.name!r}")
+            xs.append(dl)
+        y_dl = None
+        if self.label_tensor is not None:
+            y_dl = by_tensor.get(self.label_tensor.guid)
+        return xs, y_dl
 
     def print_layers(self, id: int = -1) -> None:
         for op in self.ops:

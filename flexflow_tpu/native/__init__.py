@@ -268,6 +268,11 @@ class BatchStream:
         buf = (ctypes.c_char * (self.batch_size
                                 * int(np.prod(self._sample_shape, dtype=int))
                                 * self._dtype.itemsize)).from_address(ptr)
+        # the returned view must keep the stream (and its ring memory) alive:
+        # the array's base chain holds `buf`, and `buf` holds the stream —
+        # dropping the BatchStream while retaining the batch is then safe
+        # (the valid-until-next-call rule still bounds the CONTENT's life)
+        buf._ffstream = self
         return np.frombuffer(buf, dtype=self._dtype).reshape(
             (self.batch_size,) + self._sample_shape)
 

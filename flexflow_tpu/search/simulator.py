@@ -101,14 +101,57 @@ TP_WEIGHT_SHARD_DIMS = {
 _MEMORY_BOUND_BWD_FACTOR = 2.0  # bwd ≈ 2x fwd cost (two grad GEMMs per GEMM)
 
 
+# ops that DEFINE an NCHW output layout (dim 1 = channels)
+_SPATIAL_LAYOUT = AP_CAPABLE | {OpType.BATCHNORM, OpType.FLAT}
+# ops that DEFINE a token layout (dim 1 = position) or re-lay-out their
+# input, breaking NCHW propagation (reshape/transpose are how a vision
+# graph turns NCHW activations into (B, L, D) tokens)
+_LAYOUT_SOURCES = {
+    OpType.MULTIHEAD_ATTENTION, OpType.LINEAR, OpType.EMBEDDING,
+    OpType.RESHAPE, OpType.TRANSPOSE,
+}
+
+
+def _dim1_is_channel(op: Op) -> bool:
+    """True when op's 4D output is NCHW-laid-out (dim 1 = channels, not a
+    position dim): it is a spatial op, a raw 4D graph input (images), or a
+    layout-preserving op (elementwise/dropout/concat/...) inheriting NCHW
+    from a 4D producer. Memoized on the op (layout never changes)."""
+    cached = getattr(op, "_dim1_channel", None)
+    if cached is not None:
+        return cached
+    t = op.outputs[0]
+    if len(t.dims) != 4:
+        r = False
+    elif op.op_type in _SPATIAL_LAYOUT:
+        r = True
+    elif op.op_type in (OpType.INPUT, OpType.WEIGHT):
+        r = True  # raw 4D sources are NCHW images in this framework
+    elif op.op_type in _LAYOUT_SOURCES:
+        r = False
+    else:
+        r = any(
+            t_in.owner_op is not None and len(t_in.dims) == 4
+            and _dim1_is_channel(t_in.owner_op)
+            for t_in in op.inputs)
+    op._dim1_channel = r
+    return r
+
+
 def sp_shardable(op: Op, sp: int) -> bool:
     """Sequence sharding applies to ops whose output carries a position dim
-    (ndim >= 3, dim 1 divisible). EXPERTS excluded: its expert-axis
-    shard_map owns the token layout."""
+    at index 1 (ndim >= 3, dim 1 divisible). EXPERTS excluded: its
+    expert-axis shard_map owns the token layout; NCHW-layout outputs
+    excluded (layout propagated from producers): their dim 1 is channels —
+    GSPMD would stay correct, but the cost model would wrongly divide their
+    time by sp and the annotation would shard channels over 'seq' in hybrid
+    attention+conv graphs."""
     if sp <= 1 or not op.outputs or op.op_type == OpType.EXPERTS:
         return False
     t = op.outputs[0]
-    return len(t.dims) >= 3 and t.dims[1] > 1 and t.dims[1] % sp == 0
+    if len(t.dims) < 3 or t.dims[1] <= 1 or t.dims[1] % sp != 0:
+        return False
+    return not _dim1_is_channel(op)
 
 
 class CostModel:

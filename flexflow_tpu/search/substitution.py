@@ -34,6 +34,15 @@ class Application:
     rule: str
     apply: Callable[[], None]
     description: str = ""
+    # structural identity: guids of the matched ops. The joint search replays
+    # a winning rewrite on a clone/the original graph by re-matching on this
+    # key (clones preserve guids); descriptions are for logs only and may
+    # collide when two matches involve same-named ops.
+    key: Optional[tuple] = None
+
+    @property
+    def match_key(self):
+        return self.key if self.key is not None else self.description
 
 
 def _consumers(graph: Graph, op: Op) -> List[Op]:
@@ -230,7 +239,8 @@ def rule_merge_parallel_linears(graph: Graph) -> List[Application]:
                     graph.remove_op(b)
 
                 apps.append(Application("merge_parallel_linears", apply,
-                                        f"{a.name}+{b.name}"))
+                                        f"{a.name}+{b.name}",
+                                        key=(a.guid, b.guid)))
     return apps
 
 
@@ -374,7 +384,8 @@ def rule_merge_parallel_convs(graph: Graph) -> List[Application]:
                     graph.remove_op(b)
 
                 apps.append(Application("merge_parallel_convs", apply,
-                                        f"{a.name}+{b.name}"))
+                                        f"{a.name}+{b.name}",
+                                        key=(a.guid, b.guid)))
     return apps
 
 

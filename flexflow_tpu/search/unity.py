@@ -27,7 +27,7 @@ import heapq
 import itertools
 import json
 import logging
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _log = logging.getLogger("flexflow_tpu.search")
 
@@ -543,7 +543,8 @@ class GraphSearchHelper:
         base = self.graph
         best_res = self._parallelize(base, batch_size, n_devices, lam=lam)
         best_cost = objective(best_res)
-        best_seq: List[Tuple[str, str]] = []
+        # (rule name, structural match key, description) per applied rewrite
+        best_seq: List[Tuple[str, Any, str]] = []
         self.log.append(f"joint: base cost={best_cost:.1f}us")
         visited = {base.hash()}
         counter = itertools.count()
@@ -561,7 +562,7 @@ class GraphSearchHelper:
                 apps.extend(fn(g))
             for app in apps:
                 g2 = g.clone()
-                match = self._find_app(g2, rules, app.rule, app.description)
+                match = self._find_app(g2, rules, app.rule, app.match_key)
                 if match is None:
                     continue
                 match.apply()
@@ -577,7 +578,7 @@ class GraphSearchHelper:
                         f"joint: {app.rule}({app.description}) infeasible: {exc}")
                     continue
                 c2 = objective(r2)
-                seq2 = seq + [(app.rule, app.description)]
+                seq2 = seq + [(app.rule, app.match_key, app.description)]
                 self.log.append(
                     f"joint: {app.rule}({app.description}) -> {c2:.1f}us")
                 if c2 < best_cost:
@@ -587,14 +588,16 @@ class GraphSearchHelper:
         if best_seq and materialize:
             # materialize the winning rewrites on the real graph, then
             # re-cost it so strategies key to the real (fresh) op guids
-            for rule_name, desc in best_seq:
-                match = self._find_app(self.graph, rules, rule_name, desc)
+            for rule_name, mkey, desc in best_seq:
+                match = self._find_app(self.graph, rules, rule_name, mkey,
+                                       description=desc)
                 if match is None:
                     raise RuntimeError(
                         f"joint search: rewrite {rule_name}({desc}) did not "
                         "re-match on the original graph")
                 match.apply()
-            self.log.append(f"joint: applied {best_seq}")
+            self.log.append(
+                f"joint: applied {[(r, d) for r, _, d in best_seq]}")
             best_res = self._parallelize(self.graph, batch_size, n_devices,
                                          lam=lam, quiet=True)
             self.log.append(
@@ -602,10 +605,22 @@ class GraphSearchHelper:
         return best_res
 
     @staticmethod
-    def _find_app(graph: Graph, rules, rule_name: str, description: str):
-        for a in rules[rule_name](graph):
-            if a.description == description:
+    def _find_app(graph: Graph, rules, rule_name: str, match_key,
+                  description: Optional[str] = None):
+        """Re-match a rewrite on another graph by its structural key — the
+        matched ops' guids, which clones preserve — falling back to the
+        description. The fallback matters for CHAINED rewrites at
+        materialization: an op created by an earlier rewrite gets a fresh
+        guid on the real graph (clone-time guids don't replay), but its
+        name — and hence the description — is deterministic."""
+        apps = rules[rule_name](graph)
+        for a in apps:
+            if a.match_key == match_key:
                 return a
+        if description is not None:
+            for a in apps:
+                if a.description == description:
+                    return a
         return None
 
     def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],

@@ -187,7 +187,15 @@ def _fold(target: str, args, kwargs):
                             for x in idx)
             arr = a[0]
             if not (isinstance(arr, np.ndarray) and arr.flags.writeable):
+                # non-writeable source (e.g. a broadcast_to fold): replace
+                # the value INSIDE the original holder so downstream
+                # references to the source node keep aliasing the mutation
+                if not isinstance(args[0], _Const):
+                    raise ValueError(
+                        "setitem on a non-writeable trace-time array with no "
+                        "value holder — in-place aliasing cannot be preserved")
                 arr = np.array(arr)
+                args[0].value = arr
             arr[idx] = a[2]
             return wrap(arr)
         if target == "getattr":
