@@ -61,6 +61,16 @@ class FFConfig:
     # (reference: base_optimize over candidate graphs, substitution.cc:2229).
     # False = rewrites applied greedily before the strategy search.
     joint_search: bool = True
+    # strategy-search algorithm: "unity" (the joint search above) or "mcmc"
+    # (the MLSys'19 Metropolis annealing, reference model.cc:3286-3358)
+    strategy_search: str = "unity"
+    # MCMC iteration budget (None = reuse search_budget); setting it > 0
+    # with --strategy-search mcmc enables the search even when
+    # search_budget is 0
+    mcmc_budget: Optional[int] = None
+    # propagate accepted configs to same-typed neighbors (reference:
+    # FF_USE_PROPAGATE, model.cc:3181)
+    mcmc_propagate: bool = False
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
@@ -148,6 +158,16 @@ class FFConfig:
                 self.search_alpha = float(take())
             elif a == "--base-optimize-threshold":
                 self.base_optimize_threshold = int(take())
+            elif a == "--strategy-search":
+                v = take()
+                if v not in ("unity", "mcmc"):
+                    raise ValueError(
+                        f"--strategy-search must be unity or mcmc, got {v!r}")
+                self.strategy_search = v
+            elif a == "--mcmc-budget":
+                self.mcmc_budget = int(take())
+            elif a == "--mcmc-propagate":
+                self.mcmc_propagate = True
             elif a == "--only-data-parallel":
                 self.only_data_parallel = True
             elif a == "--enable-parameter-parallel":

@@ -458,6 +458,16 @@ class FFModel:
     def allreduce(self, input: Tensor, axis_name: str = "data", name: str = "") -> Tensor:
         return self._add_op(OpType.ALLREDUCE, [input], name, axis_name=axis_name).outputs[0]
 
+    def fused_parallel(self, input: Tensor, descriptors: Sequence[dict],
+                       name: str = "") -> Tensor:
+        """Chain of parallel-op descriptors applied as ONE reshard
+        (reference: src/parallel_ops/fused_parallel_op.cc). Each descriptor:
+        {"type": "partition"|"combine"|"replicate", "dim": int,
+        "degree": int, "axis": Optional[str]} — see parallel/parallel_ops.py
+        FusedParallelOp."""
+        return self._add_op(OpType.FUSED_PARALLEL, [input], name,
+                            descriptors=list(descriptors)).outputs[0]
+
     def create_constant(self, value, trainable: bool = False,
                         dtype: Optional[DataType] = None,
                         name: str = "") -> Tensor:
@@ -584,15 +594,30 @@ class FFModel:
         self._op_strategies = None
         if parallel_axes is None:
             if self.config.import_strategy_file:
+                from .search.substitution import (
+                    apply_substitutions,
+                    load_rule_spec,
+                    rule_set_from_spec,
+                )
                 from .search.unity import import_strategy
 
+                # the exporting search ran the greedy rewrite pass before
+                # choosing strategies, so op names in the file refer to the
+                # REWRITTEN graph (e.g. fuse_parallel_ops' merged names) —
+                # re-run the same deterministic pass before matching names
+                spec, is_taso = load_rule_spec(
+                    self.config.substitution_json_path)
+                apply_substitutions(self.graph,
+                                    rule_set_from_spec(spec, is_taso))
                 strategies, axes = import_strategy(
                     self.graph, self.config.import_strategy_file
                 )
                 self._op_strategies = strategies
                 parallel_axes = axes
             elif (
-                self.config.search_budget > 0
+                (self.config.search_budget > 0
+                 or (self.config.strategy_search == "mcmc"
+                     and (self.config.mcmc_budget or 0) > 0))
                 and n_dev > 1
                 and not self.config.only_data_parallel
             ):
@@ -600,10 +625,18 @@ class FFModel:
                 from .search.unity import export_strategy, unity_optimize
 
                 machine = make_machine_model(self.config, n_dev)
-                self.search_result = unity_optimize(
-                    self.graph, self.config, machine,
-                    self.config.batch_size, n_dev,
-                )
+                if self.config.strategy_search == "mcmc":
+                    from .search.mcmc import mcmc_search
+
+                    self.search_result = mcmc_search(
+                        self.graph, self.config, machine,
+                        self.config.batch_size, n_dev,
+                    )
+                else:
+                    self.search_result = unity_optimize(
+                        self.graph, self.config, machine,
+                        self.config.batch_size, n_dev,
+                    )
                 self._op_strategies = self.search_result.strategies
                 parallel_axes = self.search_result.mesh_axes
                 if self.config.export_strategy_file:
@@ -852,36 +885,19 @@ class FFModel:
                     )
             # explicit parallel ops override the default output sharding
             if op.op_type == OpType.REPARTITION:
-                degree = op.params["degree"]
-                # explicit axis param wins; else dim-kind convention
-                # (dim 0 = batch -> 'data', others -> 'model'); else any
-                # axis whose size matches
-                axis = op.params.get("axis")
-                if axis is None:
-                    cand = "data" if op.params["dim"] == 0 else "model"
-                    if axes.get(cand) == degree:
-                        axis = cand
-                    else:
-                        axis = next(
-                            (n for n, s in axes.items() if s == degree), None
-                        )
-                if axis is None:
-                    if degree > 1 and axes:
-                        raise ValueError(
-                            f"repartition {op.name}: no mesh axis of size "
-                            f"{degree} in {axes}"
-                        )
-                elif axes.get(axis) != degree:
-                    raise ValueError(
-                        f"repartition {op.name}: axis {axis!r} has size "
-                        f"{axes.get(axis)}, need {degree}"
-                    )
-                else:
+                from .parallel.parallel_ops import resolve_partition_axis
+
+                axis = resolve_partition_axis(
+                    op.name, op.params["dim"], op.params["degree"], axes,
+                    axis=op.params.get("axis"))
+                if axis is not None:
                     op.apply_parallel_shape(axis)
             elif op.op_type == OpType.COMBINE:
                 op.apply_parallel_shape()
             elif op.op_type == OpType.REPLICATE:
                 op.apply_parallel_shape()
+            elif op.op_type == OpType.FUSED_PARALLEL:
+                op.apply_parallel_shape(axes)
 
     def _assign_tp_weights(self, op: Op, tp: int, row: bool = False) -> None:
         """Shard weight dims over the 'model' axis where the op supports TP.

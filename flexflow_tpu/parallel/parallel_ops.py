@@ -25,7 +25,7 @@ reduction.cc:230 kernel which adds num_replicas buffers).
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,31 @@ import jax.numpy as jnp
 from ..core.op import Op, register_op
 from ..core.tensor import ParallelDim, ParallelTensorShape
 from ..ffconst import OpType, ParallelDimKind
+
+
+def resolve_partition_axis(op_name: str, dim: int, degree: int,
+                           axes: Dict[str, int],
+                           axis: Optional[str] = None) -> Optional[str]:
+    """Mesh axis a partition descriptor shards over: an explicit axis param
+    wins; else the dim-kind convention (dim 0 = batch -> 'data', others ->
+    'model'); else any axis whose size matches. Raises when no axis of the
+    required size exists (degree > 1 under a non-empty mesh)."""
+    if axis is None:
+        cand = "data" if dim == 0 else "model"
+        if axes.get(cand) == degree:
+            axis = cand
+        else:
+            axis = next((n for n, s in axes.items() if s == degree), None)
+    if axis is None:
+        if degree > 1 and axes:
+            raise ValueError(
+                f"partition {op_name}: no mesh axis of size {degree} in {axes}")
+        return None
+    if axes.get(axis) != degree:
+        raise ValueError(
+            f"partition {op_name}: axis {axis!r} has size "
+            f"{axes.get(axis)}, need {degree}")
+    return axis
 
 
 class ParallelOpBase(Op):
@@ -144,11 +169,60 @@ class AllReduceOp(Op):
         return [inputs[0]]
 
 
+# descriptor extraction for the parallel ops a FusedParallelOp can absorb
+# (reference: FusedParallelOp's ParallelOpInfo{op_type, parallel_dim,
+# parallel_degree}, include/flexflow/parallel_ops/parallel_op.h)
+def descriptors_of(op: Op) -> List[dict]:
+    if op.op_type == OpType.REPARTITION:
+        return [{"type": "partition", "dim": op.params["dim"],
+                 "degree": op.params["degree"],
+                 "axis": op.params.get("axis")}]
+    if op.op_type == OpType.COMBINE:
+        return [{"type": "combine", "dim": op.params["dim"]}]
+    if op.op_type == OpType.REPLICATE:
+        return [{"type": "replicate"}]
+    if op.op_type == OpType.FUSED_PARALLEL:
+        return [dict(d) for d in op.params["descriptors"]]
+    raise ValueError(f"{op.op_type} has no parallel descriptor")
+
+
 @register_op
 class FusedParallelOp(ParallelOpBase):
     """Composition of parallel-op descriptors applied as one reshard
-    (reference: fused_parallel_op.cc). The final sharding is whatever the
-    last descriptor produces; intermediate reshards are elided (GSPMD would
-    fuse them anyway)."""
+    (reference: fused_parallel_op.cc — FusedParallelOp carries a
+    ParallelOpInfo chain and its kernel forwards data once). The output's
+    ParallelTensorShape is the chain's FINAL state, so the executor's single
+    sharding constraint emits one GSPMD reshard for the whole chain —
+    intermediate reshards are elided by construction.
+
+    params["descriptors"]: list of {"type": "partition"|"combine"|
+    "replicate", "dim": int, "degree": int, "axis": Optional[str]} applied
+    in order (dim/degree/axis per type as in the standalone ops)."""
 
     op_type = OpType.FUSED_PARALLEL
+
+    def apply_parallel_shape(self, axes: Dict[str, int]) -> None:
+        t = self.outputs[0]
+        src = self.inputs[0].parallel_shape
+        dims = [ParallelDim(d.size, d.degree, d.axis, d.is_replica_dim, d.kind)
+                for d in src.dims]
+        for desc in self.params["descriptors"]:
+            kind = desc["type"]
+            if kind == "partition":
+                dim, degree = desc["dim"], desc["degree"]
+                axis = resolve_partition_axis(self.name, dim, degree, axes,
+                                              axis=desc.get("axis"))
+                if axis is not None:
+                    dims[dim] = ParallelDim(
+                        dims[dim].size, degree, axis,
+                        kind=ParallelDimKind.SAMPLE if dim == 0
+                        else ParallelDimKind.ATTRIBUTE)
+            elif kind == "combine":
+                dim = desc["dim"]
+                dims[dim] = ParallelDim(dims[dim].size, 1, None)
+            elif kind == "replicate":
+                dims = [ParallelDim(d.size, 1, None) for d in dims]
+            else:
+                raise ValueError(
+                    f"{self.name}: unknown parallel descriptor type {kind!r}")
+        t.parallel_shape = ParallelTensorShape(dims, t.dtype)

@@ -13,8 +13,9 @@ import random
 from typing import Dict, Optional
 
 from ..core.graph import Graph
+from .machine_model import MachineModel
 from .simulator import OpStrategy, Simulator
-from .unity import valid_strategies
+from .unity import SearchResult, _divisor_pairs, mesh_axes_for, valid_strategies
 
 
 def mcmc_optimize(
@@ -60,4 +61,64 @@ def mcmc_optimize(
             current, current_cost = cand, cost
             if cost < best_cost:
                 best, best_cost = dict(cand), cost
+    return best
+
+
+def mcmc_search(graph: Graph, config, machine: MachineModel,
+                batch_size: int, n_devices: int,
+                simulator: Optional[Simulator] = None) -> SearchResult:
+    """User entry for the MCMC strategy search (--strategy-search mcmc;
+    reference: FFModel::mcmc_optimize, model.cc:3286-3358, whose result is
+    exported/imported through the same strategy-file path, model.cc:3609).
+
+    The reference anneals machine-view proposals under its fixed device
+    pool; here the mesh factorization is the outer loop — each (dp, tp)
+    pair gets an equal share of the iteration budget, and the best
+    annealed strategy across factorizations wins (costed by the same
+    Simulator — measured costs auto-enabled on real accelerators exactly
+    as unity_optimize does — so the two searches are comparable)."""
+    from .substitution import (
+        apply_substitutions,
+        load_rule_spec,
+        rule_set_from_spec,
+    )
+    from .unity import _want_measured
+
+    log = []
+    # the greedy always-beneficial rewrite pass runs regardless of search
+    # algorithm (reference: substitutions precede strategy search)
+    spec, is_taso = load_rule_spec(config.substitution_json_path)
+    applied = apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
+    if applied:
+        log.append(f"substitutions: {applied}")
+    if simulator is None and _want_measured(config):
+        from .simulator import get_op_cost_cache
+
+        simulator = Simulator(machine, config,
+                              measured=get_op_cost_cache(config))
+    sim = simulator or Simulator(machine, config)
+    budget = (config.mcmc_budget if config.mcmc_budget is not None
+              else max(1, config.search_budget))
+    pairs = [(dp, tp) for dp, tp in _divisor_pairs(n_devices)
+             if batch_size % dp == 0]
+    if config.only_data_parallel:
+        pairs = [(n_devices, 1)]
+    if not pairs:
+        raise ValueError("no feasible (dp, tp) mesh factorization")
+    share = max(1, budget // len(pairs))
+    best = None
+    for dp, tp in pairs:
+        strategies = mcmc_optimize(
+            graph, config, sim, batch_size, dp, tp, budget=share,
+            alpha=0.05, seed=config.seed, propagate=config.mcmc_propagate)
+        cost = sim.simulate(graph, strategies)
+        mem = sim.memory_bytes(graph, strategies)
+        axes = mesh_axes_for(dp, tp, strategies)
+        log.append(f"mcmc: dp={dp} tp={tp} cost={cost:.1f}us "
+                   f"mem={mem/1e9:.2f}GB")
+        r = SearchResult(strategies, axes, cost, mem, [log[-1]])
+        if best is None or r.cost_us < best.cost_us:
+            best = r
+    best.log = log + [f"mcmc selected: {best.mesh_axes} "
+                      f"cost={best.cost_us:.1f}us"]
     return best

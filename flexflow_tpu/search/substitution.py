@@ -312,6 +312,46 @@ def rule_drop_noop_cast(graph: Graph) -> List[Application]:
     return apps
 
 
+def rule_fuse_parallel_ops(graph: Graph) -> List[Application]:
+    """Two consecutive parallel ops ==> one FusedParallelOp carrying both
+    descriptor chains (reference: src/parallel_ops/fused_parallel_op.cc —
+    the reference's graph optimizer emits FusedParallelOp for chained
+    reshards so data is forwarded once). Strictly shrinking and
+    value-identity (every absorbed op is an identity on values), so it runs
+    in the greedy pass; re-matching to fixed point collapses chains of any
+    length."""
+    from ..parallel.parallel_ops import descriptors_of
+
+    FUSABLE = {OpType.REPARTITION, OpType.COMBINE, OpType.REPLICATE,
+               OpType.FUSED_PARALLEL}
+    apps = []
+    for op in list(graph.ops.values()):
+        if op.op_type not in FUSABLE:
+            continue
+        src = op.inputs[0].owner_op
+        if src is None or src.op_type not in FUSABLE or src.guid not in graph.ops:
+            continue
+        if len(_consumers(graph, src)) != 1:
+            continue
+
+        def apply(op=op, src=src):
+            from ..core.op import OP_REGISTRY
+            from ..ffconst import OpType as OT
+
+            fused = OP_REGISTRY[OT.FUSED_PARALLEL](
+                op.model, [src.inputs[0]], f"{src.name}+{op.name}",
+                descriptors=descriptors_of(src) + descriptors_of(op))
+            graph.add_op(fused)
+            _rewire(graph, op.outputs[0], fused.outputs[0])
+            graph.remove_op(op)
+            graph.remove_op(src)
+
+        apps.append(Application("fuse_parallel_ops", apply,
+                                f"{src.name}->{op.name}",
+                                key=(src.guid, op.guid)))
+    return apps
+
+
 ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "fuse_linear_activation": rule_fuse_linear_activation,
     "merge_adjacent_reshape": rule_merge_adjacent_reshape,
@@ -321,6 +361,7 @@ ALL_RULES: Dict[str, Callable[[Graph], List[Application]]] = {
     "cancel_split_concat": rule_cancel_split_concat,
     "drop_zero_dropout": rule_drop_zero_dropout,
     "drop_noop_cast": rule_drop_noop_cast,
+    "fuse_parallel_ops": rule_fuse_parallel_ops,
 }
 
 # no 'dtype': model.conv2d takes none (unlike dense), so it would never

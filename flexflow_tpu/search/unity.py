@@ -625,18 +625,26 @@ class GraphSearchHelper:
 
     def _axes(self, dp: int, tp: int, strategies: Dict[int, OpStrategy],
               ep: int = 1, ap: int = 1, sp: int = 1) -> Dict[str, int]:
-        axes = {}
-        if dp > 1 and any(s.dp > 1 for s in strategies.values()):
-            axes["data"] = dp
-        if tp > 1 and any(s.tp > 1 for s in strategies.values()):
-            axes["model"] = tp
-        if ep > 1 and any(s.ep > 1 for s in strategies.values()):
-            axes["expert"] = ep
-        if ap > 1 and any(s.ap > 1 for s in strategies.values()):
-            axes["attr"] = ap
-        if sp > 1 and any(s.sp > 1 for s in strategies.values()):
-            axes["seq"] = sp
-        return axes
+        return mesh_axes_for(dp, tp, strategies, ep, ap, sp)
+
+
+def mesh_axes_for(dp: int, tp: int, strategies: Dict[int, OpStrategy],
+                  ep: int = 1, ap: int = 1, sp: int = 1) -> Dict[str, int]:
+    """Mesh axes a strategy set actually uses (an axis is only included when
+    some op shards over it) — shared by the Unity and MCMC searches so their
+    exported mesh_axes follow one convention."""
+    axes = {}
+    if dp > 1 and any(s.dp > 1 for s in strategies.values()):
+        axes["data"] = dp
+    if tp > 1 and any(s.tp > 1 for s in strategies.values()):
+        axes["model"] = tp
+    if ep > 1 and any(s.ep > 1 for s in strategies.values()):
+        axes["expert"] = ep
+    if ap > 1 and any(s.ap > 1 for s in strategies.values()):
+        axes["attr"] = ap
+    if sp > 1 and any(s.sp > 1 for s in strategies.values()):
+        axes["seq"] = sp
+    return axes
 
 
 def _want_measured(config) -> bool:
@@ -750,9 +758,17 @@ def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dic
         data = json.load(f)
     by_name = {op.name: op for op in graph.ops.values()}
     strategies = {}
+    unmatched = []
     for name, s in data["ops"].items():
         if name in by_name:
             strategies[by_name[name].guid] = OpStrategy(
                 dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1),
                 sp=s.get("sp", 1), tp_row=s.get("tp_row", False))
+        else:
+            unmatched.append(name)
+    if unmatched:
+        _log.warning(
+            "import_strategy: %d op entries have no matching op in the "
+            "graph (they fall back to the default strategy): %s",
+            len(unmatched), unmatched[:8])
     return strategies, data.get("mesh_axes", {})

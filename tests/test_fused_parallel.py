@@ -1,0 +1,113 @@
+"""FusedParallelOp: descriptor-chain composition applied as one reshard
+(reference: src/parallel_ops/fused_parallel_op.cc)."""
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.search.substitution import (
+    apply_substitutions,
+    rule_fuse_parallel_ops,
+)
+
+
+def _mlp_with(chain_builder, batch=8, feats=16):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    x = model.create_tensor([batch, feats])
+    t = chain_builder(model, x)
+    model.softmax(model.dense(t, 4))
+    return model, x
+
+
+def _fit_briefly(model):
+    rs = np.random.RandomState(0)
+    data = rs.randn(16, 16).astype(np.float32)
+    labels = rs.randint(0, 4, size=(16, 1)).astype(np.int32)
+    model.compile(loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  parallel_axes={"data": 2})
+    return model.fit(data, labels, epochs=1)
+
+
+def test_fused_partition_then_combine_shape():
+    """partition(dim 0) then combine(dim 0) composes to no sharding."""
+    model, _ = _mlp_with(lambda m, x: m.fused_parallel(x, [
+        {"type": "partition", "dim": 0, "degree": 2, "axis": "data"},
+        {"type": "combine", "dim": 0},
+    ]))
+    _fit_briefly(model)
+    fused = next(op for op in model.ops if op.op_type == OpType.FUSED_PARALLEL)
+    assert all(d.degree == 1 for d in fused.outputs[0].parallel_shape.dims)
+
+
+def test_fused_partition_shape_matches_standalone():
+    """A single-partition fused chain shards identically to RepartitionOp."""
+    fused_model, _ = _mlp_with(lambda m, x: m.fused_parallel(x, [
+        {"type": "partition", "dim": 0, "degree": 2, "axis": "data"},
+    ]))
+    plain_model, _ = _mlp_with(lambda m, x: m.repartition(x, 0, 2, axis="data"))
+    _fit_briefly(fused_model)
+    _fit_briefly(plain_model)
+    f = next(op for op in fused_model.ops
+             if op.op_type == OpType.FUSED_PARALLEL).outputs[0].parallel_shape
+    p = next(op for op in plain_model.ops
+             if op.op_type == OpType.REPARTITION).outputs[0].parallel_shape
+    assert [(d.size, d.degree, d.axis) for d in f.dims] == \
+        [(d.size, d.degree, d.axis) for d in p.dims]
+
+
+def test_replicate_descriptor_clears_sharding():
+    model, _ = _mlp_with(lambda m, x: m.fused_parallel(x, [
+        {"type": "partition", "dim": 0, "degree": 2, "axis": "data"},
+        {"type": "replicate"},
+    ]))
+    _fit_briefly(model)
+    fused = next(op for op in model.ops if op.op_type == OpType.FUSED_PARALLEL)
+    assert all(d.degree == 1 and d.axis is None
+               for d in fused.outputs[0].parallel_shape.dims)
+
+
+def test_unknown_descriptor_rejected():
+    model, _ = _mlp_with(lambda m, x: m.fused_parallel(x, [
+        {"type": "shuffle", "dim": 0, "degree": 2},
+    ]))
+    with pytest.raises(ValueError, match="unknown parallel descriptor"):
+        _fit_briefly(model)
+
+
+def test_rule_collapses_parallel_chain():
+    """repartition -> combine -> replicate collapses (to fixed point) into
+    ONE FusedParallelOp carrying all three descriptors in order."""
+    model, _ = _mlp_with(
+        lambda m, x: m.replicate(m.combine(m.repartition(x, 0, 2), 0)))
+    graph = Graph(model.ops)
+    applied = apply_substitutions(
+        graph, {"fuse_parallel_ops": rule_fuse_parallel_ops})
+    assert len(applied) == 2, applied
+    fused = [op for op in graph.ops.values()
+             if op.op_type == OpType.FUSED_PARALLEL]
+    assert len(fused) == 1
+    assert [d["type"] for d in fused[0].params["descriptors"]] == \
+        ["partition", "combine", "replicate"]
+    assert not any(op.op_type in (OpType.REPARTITION, OpType.COMBINE,
+                                  OpType.REPLICATE)
+                   for op in graph.ops.values())
+
+
+def test_fused_chain_trains_like_unfused():
+    """The fused chain is a value identity: training histories match the
+    unfused chain exactly (same init seed, same data)."""
+    def chain(m, x):
+        return m.combine(m.repartition(x, 0, 2, axis="data"), 0)
+
+    def fused(m, x):
+        return m.fused_parallel(x, [
+            {"type": "partition", "dim": 0, "degree": 2, "axis": "data"},
+            {"type": "combine", "dim": 0},
+        ])
+
+    h1 = _fit_briefly(_mlp_with(chain)[0])
+    h2 = _fit_briefly(_mlp_with(fused)[0])
+    assert h1[-1]["loss"] == pytest.approx(h2[-1]["loss"], rel=1e-5)

@@ -491,3 +491,73 @@ def test_strategy_roundtrip_preserves_sp(tmp_path):
     loaded, axes = import_strategy(graph, path)
     assert axes == {"data": 2, "seq": 4}
     assert all(s.sp == 4 and s.dp == 2 for s in loaded.values())
+
+
+# -- MCMC user path (--strategy-search mcmc) ----------------------------
+def test_mcmc_flags_parse():
+    cfg = ff.FFConfig()
+    rest = cfg.parse_args(["--strategy-search", "mcmc",
+                           "--mcmc-budget", "50", "--mcmc-propagate"])
+    assert rest == []
+    assert cfg.strategy_search == "mcmc"
+    assert cfg.mcmc_budget == 50
+    assert cfg.mcmc_propagate is True
+    with pytest.raises(ValueError):
+        ff.FFConfig().parse_args(["--strategy-search", "genetic"])
+
+
+def test_mcmc_search_beats_pure_data_parallel():
+    """mcmc_search starts each factorization from pure DP, so its winner is
+    never worse than the best pure-DP strategy under the same simulator."""
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.mcmc import mcmc_search
+
+    model = build_mlp()
+    model.config.mcmc_budget = 120
+    graph = Graph(model.ops)
+    machine = make_machine_model(model.config, 8)
+    sim = Simulator(machine, model.config)
+    result = mcmc_search(graph, model.config, machine, 64, 8, simulator=sim)
+    pure_dp = {op.guid: OpStrategy(dp=8, tp=1) for op in graph.ops.values()}
+    assert result.cost_us <= sim.simulate(graph, pure_dp) + 1e-6
+    assert result.strategies and result.mesh_axes
+
+
+def test_mcmc_compile_and_export(tmp_path):
+    """compile() dispatches to MCMC and exports its strategy through the
+    same --export file Unity uses (reference: model.cc:3609-3617)."""
+    export = tmp_path / "mcmc_strategy.json"
+    config = ff.FFConfig()
+    config.batch_size = 64
+    config.num_devices = 8
+    config.strategy_search = "mcmc"
+    config.mcmc_budget = 60
+    config.export_strategy_file = str(export)
+    model = ff.FFModel(config)
+    inp = model.create_tensor([64, 512])
+    t = model.dense(inp, 2048, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    model.compile(loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert model.search_result is not None
+    assert export.exists()
+    data = json.loads(export.read_text())
+    assert data["ops"] and "mesh_axes" in data
+
+
+def test_mcmc_vs_unity_comparable():
+    """Unity's best-first search should match or beat annealing on a small
+    graph under the same simulator/cost model."""
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.mcmc import mcmc_search
+
+    model = build_mlp()
+    model.config.search_budget = 30
+    model.config.mcmc_budget = 120
+    graph = Graph(model.ops)
+    machine = make_machine_model(model.config, 8)
+    sim = Simulator(machine, model.config)
+    unity = unity_optimize(Graph(model.ops), model.config, machine, 64, 8,
+                           simulator=sim)
+    mcmc = mcmc_search(graph, model.config, machine, 64, 8, simulator=sim)
+    assert unity.cost_us <= mcmc.cost_us * 1.05
