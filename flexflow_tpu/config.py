@@ -78,6 +78,13 @@ class FFConfig:
     # the Unity search may shard the position dim over a 'seq' mesh axis
     # (ring attention) when enabled
     enable_sequence_parallel: bool = False
+    # pipeline parallelism as a SEARCH axis (NEW vs the reference, whose
+    # OP_PIPELINE enum ffconst.h:159 is unused): the search may map the
+    # graph's repeated-block region onto a 'stage' mesh axis via the GPipe
+    # kernel, priced by bubble fraction (S-1)/(M+S-1) + activation transfer
+    enable_pipeline_parallel: bool = False
+    # GPipe microbatch count M for the 'stage' axis (batch must divide)
+    pipeline_microbatches: int = 4
     enable_inplace_optimizations: bool = False
     # collectives overlap compute in the simulator's two-stream schedule
     # (XLA's latency-hiding scheduler does this on TPU); False = collectives
@@ -176,6 +183,10 @@ class FFConfig:
                 self.enable_attribute_parallel = True
             elif a == "--enable-sequence-parallel":
                 self.enable_sequence_parallel = True
+            elif a == "--enable-pipeline-parallel":
+                self.enable_pipeline_parallel = True
+            elif a == "--pipeline-microbatches":
+                self.pipeline_microbatches = int(take())
             elif a == "--search-overlap-backward-update":
                 self.search_overlap_backward_update = True
             elif a == "--memory-search":

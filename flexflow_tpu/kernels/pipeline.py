@@ -24,13 +24,21 @@ from jax import lax
 
 
 def gpipe_stage_loop(stage_fn: Callable, local_params, x_micro,
-                     n_stages: int, axis_name: str = "stage"):
+                     n_stages: int, axis_name: str = "stage", rng=None,
+                     fold_axes=()):
     """Runs INSIDE shard_map. local_params: this stage's parameter slice
     (leading stacked dim of size 1, squeezed here). x_micro: (M, ...) the
     full microbatch queue (replicated — only stage 0 reads it). Returns
-    (M, ...) outputs, replicated across stages."""
+    (M, ...) outputs, replicated across stages. rng (optional): folded per
+    tick and per mesh coordinate along `fold_axes` (the stage axis plus any
+    batch-sharding axes), then passed as stage_fn's third argument —
+    dropout inside a stage differs per stage, per microbatch, AND per
+    data shard, like a sequential execution over the global batch would."""
     s = lax.axis_index(axis_name)
     params = jax.tree_util.tree_map(lambda p: p[0], local_params)
+    if rng is not None:
+        for ax in (axis_name, *fold_axes):
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
     m = x_micro.shape[0]
     ticks = m + n_stages - 1  # static: mesh size and M are trace-time consts
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -39,7 +47,10 @@ def gpipe_stage_loop(stage_fn: Callable, local_params, x_micro,
         # stage 0 pulls from the queue; others use the permuted-in buffer
         mb = x_micro[jnp.clip(t, 0, m - 1)]
         x_in = jnp.where(s == 0, mb, buf)
-        y = stage_fn(params, x_in)
+        if rng is None:
+            y = stage_fn(params, x_in)
+        else:
+            y = stage_fn(params, x_in, jax.random.fold_in(rng, t))
         out = y  # meaningful on the LAST stage for microbatch t - (S-1)
         buf_next = lax.ppermute(y, axis_name, perm)
         return buf_next, out
@@ -63,6 +74,21 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, mesh,
     over `axis_name`. x: (B, ...) global batch (B % microbatches == 0).
     Returns (B, ...) outputs. Differentiable end to end.
     """
+    return gpipe_apply_mesh(stage_fn, stacked_params, x, mesh,
+                            axis_name=axis_name, microbatches=microbatches)
+
+
+def gpipe_apply_mesh(stage_fn: Callable, stacked_params, x, mesh,
+                     axis_name: str = "stage", microbatches: int = 4,
+                     data_axis=None, rng=None):
+    """Pipeline application on a mesh that may also carry a data axis.
+
+    The executor's PCG path: `x` is the (B, ...) region input, possibly
+    batch-sharded over `data_axis`; each (data-shard, stage) device runs the
+    GPipe loop on its batch shard, ppermuting activations over `axis_name`
+    only. stage_fn(params_slice, x_micro[, rng]) applies one stage's chunk
+    of the region. Differentiable end to end (scan reverse-mode is the
+    backward pipeline schedule)."""
     from jax.sharding import PartitionSpec as P
 
     from . import get_shard_map
@@ -70,20 +96,34 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, mesh,
     shard_map = get_shard_map()
 
     b = x.shape[0]
-    assert b % microbatches == 0, (b, microbatches)
-    stacked = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    assert stacked == mesh.shape[axis_name], (
-        f"stacked stage dim {stacked} != mesh '{axis_name}' size "
-        f"{mesh.shape[axis_name]} — each device must hold exactly one stage")
-    x_micro = x.reshape((microbatches, b // microbatches) + x.shape[1:])
-
     n_stages = mesh.shape[axis_name]
+    if b % microbatches != 0:
+        raise ValueError(
+            f"pipeline microbatches ({microbatches}) must divide the batch "
+            f"({b}) — set config.pipeline_microbatches accordingly")
+    micro_b = b // microbatches
+    dp = mesh.shape[data_axis] if data_axis else 1
+    if micro_b % dp != 0:
+        raise ValueError(
+            f"per-microbatch batch ({micro_b}) must divide over the data "
+            f"axis ({dp}): batch={b}, microbatches={microbatches}")
+    stacked = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert stacked == n_stages, (
+        f"stacked stage dim {stacked} != mesh '{axis_name}' size {n_stages}")
+    x_micro = x.reshape((microbatches, micro_b) + x.shape[1:])
+
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    fn = shard_map(
-        lambda p, xm: gpipe_stage_loop(stage_fn, p, xm, n_stages, axis_name),
-        mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
-    )
-    out = fn(stacked_params, x_micro)
+    xspec = P(None, data_axis) if data_axis else P()
+    args = (stacked_params, x_micro) + ((rng,) if rng is not None else ())
+    in_specs = (pspec, xspec) + ((P(),) if rng is not None else ())
+
+    fold_axes = (data_axis,) if data_axis else ()
+
+    def body(p, xm, *r):
+        return gpipe_stage_loop(stage_fn, p, xm, n_stages, axis_name,
+                                rng=r[0] if r else None,
+                                fold_axes=fold_axes)
+
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=xspec)
+    out = fn(*args)
     return out.reshape((b,) + out.shape[2:])

@@ -598,19 +598,24 @@ class FFModel:
                     apply_substitutions,
                     load_rule_spec,
                     rule_set_from_spec,
+                    search_rules_from_spec,
                 )
                 from .search.unity import import_strategy
 
                 # the exporting search ran the greedy rewrite pass before
                 # choosing strategies, so op names in the file refer to the
                 # REWRITTEN graph (e.g. fuse_parallel_ops' merged names) —
-                # re-run the same deterministic pass before matching names
+                # re-run the same deterministic pass before matching names.
+                # Trade-off (search-rule) rewrites the exporting search
+                # materialized are recorded in the file and replayed by
+                # import_strategy via the rules registry.
                 spec, is_taso = load_rule_spec(
                     self.config.substitution_json_path)
                 apply_substitutions(self.graph,
                                     rule_set_from_spec(spec, is_taso))
                 strategies, axes = import_strategy(
-                    self.graph, self.config.import_strategy_file
+                    self.graph, self.config.import_strategy_file,
+                    rules=search_rules_from_spec(spec, is_taso),
                 )
                 self._op_strategies = strategies
                 parallel_axes = axes

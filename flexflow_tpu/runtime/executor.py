@@ -33,12 +33,110 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._forward_jit = None
+        # pipeline parallelism: a 'stage' mesh axis routes the repeated-block
+        # region of the PCG through the GPipe kernel (beyond-reference:
+        # upstream's OP_PIPELINE ffconst.h:159 is an unused enum)
+        self.pipeline_plan = None
+        if mesh is not None and "stage" in mesh.axis_names \
+                and mesh.shape["stage"] > 1:
+            from ..parallel.pipeline_plan import find_pipeline_plan
+
+            self.pipeline_plan = find_pipeline_plan(graph,
+                                                    mesh.shape["stage"])
+            self.pipeline_microbatches = max(
+                1, getattr(config, "pipeline_microbatches", 4))
+
+    # -- pipeline helpers --------------------------------------------------
+    def _pp_key(self, j: int, r: int, op) -> str:
+        return f"seg{j}_op{r}_{op.name}"
+
+    def _init_pipeline_params(self, key, params: Dict) -> Any:
+        """Stacked region parameters: leaf shape (S, *dims), sharded over
+        the 'stage' axis — each device holds exactly its stage's slice."""
+        import jax
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        plan = self.pipeline_plan
+        stacked: Dict[str, Dict[str, Any]] = {}
+        for j in range(plan.segs_per_stage):
+            for r, template in enumerate(plan.segments[j]):
+                if not template.weights:
+                    continue
+                entry: Dict[str, Any] = {}
+                for wi, w in enumerate(template.weights):
+                    ws = w._weight_spec
+                    slices = []
+                    for s in range(plan.n_stages):
+                        op_s = plan.segments[s * plan.segs_per_stage + j][r]
+                        w_s = op_s.weights[wi]
+                        key, sub = jax.random.split(key)
+                        if w_s._host_value is not None:
+                            slices.append(jnp.asarray(w_s._host_value))
+                        else:
+                            ws_s = w_s._weight_spec
+                            slices.append(ws_s.initializer(
+                                sub, ws_s.dims, ws_s.dtype.jnp_dtype))
+                    val = jnp.stack(slices)
+                    spec = PartitionSpec("stage",
+                                         *([None] * (val.ndim - 1)))
+                    entry[ws.name] = jax.device_put(
+                        val, NamedSharding(self.mesh, spec))
+                stacked[self._pp_key(j, r, template)] = entry
+        params["__pipeline__"] = stacked
+        return key
+
+    def _run_pipeline(self, pp_params, x, ctx, rng):
+        """Evaluate the pipelined region: GPipe over the 'stage' axis, one
+        stage = segs_per_stage isomorphic segments walked with the stage-0
+        template ops and this stage's weight slices."""
+        from ..kernels.pipeline import gpipe_apply_mesh
+        from ..core.op import LoweringContext
+
+        plan = self.pipeline_plan
+        config, mode = self.config, ctx.mode
+        seq_len = ctx.iter_seq_length
+
+        def stage_fn(p_slice, x_in, *stage_rng):
+            sub = LoweringContext(config, mode, None,
+                                  stage_rng[0] if stage_rng else None,
+                                  iter_seq_length=seq_len)
+            sub.in_shard_map = True
+            values = {plan.entries[0].guid: x_in}
+            for j in range(plan.segs_per_stage):
+                for r, op in enumerate(plan.segments[j]):
+                    ins = [values[t.guid] for t in op.inputs]
+                    weights = dict(p_slice.get(self._pp_key(j, r, op), {}))
+                    with jax.named_scope(f"pp:{op.op_type.value}:{op.name}"):
+                        outs = op.lower(sub, ins, weights)
+                    for t, v in zip(op.outputs, outs):
+                        if hasattr(v, "astype"):
+                            v = v.astype(emit_dtype(config, t.dtype))
+                        values[t.guid] = v
+                # the next template segment reads its entry tensor, which
+                # segment j's bottleneck just produced into `values`
+            return values[plan.segments[plan.segs_per_stage - 1][-1]
+                          .outputs[0].guid]
+
+        data_axis = ("data" if "data" in self.mesh.axis_names
+                     and self.mesh.shape["data"] > 1 else None)
+        return gpipe_apply_mesh(
+            stage_fn, pp_params, x, self.mesh,
+            axis_name="stage",
+            microbatches=self.pipeline_microbatches,
+            data_axis=data_axis,
+            rng=rng,
+        )
 
     # -- parameter/state initialization (reference: init_operators + initializer tasks)
     def init_params(self, key) -> Tuple[Dict, Dict]:
         params: Dict[str, Dict[str, Any]] = {}
         state: Dict[str, Dict[str, Any]] = {}
+        region = (self.pipeline_plan.region_guids
+                  if self.pipeline_plan else ())
         for op in self.topo:
+            if op.guid in region:
+                continue  # stacked under "__pipeline__" below
             if op.weights:
                 params[op.name] = {}
                 for w in op.weights:
@@ -63,6 +161,8 @@ class Executor:
                     state[op.name][sv.name] = sv.initializer(
                         sub, sv.dims, sv.dtype.jnp_dtype
                     )
+        if self.pipeline_plan is not None:
+            key = self._init_pipeline_params(key, params)
         return params, state
 
     # -- forward walk ------------------------------------------------------
@@ -90,7 +190,18 @@ class Executor:
         for op_name, vars_ in state.items():
             for var, val in vars_.items():
                 ctx.state[(op_name, var)] = val
+        plan = self.pipeline_plan
         for op in self.topo:
+            if plan is not None and op.guid in plan.region_guids:
+                if op.guid == plan.first_op_guid:
+                    x = ctx.values[plan.region_input.guid]
+                    out = self._run_pipeline(
+                        params.get("__pipeline__", {}), x, ctx, rng)
+                    out = out.astype(
+                        emit_dtype(self.config, plan.region_output.dtype))
+                    ctx.values[plan.region_output.guid] = ctx.constrain(
+                        out, plan.region_output)
+                continue
             if op.op_type == OpType.INPUT:
                 val = input_values[op.name]
                 ctx.values[op.outputs[0].guid] = ctx.constrain(val, op.outputs[0])

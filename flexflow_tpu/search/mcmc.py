@@ -117,7 +117,19 @@ def mcmc_search(graph: Graph, config, machine: MachineModel,
         log.append(f"mcmc: dp={dp} tp={tp} cost={cost:.1f}us "
                    f"mem={mem/1e9:.2f}GB")
         r = SearchResult(strategies, axes, cost, mem, [log[-1]])
-        if best is None or r.cost_us < best.cost_us:
+        # honor the memory-aware flags the Unity path honors via its
+        # lambda search: an over-budget strategy only wins when nothing
+        # fits (then the caller sees the same loud log the Unity path logs)
+        over = (config.memory_search
+                and mem > config.memory_budget_mb * 1e6)
+        best_over = (best is not None and config.memory_search
+                     and best.memory_bytes > config.memory_budget_mb * 1e6)
+        if best is None:
+            best = r
+        elif over != best_over:
+            if not over:
+                best = r
+        elif r.cost_us < best.cost_us:
             best = r
     best.log = log + [f"mcmc selected: {best.mesh_axes} "
                       f"cost={best.cost_us:.1f}us"]

@@ -132,6 +132,13 @@ class SearchResult:
     cost_us: float
     memory_bytes: float
     log: List[str]
+    # graph rewrites the search MATERIALIZED before choosing strategies —
+    # exported so the --import path can replay them and op names match
+    # (reference analog: the imported strategy file keys by guid hashes
+    # that encode the rewritten graph, model.cc:3609-3617)
+    applied_rewrites: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+    greedy_search_rules: bool = False
 
 
 class GraphSearchHelper:
@@ -295,6 +302,7 @@ class GraphSearchHelper:
             applied2 = apply_substitutions(self.graph, search_rules)
             if applied2:
                 self.log.append(f"greedy substitutions: {applied2}")
+            self._greedy_search_rules_ran = bool(applied2)
 
         def select(lam: float, final: bool = True) -> SearchResult:
             if joint:
@@ -323,6 +331,8 @@ class GraphSearchHelper:
             _log.info(self.log[-1])
             self.sim.measured.save()
         best.log = self.log
+        if getattr(self, "_greedy_search_rules_ran", False):
+            best.greedy_search_rules = True
         return best
 
     def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
@@ -408,12 +418,85 @@ class GraphSearchHelper:
                              [f"dp={dp} tp={tp} ep={ep} ap={ap} sp={sp} "
                               f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
             )
+        candidates.extend(
+            self._pipeline_candidates(graph, batch_size, n_devices))
         if not candidates:
             raise ValueError("no feasible mesh factorization")
         best = min(candidates, key=lambda r: r.cost_us + lam * r.memory_bytes)
         if not quiet:
             self.log.extend(c.log[0] for c in candidates)
         return best
+
+    def _pipeline_candidates(self, graph: Graph, batch_size: int,
+                             n_devices: int) -> List[SearchResult]:
+        """Pipeline-parallel mesh candidates (NEW vs the reference — its
+        OP_PIPELINE enum ffconst.h:159 is unused): a (dp, pp) mesh routes
+        the graph's repeated-block region through the GPipe kernel. Priced
+        as region_cost * (M+S-1)/(M*S) — the bubble-inclusive GPipe
+        schedule length — plus 2(M+S-1) activation ppermute hops, with
+        region weights/optimizer state sharded S-ways (the memory win the
+        lambda search can buy when dp replication does not fit)."""
+        if (not getattr(self.config, "enable_pipeline_parallel", False)
+                or self.config.only_data_parallel):
+            return []
+        from ..parallel.pipeline_plan import find_isomorphic_run
+
+        # the lambda search re-enters per probe with an unchanged graph:
+        # cache the run finder (keyed by the op-guid set, which every
+        # rewrite changes) rather than re-scanning O(period * segs^2 * V)
+        if not hasattr(self, "_pp_run_cache"):
+            self._pp_run_cache = {}
+        key = frozenset(graph.ops)
+        if key not in self._pp_run_cache:
+            self._pp_run_cache[key] = find_isomorphic_run(graph)
+        run_len, run, entries = self._pp_run_cache[key]
+        if run_len < 2:
+            return []
+        m = max(1, getattr(self.config, "pipeline_microbatches", 4))
+        if batch_size % m:
+            return []
+        entry = entries[0]
+        import numpy as np
+
+        act_elems = int(np.prod(entry.dims[1:]))  # per-sample activation
+        act_bytes_el = 2 if self.config.allow_mixed_precision else 4
+        out: List[SearchResult] = []
+        for dp, pp in _divisor_pairs(n_devices):
+            if pp <= 1 or pp > run_len:
+                continue
+            if batch_size % dp or (batch_size // m) % dp:
+                continue
+            # the executor pipelines the largest multiple of pp groups and
+            # runs the rest sequentially (pipeline_plan truncation) — price
+            # the same split
+            usable = (run_len // pp) * pp
+            region = {op.guid for g in run[:usable] for op in g}
+            strategies = {guid: OpStrategy(dp=dp, tp=1)
+                          for guid in graph.ops}
+            region_cost = rest_cost = 0.0
+            mem = 0.0
+            for guid, op in graph.ops.items():
+                t = self.sim.op_step_time_us(op, strategies[guid])
+                om = self.sim.cost.op_memory_bytes(op, strategies[guid])
+                if guid in region:
+                    region_cost += t
+                    mem += om / pp
+                else:
+                    rest_cost += t
+                    mem += om
+            hop_bytes = (batch_size // m // dp) * act_elems * act_bytes_el
+            hop_us = self.machine.p2p_time_us(hop_bytes)
+            ticks = m + pp - 1
+            cost = (rest_cost
+                    + region_cost * ticks / (m * pp)
+                    + 2.0 * ticks * hop_us)
+            axes = ({"data": dp} if dp > 1 else {})
+            axes["stage"] = pp
+            out.append(SearchResult(
+                strategies, axes, cost, mem,
+                [f"dp={dp} pp={pp} m={m} "
+                 f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"]))
+        return out
 
     def _boundary_ops(self, graph: Graph) -> List[Op]:
         """Ops with an edge crossing a segment boundary — the only ops whose
@@ -600,6 +683,7 @@ class GraphSearchHelper:
                 f"joint: applied {[(r, d) for r, _, d in best_seq]}")
             best_res = self._parallelize(self.graph, batch_size, n_devices,
                                          lam=lam, quiet=True)
+            best_res.applied_rewrites = [(r, d) for r, _, d in best_seq]
             self.log.append(
                 f"joint: post-rewrite {best_res.log[0] if best_res.log else ''}")
         return best_res
@@ -740,6 +824,10 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         "mesh_axes": result.mesh_axes,
         "cost_us": result.cost_us,
         "memory_bytes": result.memory_bytes,
+        # rewrites the search materialized: the import path replays these
+        # (by rule + description) so op names in "ops" resolve
+        "applied_rewrites": list(result.applied_rewrites),
+        "greedy_search_rules": result.greedy_search_rules,
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
                                    "ap": s.ap, "sp": s.sp,
@@ -752,10 +840,34 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         json.dump(data, f, indent=2)
 
 
-def import_strategy(graph: Graph, path: str) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
-    """Load a strategy exported by export_strategy (reference: --import)."""
+def import_strategy(graph: Graph, path: str,
+                    rules=None) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
+    """Load a strategy exported by export_strategy (reference: --import).
+
+    rules: the search-rule registry (search_rules_from_spec) — needed to
+    replay the trade-off rewrites the exporting search materialized, so
+    rule-created op names in the file resolve against this graph."""
     with open(path) as f:
         data = json.load(f)
+    if rules:
+        from .substitution import apply_substitutions
+
+        if data.get("greedy_search_rules"):
+            apply_substitutions(graph, rules)
+        for rule_name, desc in data.get("applied_rewrites", []):
+            if rule_name not in rules:
+                _log.warning("import_strategy: unknown rewrite rule %r "
+                             "in strategy file", rule_name)
+                continue
+            for a in rules[rule_name](graph):
+                if a.description == desc:
+                    a.apply()
+                    break
+            else:
+                _log.warning(
+                    "import_strategy: recorded rewrite %s(%s) did not "
+                    "re-match on this graph — its op entries may fall "
+                    "back to default strategies", rule_name, desc)
     by_name = {op.name: op for op in graph.ops.values()}
     strategies = {}
     unmatched = []
