@@ -165,6 +165,19 @@ class Graph:
         src_guids = {s.guid for s in sources}
         return [op for op in order if op.guid in common and op.guid not in src_guids]
 
+    def segments(self) -> List[List["Op"]]:
+        """Topo-ordered ops split after each bottleneck node — shared by the
+        Unity sequence-split DP and the pipeline-stage planner (so both
+        always agree on segment boundaries)."""
+        order = self.topo_order()
+        bottlenecks = {op.guid for op in self.bottleneck_nodes()}
+        out: List[List[Op]] = [[]]
+        for op in order:
+            out[-1].append(op)
+            if op.guid in bottlenecks:
+                out.append([])
+        return [s for s in out if s]
+
     # -- cloning (for search over candidate rewritten graphs) --------------
     def clone(self) -> "Graph":
         """Structural copy for substitution search: new Op shells (shared

@@ -67,16 +67,9 @@ class PipelinePlan:
 
 
 def _segments_of(graph: Graph) -> List[List[Op]]:
-    """Topo-ordered ops split after each bottleneck node (the Unity
-    sequence-split segmentation, search/unity.py _segments)."""
-    order = graph.topo_order()
-    bottlenecks = {op.guid for op in graph.bottleneck_nodes()}
-    segments: List[List[Op]] = [[]]
-    for op in order:
-        segments[-1].append(op)
-        if op.guid in bottlenecks:
-            segments.append([])
-    return [s for s in segments if s]
+    """Topo-ordered ops split after each bottleneck node (core/graph.py
+    segments — one implementation shared with the Unity sequence-split DP)."""
+    return graph.segments()
 
 
 def _entry_tensor(prev_seg: List[Op]) -> Optional[Tensor]:
@@ -153,6 +146,12 @@ def find_isomorphic_run(
     n = len(segs)
     best: Tuple[int, List[List[Op]], List[Tensor]] = (0, [], [])
     best_score = (-1, -1)  # (ops covered, groups)
+    # tensor guid -> consumer op guids, computed once (the per-candidate
+    # escape check below would otherwise rescan every op's inputs)
+    consumers_of: Dict[int, Set[int]] = {}
+    for c in graph.ops.values():
+        for t in c.inputs:
+            consumers_of.setdefault(t.guid, set()).add(c.guid)
 
     for p in range(1, min(MAX_PERIOD, max(1, (n - 1) // 2)) + 1):
         for i in range(1, n):  # segment 0 holds graph inputs: never in a run
@@ -175,9 +174,7 @@ def find_isomorphic_run(
                 # the group entry must be consumed only inside the group —
                 # a residual skipping a whole stage cannot ride the carry
                 gset = {op.guid for op in group}
-                consumers = {c.guid for c in graph.ops.values()
-                             if any(t.guid == entry.guid for t in c.inputs)}
-                if not consumers <= gset:
+                if not consumers_of.get(entry.guid, set()) <= gset:
                     break
                 sig = _segment_signature(group, entry.guid)
                 if sig is None:

@@ -449,11 +449,17 @@ def search_rules_from_spec(spec, is_taso: bool, parsed=None) -> Dict[str, Callab
     if spec is None:
         return dict(SEARCH_RULES)
     if is_taso:
+        from .graph_xfer import xfers_from_rules
         from .substitution_loader import rules_from_spec, xfer_templates_from_rules
 
-        names = xfer_templates_from_rules(
-            parsed if parsed is not None else rules_from_spec(spec))
-        return {n: SEARCH_RULES[n] for n in names if n in SEARCH_RULES}
+        rules = parsed if parsed is not None else rules_from_spec(spec)
+        names = xfer_templates_from_rules(rules)
+        out = {n: SEARCH_RULES[n] for n in names if n in SEARCH_RULES}
+        # every supported loaded rule is ALSO an executable GraphXfer —
+        # source->target matching/replacement, not just template activation
+        # (reference: create_xfers, substitution.h:119-121)
+        out.update(xfers_from_rules(rules))
+        return out
     names = spec.get("rules", [])
     return {n: SEARCH_RULES[n] for n in names if n in SEARCH_RULES}
 

@@ -183,14 +183,7 @@ class GraphSearchHelper:
     # -- sequence split (reference: generic_sequence_optimize, memoized) --
     def _segments(self, graph: Optional[Graph] = None) -> List[List[Op]]:
         graph = graph if graph is not None else self.graph
-        order = graph.topo_order()
-        bottlenecks = {op.guid for op in graph.bottleneck_nodes()}
-        segments: List[List[Op]] = [[]]
-        for op in order:
-            segments[-1].append(op)
-            if op.guid in bottlenecks:
-                segments.append([])
-        return [s for s in segments if s]
+        return graph.segments()
 
     def _segment_cost(self, seg_graph: Graph, strategies: Dict[int, OpStrategy],
                       lam: float = 0.0) -> float:
@@ -442,14 +435,20 @@ class GraphSearchHelper:
         from ..parallel.pipeline_plan import find_isomorphic_run
 
         # the lambda search re-enters per probe with an unchanged graph:
-        # cache the run finder (keyed by the op-guid set, which every
-        # rewrite changes) rather than re-scanning O(period * segs^2 * V)
-        if not hasattr(self, "_pp_run_cache"):
-            self._pp_run_cache = {}
-        key = frozenset(graph.ops)
-        if key not in self._pp_run_cache:
-            self._pp_run_cache[key] = find_isomorphic_run(graph)
-        run_len, run, entries = self._pp_run_cache[key]
+        # cache the run finder rather than re-scanning. Only the REAL graph
+        # is cached (keyed by its op-guid set, which every rewrite changes);
+        # joint-search probe clones are transient and caching them would
+        # pin every discarded clone in memory for the helper's lifetime
+        if graph is self.graph:
+            if not hasattr(self, "_pp_run_cache"):
+                self._pp_run_cache = {}
+            key = frozenset(graph.ops)
+            if key not in self._pp_run_cache:
+                self._pp_run_cache.clear()  # rewrites invalidated the old
+                self._pp_run_cache[key] = find_isomorphic_run(graph)
+            run_len, run, entries = self._pp_run_cache[key]
+        else:
+            run_len, run, entries = find_isomorphic_run(graph)
         if run_len < 2:
             return []
         m = max(1, getattr(self.config, "pipeline_microbatches", 4))
@@ -859,15 +858,22 @@ def import_strategy(graph: Graph, path: str,
                 _log.warning("import_strategy: unknown rewrite rule %r "
                              "in strategy file", rule_name)
                 continue
-            for a in rules[rule_name](graph):
-                if a.description == desc:
-                    a.apply()
-                    break
-            else:
+            hits = [a for a in rules[rule_name](graph)
+                    if a.description == desc]
+            if not hits:
                 _log.warning(
                     "import_strategy: recorded rewrite %s(%s) did not "
                     "re-match on this graph — its op entries may fall "
                     "back to default strategies", rule_name, desc)
+                continue
+            if len(hits) > 1:
+                # descriptions can collide (substitution.py Application):
+                # the replay may pick a different match than the exporter
+                _log.warning(
+                    "import_strategy: rewrite %s(%s) matches %d sites — "
+                    "applying the first; the exported strategy may refer "
+                    "to a different one", rule_name, desc, len(hits))
+            hits[0].apply()
     by_name = {op.name: op for op in graph.ops.values()}
     strategies = {}
     unmatched = []
