@@ -13,43 +13,57 @@ from ..core.tensor import Tensor
 from ..ffconst import ActiMode, AggrMode, DataType, PoolType
 
 
-def _require_onnx():
+def _backend():
+    """The onnx package when installed, else the built-in pure-Python wire
+    codec (onnx/wire.py) — the importer runs either way."""
     try:
         import onnx  # noqa: F401
 
         return onnx
-    except ImportError as e:  # pragma: no cover - environment dependent
-        raise ImportError(
-            "the onnx package is required for flexflow_tpu.onnx; install onnx "
-            "or use the torch.fx / keras frontends"
-        ) from e
+    except ImportError:
+        from . import wire
+
+        return wire
 
 
 def _attrs(node) -> Dict:
-    import onnx
-
-    out = {}
-    for a in node.attribute:
-        out[a.name] = onnx.helper.get_attribute_value(a)
-    return out
+    be = _backend()
+    get = (be.helper.get_attribute_value if hasattr(be, "helper")
+           else be.get_attribute_value)
+    return {a.name: get(a) for a in node.attribute}
 
 
 class ONNXModel:
     """Replays an ONNX graph as flexflow_tpu layer calls."""
 
     def __init__(self, path_or_proto):
-        onnx = _require_onnx()
-        if isinstance(path_or_proto, (str, bytes)):
-            self.model = onnx.load(path_or_proto)
+        be = _backend()
+        if isinstance(path_or_proto, bytes):
+            # serialized proto bytes: the wire codec takes them directly;
+            # the onnx package parses via its proto class
+            if hasattr(be, "ModelProto"):
+                m = be.ModelProto()
+                m.ParseFromString(path_or_proto)
+                self.model = m
+            else:
+                self.model = be.load(path_or_proto)
+        elif isinstance(path_or_proto, str):
+            self.model = be.load(path_or_proto)
         else:
             self.model = path_or_proto
         self.graph = self.model.graph
         self.inits = {i.name: i for i in self.graph.initializer}
 
     def _init_array(self, name):
-        import onnx.numpy_helper as nph
+        t = self.inits[name]
+        try:
+            import onnx.numpy_helper as nph
 
-        return nph.to_array(self.inits[name])
+            return nph.to_array(t)
+        except ImportError:
+            from .wire import to_array
+
+            return to_array(t)
 
     def apply(self, ffmodel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
         env: Dict[str, object] = {}

@@ -27,16 +27,13 @@ import-strategy replay exactly like the hand-written algebraic rules.
 """
 from __future__ import annotations
 
-import itertools
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
-from .substitution import Application
+from .substitution import Application, _rewire
 from .substitution_loader import PARALLEL_OPS, Rule
-
-_uid = itertools.count(1)
 
 # dst parallel-op constructors: OpType -> (class path resolved lazily)
 _PARALLEL_CLS = {
@@ -81,6 +78,12 @@ class GraphXfer:
         by_type: Dict[OpType, List[Op]] = {}
         for op in graph.topo_order():
             by_type.setdefault(op.op_type, []).append(op)
+        # tensor guid -> consumer guids, once per scan (escape checks)
+        consumers_of: Dict[int, set] = {}
+        for c in graph.ops.values():
+            for t in c.inputs:
+                consumers_of.setdefault(t.guid, set()).add(c.guid)
+        self._consumers_of = consumers_of
         matches: List[Tuple[List[Op], Dict]] = []
         binding: List[Optional[Op]] = [None] * len(src)
         bound_guids = set()
@@ -98,6 +101,13 @@ class GraphXfer:
                 if op.guid in bound_guids:
                     continue
                 if len(pat.inputs) > len(op.inputs):
+                    continue
+                # don't stack onto this rule's own output: a compute op
+                # already fed by a parallel op this rule created would
+                # re-match forever (replicate(replicate(...)))
+                if any(t.owner_op is not None
+                       and t.owner_op.name.startswith(self.rule.name)
+                       for t in op.inputs):
                     continue
                 if pat.is_parallel_op and not self._params_match(pat, op):
                     continue
@@ -160,11 +170,8 @@ class GraphXfer:
             for ts, t in enumerate(op.outputs):
                 if (i, ts) in mapped:
                     continue
-                for o in graph.ops.values():
-                    if o.guid in matched:
-                        continue
-                    if any(x.guid == t.guid for x in o.inputs):
-                        return False  # interior tensor escapes the match
+                if self._consumers_of.get(t.guid, set()) - matched:
+                    return False  # interior tensor escapes the match
         # feasibility of dst partition/combine degrees against real shapes
         dims_of: Dict[Tuple[int, int], Tuple[int, ...]] = {}
         for j, o in enumerate(self.rule.dst_ops):
@@ -216,8 +223,12 @@ class GraphXfer:
                 kwargs = {"degree": o.parallel_degree or 1}
                 if o.op_type != OpType.REPLICATE:
                     kwargs["dim"] = o.parallel_dim or 0
+                # deterministic name from the match site: a replayed
+                # rewrite (strategy --import) recreates the SAME names, so
+                # exported per-op strategy entries resolve
                 op_new = cls(model, [ins[0]],
-                             name=f"{rule.name}_{j}_{next(_uid)}", **kwargs)
+                             name=f"{rule.name}.{j}.{binding[0].name}",
+                             **kwargs)
                 graph.add_op(op_new)
                 new_guids.add(op_new.guid)
             else:
@@ -233,16 +244,8 @@ class GraphXfer:
         for m in rule.mapped_outputs:
             old = binding[m.src_op_id].outputs[m.src_ts_id]
             new = dst_vals[(m.dst_op_id, m.dst_ts_id)]
-            if old.guid == new.guid:
-                continue
-            skip = new_guids | reused
-            for o in graph.ops.values():
-                if o.guid in skip:
-                    continue
-                for i, t in enumerate(o.inputs):
-                    if t.guid == old.guid:
-                        o.inputs[i] = new
-            graph.tensor_aliases[old.guid] = new
+            if old.guid != new.guid:
+                _rewire(graph, old, new, skip_guids=new_guids | reused)
 
         # drop src ops that were not reused as dst compute ops
         for i, op in enumerate(binding):
@@ -257,5 +260,12 @@ def xfers_from_rules(rules: List[Rule]) -> Dict[str, Callable]:
     for r in rules:
         x = GraphXfer(r)
         if x.supported:
-            out[x.name] = x.find_applications
+            def fn(graph, _x=x):
+                return _x.find_applications(graph)
+
+            # xfers insert parallel-op chains — a cost TRADE-OFF, not a
+            # strict shrink: apply_substitutions' greedy fixed-point pass
+            # must skip them (only the budgeted joint search applies them)
+            fn.trade_off = True
+            out[x.name] = fn
     return out

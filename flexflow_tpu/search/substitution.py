@@ -53,8 +53,14 @@ def _consumers(graph: Graph, op: Op) -> List[Op]:
     ]
 
 
-def _rewire(graph: Graph, old_tensor, new_tensor) -> None:
+def _rewire(graph: Graph, old_tensor, new_tensor, skip_guids=()) -> None:
+    """Point every consumer of old_tensor at new_tensor (and record the
+    alias for resolve_tensor). skip_guids: ops whose inputs were already
+    wired explicitly — e.g. a rewrite's own created ops, which must keep
+    consuming the old tensor or the rewrite would cycle through itself."""
     for o in graph.ops.values():
+        if o.guid in skip_guids:
+            continue
         for i, t in enumerate(o.inputs):
             if t.guid == old_tensor.guid:
                 o.inputs[i] = new_tensor
@@ -505,7 +511,12 @@ def apply_substitutions(graph: Graph, rules: Optional[Dict[str, Callable]] = Non
     (the reference explores rewrites via best-first search because its rules
     can be cost-neutral-or-worse locally; every rule here strictly shrinks
     the traced program, so greedy-to-fixed-point is optimal)."""
-    rules = rules or ALL_RULES
+    # trade-off rewrites (fn.trade_off, e.g. loaded GraphXfers inserting
+    # partition/combine chains) are NOT strictly shrinking: greedily
+    # applying them diverges (each application re-matches its own output).
+    # They are joint-search actions only; the greedy pass filters them out.
+    rules = {n: fn for n, fn in (rules or ALL_RULES).items()
+             if not getattr(fn, "trade_off", False)}
     applied: List[str] = []
     for _ in range(max_passes):
         apps: List[Application] = []

@@ -45,6 +45,9 @@ def _build_onnx(model_dir: str, cfg: dict):
     if not inputs_spec:
         raise ValueError(f"{model_dir}: onnx models need config 'inputs'")
     config.batch_size = int(inputs_spec[0]["dims"][0])
+    # bf16 activations by default (TPU-friendly); a repository entry can
+    # pin exact f32 serving with "mixed_precision": false
+    config.allow_mixed_precision = bool(cfg.get("mixed_precision", True))
     model = ff.FFModel(config)
     tensors = []
     for spec in inputs_spec:
@@ -57,7 +60,14 @@ def _build_onnx(model_dir: str, cfg: dict):
     model.final_tensor = outs[-1] if isinstance(outs, (list, tuple)) else outs
     model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
                   loss_type=ff.LossType.LOSS_IDENTITY)
-    onnx_model.transfer_weights(model)
+    copied = onnx_model.transfer_weights(model)
+    expected = sum(len(v) for v in onnx_model._pending_weights.values())
+    if copied < expected:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s: only %d of %d ONNX weights matched the compiled model — "
+            "the rest keep their random init", model_dir, copied, expected)
     return model
 
 

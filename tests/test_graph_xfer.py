@@ -102,6 +102,33 @@ def test_xfer_degree_feasibility():
             g.topo_order()  # still a DAG
 
 
+def test_xfers_excluded_from_greedy_fixed_point():
+    """Trade-off xfers must NOT diverge the greedy apply_substitutions loop
+    (each application re-matches its own output); they are joint-search
+    actions only."""
+    from flexflow_tpu.search.substitution import apply_substitutions
+
+    model, _ = _linear_model()
+    g = Graph(model.ops)
+    n_before = len(g.ops)
+    rules = load_substitution_file(RULES_PATH)
+    applied = apply_substitutions(g, xfers_from_rules(rules))
+    assert applied == [] and len(g.ops) == n_before
+
+
+def test_xfer_does_not_stack_on_own_output():
+    """Applying an xfer once removes the site from its own match set."""
+    model, _ = _linear_model()
+    g = Graph(model.ops)
+    rules = load_substitution_file(RULES_PATH)
+    xfers = xfers_from_rules(rules)
+    name = next(n for n in xfers if "partition_linear_combine_d2" in n)
+    apps = xfers[name](g)
+    assert len(apps) == 1
+    apps[0].apply()
+    assert xfers[name](g) == []
+
+
 def test_xfer_joint_search_integration():
     """The joint search sees loaded xfers as actions and compile() runs end
     to end with a TASO rule file + search budget."""

@@ -1,53 +1,38 @@
 """keras_exp frontend: tf.keras-via-ONNX replay (reference:
 python/flexflow/keras_exp/models/model.py). Without tensorflow in the image,
-the test feeds the ONNX form a tf.keras export would produce."""
+the test feeds the ONNX form a tf.keras export would produce (authored with
+the built-in wire codec, so it runs with or without the onnx package)."""
 import numpy as np
 import pytest
 
 import flexflow_tpu as ff
-
-try:
-    import onnx  # noqa: F401
-
-    HAS_ONNX = True
-except ImportError:
-    HAS_ONNX = False
+from flexflow_tpu.onnx import wire
 
 
 def _keras_style_onnx():
     """The graph tf2onnx emits for a Dense->ReLU->Dense keras model
     (Gemm with transB, keras-style initializer names)."""
-    import onnx.helper as oh
-    import onnx.numpy_helper as nph
-
     rng = np.random.RandomState(0)
     w1 = rng.randn(16, 20).astype(np.float32)  # (out, in), transB=1
     b1 = rng.randn(16).astype(np.float32)
     w2 = rng.randn(4, 16).astype(np.float32)
     b2 = rng.randn(4).astype(np.float32)
     nodes = [
-        oh.make_node("Gemm", ["x", "dense/kernel", "dense/bias"], ["h"],
-                     name="dense", transB=1),
-        oh.make_node("Relu", ["h"], ["hr"], name="re_lu"),
-        oh.make_node("Gemm", ["hr", "dense_1/kernel", "dense_1/bias"], ["y"],
-                     name="dense_1", transB=1),
-        oh.make_node("Softmax", ["y"], ["prob"], name="softmax"),
+        wire.make_node("Gemm", ["x", "dense/kernel", "dense/bias"], ["h"],
+                       name="dense", transB=1),
+        wire.make_node("Relu", ["h"], ["hr"], name="re_lu"),
+        wire.make_node("Gemm", ["hr", "dense_1/kernel", "dense_1/bias"],
+                       ["y"], name="dense_1", transB=1),
+        wire.make_node("Softmax", ["y"], ["prob"], name="softmax"),
     ]
-    graph = oh.make_graph(
-        nodes, "keras_mlp",
-        [oh.make_tensor_value_info("x", 1, [8, 20])],
-        [oh.make_tensor_value_info("prob", 1, [8, 4])],
-        initializer=[
-            nph.from_array(w1, "dense/kernel"),
-            nph.from_array(b1, "dense/bias"),
-            nph.from_array(w2, "dense_1/kernel"),
-            nph.from_array(b2, "dense_1/bias"),
-        ],
-    )
-    return oh.make_model(graph), (w1, b1, w2, b2)
+    proto = wire.make_model(
+        nodes, {"x": (8, 20)}, {"prob": (8, 4)},
+        {"dense/kernel": w1, "dense/bias": b1,
+         "dense_1/kernel": w2, "dense_1/bias": b2},
+        name="keras_mlp")
+    return proto, (w1, b1, w2, b2)
 
 
-@pytest.mark.skipif(not HAS_ONNX, reason="onnx not installed")
 def test_keras_exp_model_builds_and_trains():
     from flexflow_tpu.keras_exp import Model
 
@@ -61,6 +46,26 @@ def test_keras_exp_model_builds_and_trains():
     y = np.zeros((8, 1), dtype=np.int32)
     hist = m.fit([x], y, batch_size=8, epochs=1)
     assert np.isfinite(hist[0]["loss"])
+
+
+def test_keras_exp_weights_transfer():
+    """The imported keras weights produce the same forward as numpy."""
+    from flexflow_tpu.keras_exp import Model
+
+    proto, (w1, b1, w2, b2) = _keras_style_onnx()
+    m = Model(proto, batch_size=8)
+    m.config.allow_mixed_precision = False
+    ffmodel = m.build([[8, 20]])
+    m.compile(optimizer=ff.SGDOptimizer(ffmodel, lr=0.0),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[])
+    x = np.random.RandomState(1).randn(8, 20).astype(np.float32)
+    ours = ffmodel.predict(x)
+    h = np.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)
 
 
 def test_keras_exp_live_tf_needs_tensorflow():

@@ -121,39 +121,30 @@ def test_model_repository_loads_and_serves(tmp_path):
     """Triton's primary UX: a directory per model (config + artifact) that
     the server scans and loads (reference: triton/src/model.cc per-dir
     loading)."""
-    pytest.importorskip("onnx")
-    import onnx.helper as oh
-    import onnx.numpy_helper as nph
-
+    from flexflow_tpu.onnx import wire
     from flexflow_tpu.serving import ModelRepository
 
     rng = np.random.RandomState(0)
     w1 = rng.randn(6, 12).astype(np.float32)
     w2 = rng.randn(12, 3).astype(np.float32)
     nodes = [
-        oh.make_node("MatMul", ["x", "w1"], ["h"], name="fc1"),
-        oh.make_node("Relu", ["h"], ["hr"], name="relu1"),
-        oh.make_node("MatMul", ["hr", "w2"], ["y"], name="fc2"),
+        wire.make_node("MatMul", ["x", "w1"], ["h"], name="fc1"),
+        wire.make_node("Relu", ["h"], ["hr"], name="relu1"),
+        wire.make_node("MatMul", ["hr", "w2"], ["y"], name="fc2"),
     ]
-    graph = oh.make_graph(
-        nodes, "mlp",
-        [oh.make_tensor_value_info("x", 1, [8, 6])],
-        [oh.make_tensor_value_info("y", 1, [8, 3])],
-        initializer=[nph.from_array(w1, "w1"), nph.from_array(w2, "w2")],
-    )
-    proto = oh.make_model(graph)
+    proto = wire.make_model(nodes, {"x": (8, 6)}, {"y": (8, 3)},
+                            {"w1": w1, "w2": w2}, name="mlp")
 
     mdir = tmp_path / "mlp"
     mdir.mkdir()
-    import onnx
-
-    onnx.save(proto, str(mdir / "model.onnx"))
+    wire.save(proto, str(mdir / "model.onnx"))
     (mdir / "config.json").write_text(json.dumps({
         "format": "onnx",
         "file": "model.onnx",
         "inputs": [{"dims": [8, 6], "dtype": "float32"}],
         "max_batch_size": 8,
         "batch_buckets": [1, 4, 8],
+        "mixed_precision": False,  # exact f32 so the allclose stays strict
     }))
 
     repo = ModelRepository(str(tmp_path))
