@@ -56,6 +56,10 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 1.2
     base_optimize_threshold: int = 10
+    # mesh factorizations that get the expensive cross-segment best-first
+    # refinement (the rest keep their segment-DP strategies); raise for
+    # exhaustiveness, lower for compile latency on big graphs
+    refine_top_k: int = 4
     # Joint substitution x parallelization search: graph rewrites are
     # best-first search actions costed by their optimal parallelization
     # (reference: base_optimize over candidate graphs, substitution.cc:2229).
@@ -165,6 +169,8 @@ class FFConfig:
                 self.search_alpha = float(take())
             elif a == "--base-optimize-threshold":
                 self.base_optimize_threshold = int(take())
+            elif a == "--refine-top-k":
+                self.refine_top_k = int(take())
             elif a == "--strategy-search":
                 v = take()
                 if v not in ("unity", "mcmc"):

@@ -47,9 +47,16 @@ class Graph:
 
     def add_op(self, op: Op) -> None:
         self.ops[op.guid] = op
+        self._topo_cache = None
 
     def remove_op(self, op: Op) -> None:
         del self.ops[op.guid]
+        self._topo_cache = None
+
+    def invalidate_topo(self) -> None:
+        """Call after rewiring op inputs in place (edge changes the
+        add/remove hooks can't see)."""
+        self._topo_cache = None
 
     def __len__(self):
         return len(self.ops)
@@ -90,6 +97,17 @@ class Graph:
 
     # -- traversal --------------------------------------------------------
     def topo_order(self) -> List[Op]:
+        # cached: the event-driven simulator walks the order once per
+        # candidate costing (thousands of times per search); every graph
+        # mutation path (add_op/remove_op/_rewire) invalidates
+        cached = getattr(self, "_topo_cache", None)
+        if cached is not None:
+            return cached
+        order = self._topo_order_uncached()
+        self._topo_cache = order
+        return order
+
+    def _topo_order_uncached(self) -> List[Op]:
         indeg: Dict[int, int] = {g: 0 for g in self.ops}
         succ: Dict[int, List[int]] = defaultdict(list)
         for e in self.edges():

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import math
 from typing import List, Optional, Sequence, Tuple, TYPE_CHECKING
 
 import numpy as np
@@ -147,7 +148,9 @@ class Tensor:
         return len(self.dims)
 
     def num_elements(self) -> int:
-        return int(np.prod(self.dims)) if self.dims else 1
+        # math.prod, not np.prod: this sits on the search's hottest path
+        # (cost model shape math, ~1e6 calls per big search)
+        return math.prod(self.dims) if self.dims else 1
 
     # -- host I/O (reference: parallel_tensor.h:164-169 set_tensor/get_tensor)
     def set_tensor(self, model, value: np.ndarray) -> bool:

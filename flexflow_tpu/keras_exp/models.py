@@ -74,16 +74,9 @@ class Model:
             **kwargs,
         )
         # carry the keras-trained weights over (reference keras_exp keeps
-        # the tf weights; here they arrive as ONNX initializers)
-        copied = self._onnx.transfer_weights(self.ffmodel)
-        expected = sum(len(v) for v in self._onnx._pending_weights.values())
-        if copied < expected:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "keras_exp: only %d of %d imported weights matched the "
-                "compiled model (graph rewrites may have renamed ops) — "
-                "the rest keep their random init", copied, expected)
+        # the tf weights; here they arrive as ONNX initializers —
+        # transfer_weights warns on any shortfall)
+        self._onnx.transfer_weights(self.ffmodel)
         return self.ffmodel
 
     def fit(self, x, y, **kwargs):

@@ -256,7 +256,9 @@ class ONNXModel:
         }
 
     def transfer_weights(self, ffmodel) -> int:
-        """Copy the ONNX initializer values into the compiled FFModel."""
+        """Copy the ONNX initializer values into the compiled FFModel.
+        Warns when imported weights fail to match (e.g. compile-time graph
+        rewrites renamed/merged ops) — those ops keep their random init."""
         import jax.numpy as jnp
 
         copied = 0
@@ -269,6 +271,14 @@ class ONNXModel:
                         ffmodel.params[name][key].dtype
                     )
                     copied += 1
+        expected = sum(len(v) for v in (self._pending_weights or {}).values())
+        if copied < expected:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ONNX import: only %d of %d weights matched the compiled "
+                "model (graph rewrites may have renamed ops) — the rest "
+                "keep their random init", copied, expected)
         return copied
 
 

@@ -156,6 +156,7 @@ class TensorP:
         self.float_data: List[float] = []
         self.int32_data: List[int] = []
         self.int64_data: List[int] = []
+        self.double_data: List[float] = []
 
 
 class Attribute:
@@ -223,6 +224,9 @@ def _dec_tensor(b: bytes) -> TensorP:
             t.name = v.decode()
         elif fno == 9:
             t.raw_data = v
+        elif fno == 10:
+            t.double_data += (list(struct.unpack("<d", v)) if wt == 1
+                              else list(struct.unpack(f"<{len(v) // 8}d", v)))
     return t
 
 
@@ -324,7 +328,10 @@ def load(path_or_bytes) -> ModelP:
 
 
 def to_array(t: TensorP) -> np.ndarray:
-    """numpy_helper.to_array for the decoded TensorProto."""
+    """numpy_helper.to_array for the decoded TensorProto. Raises on
+    encodings this codec does not model rather than returning zeros."""
+    import math
+
     dt = np.dtype(_NP_OF.get(t.data_type, np.float32))
     if t.data_type == BFLOAT16:
         raw = np.frombuffer(t.raw_data, dtype=np.uint16)
@@ -333,11 +340,23 @@ def to_array(t: TensorP) -> np.ndarray:
         return np.frombuffer(t.raw_data, dtype=dt).reshape(t.dims).copy()
     if t.float_data:
         return np.asarray(t.float_data, dtype=dt).reshape(t.dims)
+    if t.double_data:
+        return np.asarray(t.double_data, dtype=dt).reshape(t.dims)
     if t.int64_data:
         return np.asarray(t.int64_data, dtype=dt).reshape(t.dims)
     if t.int32_data:
+        if t.data_type == FLOAT16:
+            # the ONNX spec stores fp16 payloads as uint16 bit patterns
+            # inside int32_data
+            raw = np.asarray(t.int32_data, dtype=np.uint16)
+            return raw.view(np.float16).reshape(t.dims)
         return np.asarray(t.int32_data, dtype=dt).reshape(t.dims)
-    return np.zeros(t.dims, dtype=dt)
+    if math.prod(t.dims or [1]) == 0:
+        return np.zeros(t.dims, dtype=dt)
+    raise ValueError(
+        f"ONNX initializer {t.name!r}: no payload this codec decodes "
+        f"(data_type={t.data_type}) — install the onnx package for full "
+        "TensorProto coverage")
 
 
 def get_attribute_value(a: Attribute):

@@ -102,11 +102,13 @@ class GraphXfer:
                     continue
                 if len(pat.inputs) > len(op.inputs):
                     continue
-                # don't stack onto this rule's own output: a compute op
-                # already fed by a parallel op this rule created would
-                # re-match forever (replicate(replicate(...)))
+                # don't stack onto ANY xfer's output (own or a sibling
+                # degree rule's): a compute op already fed by an
+                # xfer-created parallel op would re-match forever
+                # (replicate(replicate(...))) and re-applications would
+                # recreate duplicate deterministic names
                 if any(t.owner_op is not None
-                       and t.owner_op.name.startswith(self.rule.name)
+                       and t.owner_op.name.startswith("xfer.")
                        for t in op.inputs):
                     continue
                 if pat.is_parallel_op and not self._params_match(pat, op):
@@ -225,9 +227,10 @@ class GraphXfer:
                     kwargs["dim"] = o.parallel_dim or 0
                 # deterministic name from the match site: a replayed
                 # rewrite (strategy --import) recreates the SAME names, so
-                # exported per-op strategy entries resolve
+                # exported per-op strategy entries resolve. The "xfer."
+                # prefix doubles as the anti-restacking marker above.
                 op_new = cls(model, [ins[0]],
-                             name=f"{rule.name}.{j}.{binding[0].name}",
+                             name=f"xfer.{rule.name}.{j}.{binding[0].name}",
                              **kwargs)
                 graph.add_op(op_new)
                 new_guids.add(op_new.guid)
@@ -235,6 +238,7 @@ class GraphXfer:
                 op_new = binding[self.dst_pairing[j]]
                 for k, t in enumerate(ins):
                     op_new.inputs[k] = t
+                graph.invalidate_topo()  # in-place edge mutation
             for ts, t in enumerate(op_new.outputs):
                 dst_vals[(j, ts)] = t
 

@@ -65,6 +65,7 @@ def _rewire(graph: Graph, old_tensor, new_tensor, skip_guids=()) -> None:
             if t.guid == old_tensor.guid:
                 o.inputs[i] = new_tensor
     graph.tensor_aliases[old_tensor.guid] = new_tensor
+    graph.invalidate_topo()
 
 
 _ACT_OF = {

@@ -288,13 +288,23 @@ class GraphSearchHelper:
         joint = (getattr(self.config, "joint_search", True) and search_rules
                  and self.config.search_budget > 0)
         if not joint and search_rules and self.config.search_budget > 0:
-            # joint_search=False: trade-off rewrites degrade to the greedy
-            # fixed-point pass (the comparison baseline). joint_search=True
-            # with no budget applies none — matching the native-path gate so
-            # native availability never changes the compiled graph.
+            # joint_search=False: hand-written trade-off rewrites degrade to
+            # the greedy fixed-point pass (the comparison baseline). Loaded
+            # GraphXfers are excluded even here — greedy application of a
+            # non-shrinking rewrite diverges — and the skip is logged so the
+            # baseline isn't a silent no-op. joint_search=True with no
+            # budget applies none — matching the native-path gate so native
+            # availability never changes the compiled graph.
             applied2 = apply_substitutions(self.graph, search_rules)
             if applied2:
                 self.log.append(f"greedy substitutions: {applied2}")
+            skipped = [n for n, fn in search_rules.items()
+                       if getattr(fn, "trade_off", False)]
+            if skipped:
+                self.log.append(
+                    f"joint_search=False: {len(skipped)} loaded xfer rules "
+                    "not applied (joint-search actions only)")
+                _log.info(self.log[-1])
             self._greedy_search_rules_ran = bool(applied2)
 
         def select(lam: float, final: bool = True) -> SearchResult:
@@ -388,6 +398,15 @@ class GraphSearchHelper:
                         tuples.append((dp, tp, ep, ap, sp))
         if self.config.only_data_parallel:
             tuples = [(n_devices, 1, 1, 1, 1)]
+        # Stage 1 (cheap): per-segment DP + one full-graph simulate per mesh
+        # factorization. Stage 2 (expensive): the cross-segment best-first
+        # refinement — O(budget x boundary-ops x menu x simulate) — runs
+        # only on the top-K stage-1 candidates. Sweeping refinement over
+        # every factorization made a 24-layer/256-device search take
+        # minutes for factorizations that were never going to win
+        # (reference analog: graph.cc's memoized DP exists precisely to
+        # keep the 100+-op x many-machine-view regime tractable).
+        seeded = []
         for dp, tp, ep, ap, sp in tuples:
             if batch_size % dp != 0:
                 continue
@@ -396,20 +415,32 @@ class GraphSearchHelper:
                 strategies.update(
                     self._optimize_segment(seg, dp, tp, batch_size,
                                            ep=ep, ap=ap, sp=sp, lam=lam))
-            # cross-segment refinement: per-segment DP cannot see reshard
-            # costs across segment boundaries (e.g. the column->row TP
-            # pairing on a chain, where every node is its own segment) —
-            # re-optimize single-op flips against the FULL-graph simulate
-            strategies = self._refine_global(graph, strategies, dp, tp,
-                                             batch_size, ep, ap, lam, sp=sp)
             cost = self.sim.simulate(graph, strategies)
             mem = self.sim.memory_bytes(graph, strategies)
+            seeded.append((cost + lam * mem, (dp, tp, ep, ap, sp),
+                           strategies, cost, mem))
+        seeded.sort(key=lambda x: x[0])
+        top_k = max(1, int(getattr(self.config, "refine_top_k", 4)))
+        for rank, (obj, (dp, tp, ep, ap, sp), strategies, cost,
+                   mem) in enumerate(seeded):
+            if rank < top_k:
+                # cross-segment refinement: per-segment DP cannot see
+                # reshard costs across segment boundaries (e.g. the
+                # column->row TP pairing on a chain, where every node is
+                # its own segment) — re-optimize single-op flips against
+                # the FULL-graph simulate
+                strategies = self._refine_global(graph, strategies, dp, tp,
+                                                 batch_size, ep, ap, lam,
+                                                 sp=sp)
+                cost = self.sim.simulate(graph, strategies)
+                mem = self.sim.memory_bytes(graph, strategies)
             candidates.append(
                 SearchResult(strategies,
                              self._axes(dp, tp, strategies, ep, ap, sp),
                              cost, mem,
                              [f"dp={dp} tp={tp} ep={ep} ap={ap} sp={sp} "
-                              f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"])
+                              f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"
+                              + ("" if rank < top_k else " (unrefined)")])
             )
         candidates.extend(
             self._pipeline_candidates(graph, batch_size, n_devices))

@@ -60,14 +60,7 @@ def _build_onnx(model_dir: str, cfg: dict):
     model.final_tensor = outs[-1] if isinstance(outs, (list, tuple)) else outs
     model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
                   loss_type=ff.LossType.LOSS_IDENTITY)
-    copied = onnx_model.transfer_weights(model)
-    expected = sum(len(v) for v in onnx_model._pending_weights.values())
-    if copied < expected:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "%s: only %d of %d ONNX weights matched the compiled model — "
-            "the rest keep their random init", model_dir, copied, expected)
+    onnx_model.transfer_weights(model)  # warns on any shortfall
     return model
 
 
