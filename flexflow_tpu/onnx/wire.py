@@ -332,10 +332,23 @@ def to_array(t: TensorP) -> np.ndarray:
     encodings this codec does not model rather than returning zeros."""
     import math
 
-    dt = np.dtype(_NP_OF.get(t.data_type, np.float32))
     if t.data_type == BFLOAT16:
-        raw = np.frombuffer(t.raw_data, dtype=np.uint16)
+        # bf16 payloads arrive as uint16 bit patterns, in raw_data or (per
+        # the spec) packed into int32_data
+        if t.raw_data:
+            raw = np.frombuffer(t.raw_data, dtype=np.uint16)
+        elif t.int32_data:
+            raw = np.asarray(t.int32_data, dtype=np.uint16)
+        else:
+            raise ValueError(
+                f"ONNX initializer {t.name!r}: BFLOAT16 without raw_data/"
+                "int32_data payload")
         return (raw.astype(np.uint32) << 16).view(np.float32).reshape(t.dims)
+    if t.data_type not in _NP_OF:
+        raise ValueError(
+            f"ONNX initializer {t.name!r}: data_type={t.data_type} not "
+            "modeled by this codec — install the onnx package")
+    dt = np.dtype(_NP_OF[t.data_type])
     if t.raw_data:
         return np.frombuffer(t.raw_data, dtype=dt).reshape(t.dims).copy()
     if t.float_data:

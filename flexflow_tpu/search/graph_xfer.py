@@ -108,7 +108,7 @@ class GraphXfer:
                 # (replicate(replicate(...))) and re-applications would
                 # recreate duplicate deterministic names
                 if any(t.owner_op is not None
-                       and t.owner_op.name.startswith("xfer.")
+                       and getattr(t.owner_op, "xfer_created", False)
                        for t in op.inputs):
                     continue
                 if pat.is_parallel_op and not self._params_match(pat, op):
@@ -227,11 +227,11 @@ class GraphXfer:
                     kwargs["dim"] = o.parallel_dim or 0
                 # deterministic name from the match site: a replayed
                 # rewrite (strategy --import) recreates the SAME names, so
-                # exported per-op strategy entries resolve. The "xfer."
-                # prefix doubles as the anti-restacking marker above.
+                # exported per-op strategy entries resolve
                 op_new = cls(model, [ins[0]],
                              name=f"xfer.{rule.name}.{j}.{binding[0].name}",
                              **kwargs)
+                op_new.xfer_created = True  # anti-restacking marker
                 graph.add_op(op_new)
                 new_guids.add(op_new.guid)
             else:

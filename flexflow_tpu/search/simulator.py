@@ -65,11 +65,6 @@ class OpStrategy:
     def degree(self) -> int:
         return self.dp * self.tp * self.ep * self.ap * self.sp
 
-    def key(self) -> Tuple:
-        """Hashable identity over ALL fields — the one memo-key source for
-        every cost cache (a future field added here invalidates every memo
-        site at once instead of silently aliasing strategies)."""
-        return dataclasses.astuple(self)
 
 
 # ops whose weights/channels can shard over the model axis (reference:
@@ -317,7 +312,7 @@ class CostModel:
         memo = getattr(self, "_grad_sync_memo", None)
         if memo is None:
             memo = self._grad_sync_memo = {}
-        key = (op.guid,) + s.key()
+        key = (op.guid, s)
         hit = memo.get(key)
         if hit is not None:
             return hit
@@ -664,7 +659,9 @@ class Simulator:
         otherwise — one consistent source for both numbers. Memoized per
         (op, strategy): the refinement loop re-simulates the full graph per
         flip, re-querying every unchanged op (was ~60% of search time)."""
-        key = (op.guid,) + s.key()
+        # the frozen dataclass is its own all-fields hash key: a future
+        # OpStrategy field changes every memo identity at once
+        key = (op.guid, s)
         hit = self._fwd_bwd_memo.get(key)
         if hit is not None:
             return hit
@@ -700,7 +697,7 @@ class Simulator:
         resharding exactly on boundary edges, and best-first refinement
         re-scores flips with it — charging it at seed time just biases seeds
         conservatively where edges are unknown."""
-        key = (op.guid,) + s.key()
+        key = (op.guid, s)
         hit = self._step_memo.get(key)
         if hit is not None:
             return hit
@@ -748,7 +745,7 @@ class Simulator:
         edge_memo = self._edge_memo
 
         def edge_comm_us(t, src_op, src_s, s, backward=False) -> float:
-            key = (t.guid, src_op.guid, backward) + src_s.key() + s.key()
+            key = (t.guid, src_op.guid, backward, src_s, s)
             hit = edge_memo.get(key)
             if hit is not None:
                 return hit
