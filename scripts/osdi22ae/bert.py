@@ -1,16 +1,14 @@
 """BERT benchmark (reference: scripts/osdi22ae/bert.sh — batch 8, budget 30,
 12 layers hidden 1024 seq 512; scaled by env for smaller hosts)."""
-import os
-
 import numpy as np
 
-from common import compare, _ROOT  # noqa: F401
+from common import compare, knob, _ROOT  # noqa: F401
 
-LAYERS = int(os.environ.get("BERT_LAYERS", 12))
-HIDDEN = int(os.environ.get("BERT_HIDDEN", 1024))
-HEADS = int(os.environ.get("BERT_HEADS", 16))
-SEQ = int(os.environ.get("BERT_SEQ", 512))
-BATCH = int(os.environ.get("BERT_BATCH", 8))
+LAYERS = knob("BERT_LAYERS", 12, 2)
+HIDDEN = knob("BERT_HIDDEN", 1024, 64)
+HEADS = knob("BERT_HEADS", 16, 4)
+SEQ = knob("BERT_SEQ", 512, 32)
+BATCH = knob("BERT_BATCH", 8, 8)
 
 
 def build(model, config):

@@ -1,19 +1,18 @@
 """CANDLE Uno benchmark (reference: scripts/osdi22ae/candle_uno.sh)."""
-import os
-
 import numpy as np
 
-from common import run_once
+from common import knob
 
-BATCH = int(os.environ.get("CANDLE_BATCH", 32))
+BATCH = knob("CANDLE_BATCH", 32, 16)
+DENSE = knob("CANDLE_DENSE", 1024, 128)
 FEATURE_DIMS = {"dose1": 1, "cell.rnaseq": 942, "drug1.descriptors": 5270}
 
 
 def build(model, config):
     from flexflow_tpu.models import CandleUnoConfig, build_candle_uno
 
-    cfg = CandleUnoConfig(dense_layers=[1024] * 3,
-                          dense_feature_layers=[1024] * 3)
+    cfg = CandleUnoConfig(dense_layers=[DENSE] * 3,
+                          dense_feature_layers=[DENSE] * 3)
     feats = {n: model.create_tensor([config.batch_size, d])
              for n, d in FEATURE_DIMS.items()}
     out = build_candle_uno(model, feats, cfg)

@@ -1,13 +1,11 @@
 """DLRM benchmark (reference: scripts/osdi22ae/dlrm.sh — budget 20)."""
-import os
-
 import numpy as np
 
-from common import compare
+from common import compare, knob
 
-BATCH = int(os.environ.get("DLRM_BATCH", 64))
-EMB = int(os.environ.get("DLRM_EMBEDDINGS", 4))
-VOCAB = int(os.environ.get("DLRM_VOCAB", 100000))
+BATCH = knob("DLRM_BATCH", 64, 16)
+EMB = knob("DLRM_EMBEDDINGS", 4, 4)
+VOCAB = knob("DLRM_VOCAB", 100000, 1000)
 
 
 def build(model, config):

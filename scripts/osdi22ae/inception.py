@@ -1,12 +1,10 @@
 """InceptionV3 benchmark (reference: scripts/osdi22ae/inception.sh)."""
-import os
-
 import numpy as np
 
-from common import compare
+from common import compare, knob
 
-BATCH = int(os.environ.get("INCEPTION_BATCH", 16))
-SIZE = int(os.environ.get("INCEPTION_SIZE", 299))
+BATCH = knob("INCEPTION_BATCH", 16, 8)
+SIZE = knob("INCEPTION_SIZE", 299, 75)
 
 
 def build(model, config):

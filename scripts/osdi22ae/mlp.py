@@ -1,12 +1,10 @@
 """MLP benchmark (reference: scripts/osdi22ae/mlp.sh — MLP_Unify, budget 20)."""
-import os
-
 import numpy as np
 
-from common import compare
+from common import compare, knob
 
-DIM = int(os.environ.get("MLP_DIM", 4096))
-BATCH = int(os.environ.get("MLP_BATCH", 64))
+DIM = knob("MLP_DIM", 4096, 256)
+BATCH = knob("MLP_BATCH", 64, 16)
 
 
 def build(model, config):

@@ -1,12 +1,10 @@
 """ResNeXt-50 benchmark (reference: scripts/osdi22ae/resnext-50.sh)."""
-import os
-
 import numpy as np
 
-from common import compare
+from common import compare, knob
 
-BATCH = int(os.environ.get("RESNEXT_BATCH", 16))
-SIZE = int(os.environ.get("RESNEXT_SIZE", 224))
+BATCH = knob("RESNEXT_BATCH", 16, 8)
+SIZE = knob("RESNEXT_SIZE", 224, 64)
 
 
 def build(model, config):

@@ -1,13 +1,11 @@
 """XDL benchmark (reference: scripts/osdi22ae/xdl.sh)."""
-import os
-
 import numpy as np
 
-from common import compare
+from common import compare, knob
 
-BATCH = int(os.environ.get("XDL_BATCH", 64))
-EMB = int(os.environ.get("XDL_EMBEDDINGS", 4))
-VOCAB = int(os.environ.get("XDL_VOCAB", 100000))
+BATCH = knob("XDL_BATCH", 64, 16)
+EMB = knob("XDL_EMBEDDINGS", 4, 4)
+VOCAB = knob("XDL_VOCAB", 100000, 1000)
 
 
 def build(model, config):

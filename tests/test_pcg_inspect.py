@@ -1,0 +1,46 @@
+"""PCG inspector (tools/pcg_inspect.py — the reference's gdb/pretty_print.py
+role: its state needs a debugger, ours needs one call)."""
+import os
+import sys
+
+import flexflow_tpu as ff
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.pcg_inspect import dump_graph, dump_model  # noqa: E402
+
+
+def test_dump_model_tp_and_pipeline():
+    from flexflow_tpu.models import TransformerConfig, build_bert_encoder
+
+    config = ff.FFConfig()
+    config.num_devices = 8
+    config.batch_size = 8
+    config.pipeline_microbatches = 4
+    m = ff.FFModel(config)
+    tok = m.create_tensor([8, 16], ff.DataType.DT_INT32)
+    build_bert_encoder(m, tok, TransformerConfig(
+        hidden_size=32, embedding_size=32, num_heads=4, num_layers=2,
+        sequence_length=16, vocab_size=50))
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.1),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[], parallel_axes={"data": 2, "stage": 2})
+    text = dump_model(m)
+    assert "mesh axes: {'data': 2, 'stage': 2}" in text
+    assert "pipeline: 2 stages" in text
+    assert "tok_emb" in text and "layer1_attn" in text
+
+
+def test_dump_graph_with_strategies():
+    from flexflow_tpu.core.graph import Graph
+    from flexflow_tpu.search.simulator import OpStrategy
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    m = ff.FFModel(config)
+    t = m.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    m.softmax(m.dense(t, 6, name="lin"))
+    g = Graph(m.ops)
+    strategies = {op.guid: OpStrategy(dp=2, tp=2) for op in g.ops.values()}
+    text = dump_graph(g, strategies)
+    assert "dp=2 tp=2" in text and "lin" in text

@@ -150,6 +150,31 @@ def test_pp_too_many_stages_raises():
         _build({"stage": 5}, ndev=8)
 
 
+def test_pp_checkpoint_roundtrip(tmp_path):
+    """Stacked '__pipeline__' params survive save/restore (generic pytree
+    flattening) and the restored model trains on."""
+    import os
+
+    from flexflow_tpu.runtime.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    m = _build({"data": 2, "stage": 4}, ndev=8)
+    x, y = _data()
+    m.fit(x, y, epochs=1, verbose=False)
+    p = os.path.join(str(tmp_path), "ckpt.npz")
+    save_checkpoint(p, m, step=1)
+    m2 = _build({"data": 2, "stage": 4}, ndev=8)
+    restore_checkpoint(p, m2)
+    k0 = next(iter(m.params["__pipeline__"]))
+    for wname, val in m.params["__pipeline__"][k0].items():
+        np.testing.assert_array_equal(
+            np.asarray(val), np.asarray(m2.params["__pipeline__"][k0][wname]))
+    h = m2.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(h[-1]["loss"])
+
+
 def test_search_picks_pp_under_memory_pressure():
     """Deep-narrow graph, batch caps dp at 2, TP-indivisible dims: with a
     memory budget that dp-replication busts, the lambda search must buy the

@@ -1,0 +1,113 @@
+"""PCG / strategy / sharding inspector.
+
+Reference-parity role: gdb/pretty_print.py — the reference ships gdb
+pretty-printers for its core C++ types (ParallelTensor shapes, MachineViews,
+domains) because its state lives inside Legion tasks where only a debugger
+can see it. Here the whole PCG is ordinary Python state, so the equivalent
+debugging aid is a one-call dump: per-op type/name/shapes, the chosen
+strategy, the resulting ParallelTensorShape annotations and mesh axes, and
+(optionally) the pipeline plan.
+
+Usage:
+    from tools.pcg_inspect import dump_model, dump_graph
+    print(dump_model(model))              # after compile()
+    print(dump_graph(graph, strategies))  # inside search debugging
+
+or from a shell:
+    python tools/pcg_inspect.py <cspec.json>   # a C-API exported spec
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+
+def _shape_str(t) -> str:
+    ps = getattr(t, "parallel_shape", None)
+    base = "x".join(str(d) for d in t.dims)
+    if ps is None:
+        return base
+    ann = []
+    for d in ps.dims:
+        ann.append(f"{d.size}" + (f"/{d.degree}@{d.axis}" if d.degree > 1
+                                  else ""))
+    return "[" + ",".join(ann) + "]"
+
+
+def dump_graph(graph, strategies: Optional[Dict] = None,
+               costs: Optional[Dict] = None) -> str:
+    """Table of the PCG in topo order: guid, op type, name, input/output
+    shapes with sharding annotations (size/degree@axis), strategy."""
+    strategies = strategies or {}
+    lines = [f"{'guid':>5} {'type':<22} {'name':<28} "
+             f"{'strategy':<22} shapes"]
+    for op in graph.topo_order():
+        s = strategies.get(op.guid)
+        s_str = ""
+        if s is not None:
+            parts = [f"dp={s.dp}"]
+            if s.tp > 1:
+                parts.append(f"tp={s.tp}{'r' if s.tp_row else ''}")
+            if s.ep > 1:
+                parts.append(f"ep={s.ep}")
+            if s.ap > 1:
+                parts.append(f"ap={s.ap}")
+            if s.sp > 1:
+                parts.append(f"sp={s.sp}")
+            s_str = " ".join(parts)
+        ins = ",".join(_shape_str(t) for t in op.inputs)
+        outs = ",".join(_shape_str(t) for t in op.outputs)
+        cost = f"  {costs[op.guid]:.1f}us" if costs and op.guid in costs else ""
+        lines.append(f"{op.guid:>5} {op.op_type.value:<22} "
+                     f"{op.name[:28]:<28} {s_str:<22} "
+                     f"{ins} -> {outs}{cost}")
+    return "\n".join(lines)
+
+
+def dump_model(model) -> str:
+    """Full post-compile dump: mesh, per-op strategies + shardings, weight
+    shardings, pipeline plan when present."""
+    out = []
+    axes = getattr(model, "parallel_axes", None)
+    out.append(f"mesh axes: {axes or '(single device)'}")
+    strategies = getattr(model, "_op_strategies", None) or {}
+    out.append(dump_graph(model.graph, strategies))
+    # weight shardings (only annotated ones)
+    w_lines = []
+    for op in model.graph.topo_order():
+        for w in op.weights:
+            ps = getattr(w, "parallel_shape", None)
+            if ps is not None and any(d.degree > 1 for d in ps.dims):
+                w_lines.append(f"  {op.name}.{w._weight_spec.name}: "
+                               f"{_shape_str(w)}")
+    if w_lines:
+        out.append("sharded weights:")
+        out.extend(w_lines)
+    ex = getattr(model, "executor", None)
+    plan = getattr(ex, "pipeline_plan", None) if ex else None
+    if plan is not None:
+        out.append(
+            f"pipeline: {plan.n_stages} stages x {plan.segs_per_stage} "
+            f"block(s)/stage over {len(plan.region_guids)} ops; "
+            f"carry {tuple(plan.region_input.dims)}")
+    return "\n".join(out)
+
+
+def main(argv):
+    if len(argv) != 2:
+        print(__doc__)
+        return 1
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from flexflow_tpu.native.c_model import model_from_spec
+
+    model = model_from_spec(argv[1])
+    from flexflow_tpu.core.graph import Graph
+
+    print(dump_graph(Graph(model.ops)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
