@@ -33,7 +33,7 @@ from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
 from .substitution import Application, _rewire
-from .substitution_loader import PARALLEL_OPS, Rule
+from .substitution_loader import Rule
 
 # dst parallel-op constructors: OpType -> (class path resolved lazily)
 _PARALLEL_CLS = {

@@ -7,7 +7,7 @@ dispatch table over serialized JSON records instead of a class per op.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

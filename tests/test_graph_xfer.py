@@ -5,7 +5,6 @@ rewrites (reference: substitution_loader.h:94-187 → GraphXfer::create_xfers,
 substitution.h:119-121), not just a TP-degree menu.
 """
 import numpy as np
-import pytest
 
 import flexflow_tpu as ff
 from flexflow_tpu.core.graph import Graph

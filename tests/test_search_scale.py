@@ -11,7 +11,6 @@ Local timing ~40s; the bound leaves headroom for slower CI machines.
 import time
 
 import numpy as np
-import pytest
 
 import flexflow_tpu as ff
 from flexflow_tpu.core.graph import Graph
