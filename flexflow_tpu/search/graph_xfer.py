@@ -13,7 +13,12 @@ executed. Here a Rule becomes a real rewrite:
   ((opId, tsId) wiring), external pattern inputs bound consistently, and
   parallel-op degree/dim params equal. Interior tensors may not escape the
   match (their consumers must be matched too), mirroring the reference's
-  "no external consumer" constraint.
+  "no external consumer" constraint. TASO patterns list WEIGHTS as explicit
+  pattern inputs (OP_LINEAR has (x, w)); our ops hold weights internally,
+  so pattern inputs beyond an op's data arity bind to weight markers —
+  consistently per (op, slot) — and rules whose dst graph would need a
+  weight as a data tensor (e.g. partition-the-weight layouts) stay
+  menu-only (tp_candidates_from_rules distills those).
 - **Replace**: dst parallel ops (OP_PARTITION/COMBINE/REPLICATE) are created
   as explicit PCG parallel ops (parallel/parallel_ops.py — identity on
   values, sharding change under GSPMD); dst compute ops are PAIRED with the
@@ -43,6 +48,24 @@ _PARALLEL_CLS = {
 }
 
 _MATCH_LIMIT = 64  # applications returned per rule per graph scan
+
+
+class _WeightRef:
+    """External-binding marker for a pattern input that maps to an op's
+    INTERNAL weight (TASO lists weights as pattern inputs; our ops don't)."""
+
+    __slots__ = ("guid", "slot")
+
+    def __init__(self, guid: int, slot: int):
+        self.guid = guid
+        self.slot = slot
+
+    def __eq__(self, other):
+        return (isinstance(other, _WeightRef)
+                and (self.guid, self.slot) == (other.guid, other.slot))
+
+    def __hash__(self):
+        return hash(("_WeightRef", self.guid, self.slot))
 
 
 class GraphXfer:
@@ -100,7 +123,7 @@ class GraphXfer:
             for op in by_type.get(pat.op_type, []):
                 if op.guid in bound_guids:
                     continue
-                if len(pat.inputs) > len(op.inputs):
+                if len(pat.inputs) > len(op.inputs) + len(op.weights):
                     continue
                 # don't stack onto ANY xfer's output (own or a sibling
                 # degree rule's): a compute op already fed by an
@@ -116,11 +139,29 @@ class GraphXfer:
                 saved = []
                 ok = True
                 for k, tx in enumerate(pat.inputs):
+                    if k >= len(op.inputs):
+                        # pattern slot beyond the op's data arity: one of
+                        # the op's internal weights (TASO convention)
+                        if not tx.is_external:
+                            ok = False  # ops don't consume others' weights
+                            break
+                        key = (tx.op_id, tx.ts_id)
+                        marker = _WeightRef(op.guid, k - len(op.inputs))
+                        if key in ext:
+                            if ext[key] != marker:
+                                ok = False
+                                break
+                        else:
+                            ext[key] = marker
+                            saved.append(key)
+                        continue
                     actual = op.inputs[k]
                     if tx.is_external:
                         key = (tx.op_id, tx.ts_id)
-                        if key in ext:
-                            if ext[key].guid != actual.guid:
+                        prev = ext.get(key)
+                        if prev is not None:
+                            if (isinstance(prev, _WeightRef)
+                                    or prev.guid != actual.guid):
                                 ok = False
                                 break
                         else:
@@ -174,7 +215,11 @@ class GraphXfer:
                     continue
                 if self._consumers_of.get(t.guid, set()) - matched:
                     return False  # interior tensor escapes the match
-        # feasibility of dst partition/combine degrees against real shapes
+        # feasibility of dst partition/combine degrees against real shapes.
+        # A _WeightRef external has no graph shape: legal only as a reused
+        # compute op's own weight slot; a dst PARALLEL op over a weight
+        # (partition-the-kernel layouts) cannot execute as a graph op here —
+        # those rules stay TP-menu-only.
         dims_of: Dict[Tuple[int, int], Tuple[int, ...]] = {}
         for j, o in enumerate(self.rule.dst_ops):
             ins = []
@@ -183,7 +228,15 @@ class GraphXfer:
                     src_t = ext.get((tx.op_id, tx.ts_id))
                     if src_t is None:
                         return False
-                    ins.append(tuple(src_t.dims))
+                    if isinstance(src_t, _WeightRef):
+                        if o.is_parallel_op:
+                            return False
+                        src_op = binding[self.dst_pairing[j]]
+                        if src_t.guid != src_op.guid:
+                            return False  # cross-op weight sharing
+                        ins.append(None)
+                    else:
+                        ins.append(tuple(src_t.dims))
                 else:
                     shp = dims_of.get((tx.op_id, tx.ts_id))
                     if shp is None:
@@ -191,14 +244,27 @@ class GraphXfer:
                     ins.append(shp)
             if o.op_type == OpType.REPARTITION:
                 d, k = o.parallel_dim or 0, o.parallel_degree or 1
-                if d >= len(ins[0]) or ins[0][d] % k:
+                if ins[0] is None or d >= len(ins[0]) or ins[0][d] % k:
                     return False
                 dims_of[(j, 0)] = ins[0]
             elif o.op_type in (OpType.COMBINE, OpType.REPLICATE):
+                if ins[0] is None:
+                    return False
                 dims_of[(j, 0)] = ins[0]
             else:  # reused compute op: same inputs -> same outputs
                 src_op = binding[self.dst_pairing[j]]
-                if ins and ins[0] != tuple(src_op.inputs[0].dims):
+                arity = len(src_op.inputs)
+                for k2, shp in enumerate(ins):
+                    if k2 < arity:
+                        if shp is None:
+                            return False  # weight fed as a DATA input
+                    elif shp is not None:
+                        # a real tensor at a beyond-arity slot: the apply
+                        # step could not wire it — reject rather than
+                        # silently dropping the rewiring
+                        return False
+                if (ins and ins[0] is not None
+                        and ins[0] != tuple(src_op.inputs[0].dims)):
                     return False  # rewiring would change the op's shape
                 for ts, t in enumerate(src_op.outputs):
                     dims_of[(j, ts)] = tuple(t.dims)
@@ -237,6 +303,11 @@ class GraphXfer:
             else:
                 op_new = binding[self.dst_pairing[j]]
                 for k, t in enumerate(ins):
+                    # weight markers: the reused op's own internal weights,
+                    # nothing to rewire (_valid_match guarantees every
+                    # non-marker entry sits within the op's data arity)
+                    if isinstance(t, _WeightRef):
+                        continue
                     op_new.inputs[k] = t
                 graph.invalidate_topo()  # in-place edge mutation
             for ts, t in enumerate(op_new.outputs):
