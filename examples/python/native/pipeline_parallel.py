@@ -1,37 +1,57 @@
-"""GPipe pipeline parallelism over a 'stage' mesh axis (new capability —
-the reference's OP_PIPELINE is an unused enum; kernels/pipeline.py)."""
+"""GPipe pipeline parallelism through the PCG (new capability — the
+reference's OP_PIPELINE is an unused enum).
+
+`compile(parallel_axes={"stage": S})` maps the transformer's repeated-block
+body onto GPipe stages (parallel/pipeline_plan.py): each device holds its
+stages' weights, microbatches flow over neighbor ICI links, and reverse-mode
+AD of the scan is the backward pipeline. Composes with data parallelism
+(dp x stage mesh below). The low-level kernel demo lives in
+flexflow_tpu/models/pipeline_transformer.py; this example is the USER path.
+"""
 import numpy as np
 
 import _bootstrap  # noqa: F401
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh
 
-from flexflow_tpu.models.pipeline_transformer import (
-    init_pipeline_params,
-    make_train_step,
-)
+import flexflow_tpu as ff
+from flexflow_tpu.models import TransformerConfig, build_bert_encoder
 
 
 def main():
-    stages = min(4, len(jax.devices()))
-    mesh = Mesh(np.array(jax.devices()[:stages]), ("stage",))
-    vocab, hidden, heads, layers = 64, 32, 4, stages * 2
-    params = init_pipeline_params(jax.random.PRNGKey(0), layers, hidden,
-                                  heads, stages=stages)
-    emb = jax.random.normal(jax.random.PRNGKey(1), (vocab, hidden)) * 0.02
-    head = jax.random.normal(jax.random.PRNGKey(2), (hidden, vocab)) * 0.02
-    rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, vocab, (8, 12)))
-    labels = jnp.asarray(rng.randint(0, vocab, (8, 12)))
+    n_dev = len(jax.devices())
+    stages = min(4, n_dev)
+    dp = max(1, n_dev // stages)
 
-    step = make_train_step(mesh, microbatches=4, lr=0.1)
-    for it in range(10):
-        params, emb, head, loss = step(params, emb, head, tokens, labels)
-        if it % 2 == 0:
-            print(f"iter {it}: loss {float(loss):.4f} "
-                  f"({stages} pipeline stages)")
+    config = ff.FFConfig()
+    config.num_devices = dp * stages
+    config.batch_size = 8
+    config.pipeline_microbatches = 4
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([8, 12], ff.DataType.DT_INT32)
+    cfg = TransformerConfig(hidden_size=32, embedding_size=32, num_heads=4,
+                            num_layers=stages, sequence_length=12,
+                            vocab_size=64)
+    build_bert_encoder(model, tokens, cfg)
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=5e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+        parallel_axes=({"data": dp, "stage": stages} if dp > 1
+                       else {"stage": stages}),
+    )
+    plan = model.executor.pipeline_plan
+    print(f"pipeline plan: {plan.n_stages} stages x {plan.segs_per_stage} "
+          f"block(s)/stage over {len(plan.region_guids)} ops "
+          f"(dp={dp}, microbatches={config.pipeline_microbatches})")
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 64, (8, 12)).astype(np.int32)
+    y = (x % 2).astype(np.int32)[..., None]
+    for epoch, h in enumerate(model.fit(x, y, epochs=10, verbose=False)):
+        if epoch % 2 == 0:
+            print(f"epoch {epoch}: loss {h['loss']:.4f} "
+                  f"acc {h['accuracy']:.2f}")
 
 
 if __name__ == "__main__":
