@@ -90,6 +90,8 @@ def test_random_graph_compiles_and_trains(seed):
     ({"data": 2, "seq": 4}, "attn_ulysses"),
     ({"data": 4, "attr": 2}, "conv"),
     ({"data": 2, "model": 2, "seq": 2}, "attn_ring"),
+    ({"stage": 4}, "stack"),
+    ({"data": 2, "stage": 4}, "stack"),
 ])
 def test_explicit_axes_compile_and_train(axes, kind):
     """Every advertised mesh-axis combination compiles and trains with
@@ -123,6 +125,16 @@ def test_explicit_axes_compile_and_train(axes, kind):
             0, 50, size=(2 * batch, seq)).astype(np.int32)
         Y = np.random.RandomState(1).randint(
             0, 4, size=(2 * batch, seq, 1)).astype(np.int32)
+    elif kind == "stack":  # isomorphic blocks -> pipeline stages
+        config.pipeline_microbatches = 4
+        x = model.create_tensor([batch, 32])
+        t = model.dense(x, 32, ff.ActiMode.AC_MODE_RELU, name="stem")
+        for i in range(4):
+            t = model.dense(t, 32, ff.ActiMode.AC_MODE_RELU,
+                            name=f"block{i}")
+        X = np.random.RandomState(0).randn(2 * batch, 32).astype(np.float32)
+        Y = np.random.RandomState(1).randint(
+            0, 4, size=(2 * batch, 1)).astype(np.int32)
     else:  # conv
         x = model.create_tensor([batch, 3, 8, 8])
         t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
