@@ -3,8 +3,8 @@ import numpy as np
 
 from common import compare, knob
 
-BATCH = knob("RESNEXT_BATCH", 16, 8)
-SIZE = knob("RESNEXT_SIZE", 224, 64)
+BATCH = knob("RESNEXT_BATCH", 16, 4)
+SIZE = knob("RESNEXT_SIZE", 224, 56)
 
 
 def build(model, config):
