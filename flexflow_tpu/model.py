@@ -1206,10 +1206,25 @@ class FFModel:
             return None
         return op.name, tensor._weight_spec.name
 
+    def _pp_slot(self, op_name: str):
+        ex = getattr(self, "executor", None)
+        return ex.pipeline_weight_slot(op_name) if ex is not None else None
+
     def _get_tensor_value(self, tensor: Tensor):
         loc = self._find_weight(tensor)
         if loc and self.params is not None:
-            return self.params[loc[0]][loc[1]]
+            if loc[0] in self.params:
+                return self.params[loc[0]][loc[1]]
+            slot = self._pp_slot(loc[0])
+            if slot is not None:
+                key, s = slot
+                return self.params["__pipeline__"][key][loc[1]][s]
+            # a weight tensor that resolves nowhere is a stale handle
+            # (e.g. its op was removed by a rewrite) — fail loudly rather
+            # than letting callers fall back to pre-compile host values
+            raise KeyError(
+                f"no compiled parameters for op {loc[0]!r} (stale tensor "
+                "handle after a graph rewrite?)")
         return None
 
     def _set_tensor_value(self, tensor: Tensor, value: np.ndarray):
@@ -1217,10 +1232,32 @@ class FFModel:
         if loc and self.params is not None:
             import jax.numpy as jnp
 
-            self.params[loc[0]][loc[1]] = jnp.asarray(value)
+            if loc[0] in self.params:
+                self.params[loc[0]][loc[1]] = jnp.asarray(value)
+                return
+            slot = self._pp_slot(loc[0])
+            if slot is not None:
+                key, s = slot
+                stack = self.params["__pipeline__"][key][loc[1]]
+                self.params["__pipeline__"][key][loc[1]] = (
+                    stack.at[s].set(jnp.asarray(value, dtype=stack.dtype)))
+                return
+            raise KeyError(
+                f"no compiled parameters for op {loc[0]!r} (stale tensor "
+                "handle after a graph rewrite?)")
 
     def get_parameter_by_id(self, op_name: str, weight_name: str):
-        return np.asarray(self.params[op_name][weight_name])
+        """Weight value by (op, weight) name — pipelined ops resolve into
+        their stage's slice of the stacked '__pipeline__' tree."""
+        if op_name in self.params:
+            return np.asarray(self.params[op_name][weight_name])
+        slot = self._pp_slot(op_name)
+        if slot is not None:
+            key, s = slot
+            entry = self.params.get("__pipeline__", {}).get(key, {})
+            if weight_name in entry:
+                return np.asarray(entry[weight_name][s])
+        raise KeyError(f"no parameters for op {op_name!r}")
 
     def summary(self, print_fn=print) -> str:
         """Keras-style model summary: one row per op with output shape and

@@ -50,6 +50,27 @@ class Executor:
     def _pp_key(self, j: int, r: int, op) -> str:
         return f"seg{j}_op{r}_{op.name}"
 
+    def pipeline_weight_slot(self, op_name: str):
+        """Locate a pipelined op's weights inside the stacked tree:
+        returns (pp_key, stage_index) — params["__pipeline__"][pp_key][w]
+        holds the (S, ...) stack and stage_index selects this op's slice —
+        or None when the op is not in the pipelined region (or holds no
+        weights). O(1): the map is built once alongside the stacked init."""
+        if self.pipeline_plan is None:
+            return None
+        if not hasattr(self, "_pp_slot_map"):
+            plan = self.pipeline_plan
+            self._pp_slot_map = {}
+            for j in range(plan.segs_per_stage):
+                for r, template in enumerate(plan.segments[j]):
+                    if not template.weights:
+                        continue  # weightless ops have no stacked entry
+                    for s in range(plan.n_stages):
+                        op_s = plan.segments[s * plan.segs_per_stage + j][r]
+                        self._pp_slot_map[op_s.name] = (
+                            self._pp_key(j, r, template), s)
+        return self._pp_slot_map.get(op_name)
+
     def _init_pipeline_params(self, key, params: Dict) -> Any:
         """Stacked region parameters: leaf shape (S, *dims), sharded over
         the 'stage' axis — each device holds exactly its stage's slice."""

@@ -150,6 +150,29 @@ def test_pp_too_many_stages_raises():
         _build({"stage": 5}, ndev=8)
 
 
+def test_pp_weight_accessors():
+    """get/set_tensor and get_parameter_by_id reach INTO the stacked
+    pipeline tree (reference: ParallelTensor set_tensor/get_tensor work on
+    any op's weights regardless of placement)."""
+    m = _build({"stage": 4}, ndev=8, microbatches=2)
+    # a weight belonging to a stage-2 block
+    op = next(o for o in m.graph.topo_order() if o.name == "layer2_ff1")
+    w = op.weights[0]
+    val = np.asarray(m._get_tensor_value(w))
+    got = m.get_parameter_by_id("layer2_ff1", w._weight_spec.name)
+    np.testing.assert_array_equal(val, got)
+    new = np.full_like(val, 0.25)
+    m._set_tensor_value(w, new)
+    np.testing.assert_array_equal(
+        m.get_parameter_by_id("layer2_ff1", w._weight_spec.name), new)
+    # a DIFFERENT stage's copy is untouched
+    other = m.get_parameter_by_id("layer1_ff1", w._weight_spec.name)
+    assert not np.allclose(other, new)
+    x, y = _data()
+    h = m.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(h[-1]["loss"])
+
+
 def test_pp_checkpoint_roundtrip(tmp_path):
     """Stacked '__pipeline__' params survive save/restore (generic pytree
     flattening) and the restored model trains on."""
