@@ -1,4 +1,4 @@
-"""ResNeXt-50 benchmark (reference: scripts/osdi22ae/resnext-50.sh)."""
+"""ResNeXt-50 benchmark (reference: scripts/osdi22ae/resnext-50.sh). On a 1-core host the 8-virtual-device mesh exceeds even the raised collective timeouts (32-group convs serialize minutes/step); validate with XLA_FLAGS=--xla_force_host_platform_device_count=2 BENCH_DEVICES=2."""
 import numpy as np
 
 from common import compare, knob
