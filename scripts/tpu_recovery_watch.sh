@@ -10,9 +10,18 @@ cd "$(dirname "$0")/.."
 LOG=${LOG:-/tmp/tpu_watch_r4.log}
 INTERVAL=${INTERVAL:-1500}
 MAX_TRIES=${MAX_TRIES:-24}
+# stand down before the driver's end-of-round bench needs the chip:
+# no new probe after PROBE_DEADLINE (epoch s), no profile/simvalid chain
+# start after CHAIN_DEADLINE. 0 disables.
+PROBE_DEADLINE=${PROBE_DEADLINE:-0}
+CHAIN_DEADLINE=${CHAIN_DEADLINE:-0}
 
 echo "$(date -u +%H:%M:%S) watcher start (interval=${INTERVAL}s)" >> "$LOG"
 for i in $(seq 1 "$MAX_TRIES"); do
+  if [ "$PROBE_DEADLINE" -gt 0 ] && [ "$(date +%s)" -gt "$PROBE_DEADLINE" ]; then
+    echo "$(date -u +%H:%M:%S) probe deadline passed; standing down for the driver bench" >> "$LOG"
+    exit 0
+  fi
   echo "$(date -u +%H:%M:%S) probe $i" >> "$LOG"
   BENCH_INIT_TIMEOUT_S=240 BENCH_CHILD_TIMEOUT_S=900 BENCH_MAX_RETRIES=1 \
     python bench.py > /tmp/bench_r04_live.json 2>> "$LOG"
@@ -27,6 +36,10 @@ EOF
   then
     echo "$(date -u +%H:%M:%S) RECOVERED: $(cat /tmp/bench_r04_live.json)" >> "$LOG"
     cp /tmp/bench_r04_live.json BENCH_r04_live.json
+    if [ "$CHAIN_DEADLINE" -gt 0 ] && [ "$(date +%s)" -gt "$CHAIN_DEADLINE" ]; then
+      echo "$(date -u +%H:%M:%S) chain deadline passed; bench committed, skipping profile/simvalid" >> "$LOG"
+      exit 0
+    fi
     echo "$(date -u +%H:%M:%S) running ablation profile" >> "$LOG"
     timeout 2400 python scripts/profile_bert.py \
       --variants full,full-flash,grad,fwd,batch32 \
