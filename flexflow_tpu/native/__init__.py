@@ -124,7 +124,7 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
     """Render the PCG + machine + options into the ffcore line protocol."""
     from ..ffconst import OpType
     from .. import search  # noqa: F401  (ensures simulator constants import)
-    from ..search.simulator import TP_CAPABLE
+    from ..search.simulator import TP_CAPABLE, attn_kv_bytes, sp_capability
 
     lines: List[str] = []
     if machine is not None:
@@ -147,6 +147,12 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             f"{config.memory_budget_mb * 1e6 if config.memory_search else 0} "
             f"{mcmc_iters} {config.seed}"
         )
+        # sequence-parallel candidates (feasibility is Python-side: op
+        # coverage, dropout gate, seq-length/head divisibility)
+        from ..search.unity import feasible_sp_values
+
+        sps = feasible_sp_values(graph, config, n_devices)
+        lines.append("sps " + " ".join(str(v) for v in sps))
     inert_types = (OpType.INPUT, OpType.NOOP, OpType.WEIGHT)
     for op in graph.topo_order():
         weight_bytes = sum(
@@ -159,11 +165,20 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
         dtype_bytes = (
             op.outputs[0].dtype.np_dtype.itemsize if op.outputs else 4
         )
+        # sp capability + K/V bytes via the SAME helpers the Python cost
+        # model uses (simulator.py) — the two cost models cannot drift
+        sp_capable = sp_capability(op)
+        sp_divisor = op.outputs[0].dims[1] if sp_capable else 0
+        el = (2 if (config is not None and config.allow_mixed_precision)
+              else (op.outputs[0].dtype.np_dtype.itemsize
+                    if op.outputs else 4))
+        sp_kv_base = attn_kv_bytes(op, el)
         lines.append(
             f"node {op.guid} {op.flops()} {op.bytes_accessed()} "
             f"{weight_bytes} {act_bytes} {out_elems} {dtype_bytes} "
             f"{int(op.op_type in TP_CAPABLE)} {_tp_divisor(op)} "
-            f"{int(op.op_type in inert_types)}"
+            f"{int(op.op_type in inert_types)} "
+            f"{int(sp_capable)} {sp_divisor} {sp_kv_base}"
         )
     for e in graph.edges():
         t = graph.ops[e.src].outputs[e.src_idx]
@@ -193,7 +208,7 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
     )
     out = run(text)
     cost = mem = 0.0
-    mesh_dp = mesh_tp = 1
+    mesh_dp = mesh_tp = mesh_sp = 1
     strategies: Dict[int, OpStrategy] = {}
     log: List[str] = ["native ffcore search"]
     for line in out.splitlines():
@@ -206,9 +221,12 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
             mem = float(parts[1])
         elif parts[0] == "mesh":
             mesh_dp, mesh_tp = int(parts[1]), int(parts[2])
+            if len(parts) > 3:
+                mesh_sp = int(parts[3])
         elif parts[0] == "strategy":
             strategies[int(parts[1])] = OpStrategy(
-                dp=int(parts[2]), tp=int(parts[3])
+                dp=int(parts[2]), tp=int(parts[3]),
+                sp=int(parts[4]) if len(parts) > 4 else 1,
             )
         elif parts[0] == "log":
             log.append(line[4:])
@@ -220,6 +238,8 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
         axes["data"] = mesh_dp
     if mesh_tp > 1 and any(s.tp > 1 for s in strategies.values()):
         axes["model"] = mesh_tp
+    if mesh_sp > 1 and any(s.sp > 1 for s in strategies.values()):
+        axes["seq"] = mesh_sp
     return SearchResult(strategies, axes, cost, mem, log)
 
 

@@ -139,6 +139,32 @@ def _dim1_is_channel(op: Op) -> bool:
     return r
 
 
+def sp_capability(op: Op) -> bool:
+    """The sp-independent half of sp_shardable: dim 1 is a genuine position
+    dim (ndim >= 3, size > 1, not EXPERTS, not an NCHW channel dim). Shared
+    with the native core's graph serialization (native/__init__.py) so both
+    cost models stay in lockstep."""
+    if not op.outputs or op.op_type == OpType.EXPERTS:
+        return False
+    t = op.outputs[0]
+    if len(t.dims) < 3 or t.dims[1] <= 1:
+        return False
+    return not _dim1_is_channel(op)
+
+
+def attn_kv_bytes(op: Op, dtype_bytes: int) -> float:
+    """Full (undivided) K+V bytes an attention op would rotate under ring
+    SP: 2 * B * L_k * heads * kdim * dtype_bytes. 0 for non-attention.
+    The per-chip block is this / (dp * sp). Shared with the native core."""
+    if (op.op_type != OpType.MULTIHEAD_ATTENTION or not op.inputs
+            or len(op.inputs[0].dims) < 3):
+        return 0.0
+    k_in = op.inputs[1] if len(op.inputs) > 1 else op.inputs[0]
+    heads = op.params.get("num_heads", 1)
+    kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
+    return 2.0 * k_in.dims[0] * k_in.dims[1] * heads * kdim * dtype_bytes
+
+
 def sp_shardable(op: Op, sp: int) -> bool:
     """Sequence sharding applies to ops whose output carries a position dim
     at index 1 (ndim >= 3, dim 1 divisible). EXPERTS excluded: its
@@ -147,12 +173,9 @@ def sp_shardable(op: Op, sp: int) -> bool:
     GSPMD would stay correct, but the cost model would wrongly divide their
     time by sp and the annotation would shard channels over 'seq' in hybrid
     attention+conv graphs."""
-    if sp <= 1 or not op.outputs or op.op_type == OpType.EXPERTS:
+    if sp <= 1 or not sp_capability(op):
         return False
-    t = op.outputs[0]
-    if len(t.dims) < 3 or t.dims[1] <= 1 or t.dims[1] % sp != 0:
-        return False
-    return not _dim1_is_channel(op)
+    return op.outputs[0].dims[1] % sp == 0
 
 
 class CostModel:
@@ -233,16 +256,12 @@ class CostModel:
         the mirrored rotation of their gradients in backward (the ring scan
         reverses). Non-attention ops pay nothing — GSPMD keeps their
         position-sharded activations local."""
-        if s.sp <= 1 or op.op_type != OpType.MULTIHEAD_ATTENTION:
+        if s.sp <= 1:
             return 0.0
-        if not op.inputs or len(op.inputs[0].dims) < 3:
+        base = attn_kv_bytes(op, self.op_dtype_bytes(op))
+        if base <= 0:
             return 0.0
-        k_in = op.inputs[1] if len(op.inputs) > 1 else op.inputs[0]
-        heads = op.params.get("num_heads", 1)
-        kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
-        b = k_in.dims[0] / max(1, s.dp)
-        l_local = k_in.dims[1] / s.sp
-        kv_bytes = 2.0 * b * l_local * heads * kdim * self.op_dtype_bytes(op)
+        kv_bytes = base / (max(1, s.dp) * s.sp)
         # fwd rotation + mirrored bwd rotation of dK/dV
         return 2.0 * (s.sp - 1) * self.machine.p2p_time_us(kv_bytes)
 

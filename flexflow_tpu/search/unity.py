@@ -125,6 +125,53 @@ def _tp_divides(op: Op, tp: int) -> bool:
     return False
 
 
+def make_sp_feasible(graph: Graph, config):
+    """Sequence-parallel feasibility for this graph, or None when SP is not
+    searchable at all (--enable-sequence-parallel off, no attention, an
+    attention op carries prob-dropout — the SP kernels have none — or
+    only_data_parallel). Returns a predicate sp -> bool checking that every
+    attention op's q AND k/v sequence lengths divide (cross-attention has
+    distinct lengths) and ulysses-mode heads divide. NEW vs the reference,
+    which has no SP axis; shared by the Python and native searches."""
+    attn_seq_lens = set()
+    sp_head_caps = []  # per-op extra divisibility (ulysses heads)
+    sp_blocked = False
+    for op in graph.ops.values():
+        if op.op_type != OpType.MULTIHEAD_ATTENTION:
+            continue
+        if not op.inputs or len(op.inputs[0].dims) < 3:
+            continue
+        if op.params.get("dropout", 0.0) > 0:
+            sp_blocked = True  # SP kernels have no attention dropout
+        for t in op.inputs[:3]:
+            if len(t.dims) >= 3:
+                attn_seq_lens.add(t.dims[1])
+        if op.params.get("sequence_parallel_mode") in ("ulysses",
+                                                       "all_to_all"):
+            sp_head_caps.append(op.params.get("num_heads", 1))
+    if (not getattr(config, "enable_sequence_parallel", False)
+            or not attn_seq_lens or sp_blocked
+            or config.only_data_parallel):
+        return None
+
+    def sp_feasible(sp: int) -> bool:
+        return (all(l % sp == 0 for l in attn_seq_lens)
+                and all(h % sp == 0 for h in sp_head_caps))
+
+    return sp_feasible
+
+
+def feasible_sp_values(graph: Graph, config, n_devices: int) -> List[int]:
+    """Concrete sp candidates (always includes 1) — the native search's
+    `sps` protocol line."""
+    pred = make_sp_feasible(graph, config)
+    out = [1]
+    if pred is not None:
+        out += [sp for sp in range(2, n_devices + 1)
+                if n_devices % sp == 0 and pred(sp)]
+    return out
+
+
 @dataclasses.dataclass
 class SearchResult:
     strategies: Dict[int, OpStrategy]
@@ -353,36 +400,8 @@ class GraphSearchHelper:
         has_spatial = (self.config.enable_attribute_parallel
                        and any(op.op_type in AP_CAPABLE
                                for op in graph.ops.values()))
-        # sequence parallelism is searchable only where it can execute
-        # (--enable-sequence-parallel; NEW vs the reference, which has no
-        # SP axis at all): every attention op's q AND k/v sequence lengths
-        # must divide each candidate sp (cross-attention has distinct
-        # lengths), the Ulysses mode additionally needs divisible heads,
-        # and attention-prob dropout has no SP kernel
-        attn_seq_lens = set()
-        sp_head_caps = []  # per-op extra divisibility (ulysses heads)
-        sp_blocked = False
-        for op in graph.ops.values():
-            if op.op_type != OpType.MULTIHEAD_ATTENTION:
-                continue
-            if not op.inputs or len(op.inputs[0].dims) < 3:
-                continue
-            if op.params.get("dropout", 0.0) > 0:
-                sp_blocked = True  # SP kernels have no attention dropout
-            for t in op.inputs[:3]:
-                if len(t.dims) >= 3:
-                    attn_seq_lens.add(t.dims[1])
-            if op.params.get("sequence_parallel_mode") in ("ulysses",
-                                                           "all_to_all"):
-                sp_head_caps.append(op.params.get("num_heads", 1))
-        sp_enabled = (getattr(self.config, "enable_sequence_parallel", False)
-                      and attn_seq_lens
-                      and not sp_blocked
-                      and not self.config.only_data_parallel)
-
-        def sp_feasible(sp: int) -> bool:
-            return (all(l % sp == 0 for l in attn_seq_lens)
-                    and all(h % sp == 0 for h in sp_head_caps))
+        sp_feasible = make_sp_feasible(graph, self.config)
+        sp_enabled = sp_feasible is not None
         tuples = []
         for dp, rest in _divisor_pairs(n_devices):
             for tp, rest2 in _divisor_pairs(rest):
@@ -828,7 +847,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
             and not wants_attr and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
             and not config.enable_parameter_parallel  # row-TP is Python-only
-            and not getattr(config, "enable_sequence_parallel", False)  # SP too
+            and not getattr(config, "enable_pipeline_parallel", False)
             and getattr(config, "use_native_search", True)):
         from .. import native
 

@@ -8,6 +8,21 @@
 
 namespace ffcore {
 
+// one emitter for both the text protocol and the C-model API, so the
+// result grammar cannot drift between them
+std::string format_search_result(const SearchResult& r) {
+  std::ostringstream out;
+  out.precision(17);
+  out << "cost " << r.cost_us << "\n";
+  out << "memory " << r.memory_bytes << "\n";
+  out << "mesh " << r.mesh_dp << " " << r.mesh_tp << " " << r.mesh_sp
+      << "\n";
+  for (const auto& [guid, s] : r.strategies)
+    out << "strategy " << guid << " " << s.dp << " " << s.tp << " " << s.sp
+        << "\n";
+  return out.str();
+}
+
 static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
                        Options& o) {
   std::istringstream ss(line);
@@ -34,7 +49,16 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
         n.tp_divisor >> inert;
     n.tp_capable = tp_capable;
     n.inert = inert;
+    // optional trailing sp fields (older senders omit them)
+    int sp_capable = 0;
+    if (ss >> sp_capable >> n.sp_divisor >> n.sp_kv_base)
+      n.sp_capable = sp_capable;
     g.nodes.push_back(n);
+  } else if (kind == "sps") {
+    o.sps.clear();
+    int v;
+    while (ss >> v) o.sps.push_back(v);
+    if (o.sps.empty()) o.sps.push_back(1);
   } else if (kind == "edge") {
     EdgeDesc e;
     ss >> e.src >> e.dst >> e.bytes;
@@ -77,11 +101,7 @@ std::string run_text_protocol(const std::string& input) {
     out << "cost " << sim.simulate(strategies) << "\n";
   } else {  // optimize
     SearchResult r = optimize(g, m, o);
-    out << "cost " << r.cost_us << "\n";
-    out << "memory " << r.memory_bytes << "\n";
-    out << "mesh " << r.mesh_dp << " " << r.mesh_tp << "\n";
-    for (const auto& [guid, s] : r.strategies)
-      out << "strategy " << guid << " " << s.dp << " " << s.tp << "\n";
+    out << format_search_result(r);
     std::istringstream logss(r.log);
     std::string logline;
     while (std::getline(logss, logline)) out << "log " << logline << "\n";

@@ -32,6 +32,7 @@ struct MachineSpec {
   double link_bw(int n) const;
   double compute_time_us(double flops, double bytes, int dtype_bytes) const;
   double allreduce_us(double bytes, int n) const;
+  double p2p_us(double bytes) const;
   double allgather_us(double bytes_per_shard, int n) const;
   double reduce_scatter_us(double bytes, int n) const;
   double memory_budget_bytes() const { return hbm_gb * 1e9; }
@@ -49,6 +50,12 @@ struct NodeDesc {
   bool tp_capable = false;
   int64_t tp_divisor = -1;   // quantity tp must divide; 0 = always ok
   bool inert = false;        // INPUT / NOOP / WEIGHT
+  // sequence parallelism (sp): Python computes layout/type capability
+  // (sp_shardable minus divisibility) and the position-dim size; cost
+  // formulas mirror simulator.py sp_collective_time_us / forward_time_us
+  bool sp_capable = false;   // dim 1 is a position dim (not channels)
+  int64_t sp_divisor = 0;    // position-dim size; sp must divide; 0 = never
+  double sp_kv_base = 0;     // attention: 2*B*L_k*heads*kdim*dtype_bytes
 };
 
 struct EdgeDesc {
@@ -86,19 +93,28 @@ struct Options {
   double memory_budget_bytes = 0;
   int mcmc_iters = 0;      // >0: refine with simulated annealing
   uint64_t seed = 17;
+  // candidate sequence-parallel degrees (feasibility computed Python-side:
+  // --enable-sequence-parallel, seq lens/heads divide, no attn dropout)
+  std::vector<int> sps{1};
 };
 
 struct Strategy {
   int dp = 1;
   int tp = 1;
-  bool operator==(const Strategy& o) const { return dp == o.dp && tp == o.tp; }
+  int sp = 1;  // graph-wide per factorization; 1 on non-shardable ops
+  bool operator==(const Strategy& o) const {
+    return dp == o.dp && tp == o.tp && sp == o.sp;
+  }
 };
+
+std::string format_search_result(const struct SearchResult& r);
 
 struct SearchResult {
   double cost_us = 0;
   double memory_bytes = 0;
   int mesh_dp = 1;
   int mesh_tp = 1;
+  int mesh_sp = 1;
   std::map<int64_t, Strategy> strategies;
   std::string log;
 };
@@ -112,6 +128,7 @@ class CostModel {
   double forward_us(const NodeDesc& n, const Strategy& s) const;
   double backward_us(const NodeDesc& n, const Strategy& s) const;
   double tp_collective_us(const NodeDesc& n, const Strategy& s) const;
+  double sp_collective_us(const NodeDesc& n, const Strategy& s) const;
   double tp_boundary_us(double bytes, const NodeDesc& src_n,
                         const Strategy& src, const Strategy& dst,
                         bool backward) const;

@@ -41,15 +41,36 @@ double MachineSpec::reduce_scatter_us(double bytes, int n) const {
   return (double)(n - 1) / n * bytes / link_bw(n) * 1e6 + 1.0;
 }
 
+double MachineSpec::p2p_us(double bytes) const {
+  // neighbor hop on one ICI link (mirrors machine_model.py p2p_time_us)
+  return bytes / (ici_gbps * 1e9) * 1e6 + 1.0;
+}
+
 // ---------------------------------------------------------------- costs
 static const double kBwdFactor = 2.0;  // two grad GEMMs per fwd GEMM
+
+static bool sp_ok(const NodeDesc& n, int sp) {
+  // mirrors simulator.py sp_shardable: type/layout capability is computed
+  // Python-side (sp_capable); divisibility of the position dim here
+  return sp > 1 && n.sp_capable && n.sp_divisor > 0 && n.sp_divisor % sp == 0;
+}
 
 double CostModel::forward_us(const NodeDesc& n, const Strategy& s) const {
   if (n.inert) return 0.0;
   double shards = (double)s.dp * (n.tp_capable ? s.tp : 1);
+  if (sp_ok(n, s.sp)) shards *= s.sp;
   if (shards < 1) shards = 1;
   return m_.compute_time_us(n.flops / shards, n.bytes_accessed / shards,
                             eff_dtype_bytes(n));
+}
+
+double CostModel::sp_collective_us(const NodeDesc& n,
+                                   const Strategy& s) const {
+  // ring K/V rotation: (sp-1) neighbor ppermutes of the local K and V
+  // blocks, fwd + mirrored bwd (simulator.py sp_collective_time_us)
+  if (s.sp <= 1 || n.sp_kv_base <= 0) return 0.0;
+  double kv = n.sp_kv_base / (std::max(1, s.dp) * (double)s.sp);
+  return 2.0 * (s.sp - 1) * m_.p2p_us(kv);
 }
 
 double CostModel::backward_us(const NodeDesc& n, const Strategy& s) const {
@@ -97,11 +118,13 @@ double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
 double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
   double wb = n.weight_bytes / (n.tp_capable ? std::max(1, s.tp) : 1);
   double ab = n.act_bytes / std::max(1, s.dp * s.tp);
+  if (sp_ok(n, s.sp)) ab /= s.sp;  // position-sharded activations
   return 3.0 * wb + ab;
 }
 
 double CostModel::op_step_us(const NodeDesc& n, const Strategy& s) const {
-  return forward_us(n, s) + backward_us(n, s) + tp_collective_us(n, s);
+  return forward_us(n, s) + backward_us(n, s) + tp_collective_us(n, s) +
+         sp_collective_us(n, s);
 }
 
 // ------------------------------------------------------------- simulator
@@ -169,7 +192,8 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
           run_comm(edge_comm(*e, get(e->src), s, false), out_ready[e->src]);
       ready = std::max(ready, fin);
     }
-    out_ready[n.guid] = run_compute(cost_.forward_us(n, s), ready);
+    double fin = run_compute(cost_.forward_us(n, s), ready);
+    out_ready[n.guid] = run_comm(0.5 * cost_.sp_collective_us(n, s), fin);
   }
   // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
   std::map<int64_t, double> bwd_end;
@@ -185,6 +209,7 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.backward_us(n, s), ready);
+    fin = run_comm(0.5 * cost_.sp_collective_us(n, s), fin);
     bwd_end[n.guid] = fin;
     update_ready =
         std::max(update_ready, run_comm(cost_.grad_sync_us(n, s), fin));

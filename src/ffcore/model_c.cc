@@ -456,14 +456,7 @@ char* ffc_model_optimize(void* h, int n_devices, int budget, double alpha) {
     o.budget = budget;
     o.alpha = alpha;
     ffcore::SearchResult r = ffcore::optimize(g, spec, o);
-    std::ostringstream out;
-    out.precision(17);
-    out << "cost " << r.cost_us << "\n";
-    out << "memory " << r.memory_bytes << "\n";
-    out << "mesh " << r.mesh_dp << " " << r.mesh_tp << "\n";
-    for (const auto& [guid, s] : r.strategies)
-      out << "strategy " << guid << " " << s.dp << " " << s.tp << "\n";
-    return dup_string(out.str());
+    return dup_string(ffcore::format_search_result(r));
   } catch (const std::exception& e) {
     m->last_error = e.what();
     return dup_string(std::string("error ") + e.what());

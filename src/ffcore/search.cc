@@ -15,8 +15,12 @@
 
 namespace ffcore {
 
+static bool node_sp_ok(const NodeDesc& n, int sp) {
+  return sp > 1 && n.sp_capable && n.sp_divisor > 0 && n.sp_divisor % sp == 0;
+}
+
 static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
-                                  const Options& o) {
+                                  const Options& o, int sp = 1) {
   std::vector<int> dps;
   if (o.batch % dp == 0) dps.push_back(dp);
   if (dp != 1) dps.push_back(1);
@@ -26,9 +30,12 @@ static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
                (n.tp_divisor == 0 ||
                 (n.tp_divisor > 0 && n.tp_divisor % tp == 0));
   if (tp_ok) tps = {tp, 1};
+  // sp is graph-wide per factorization (per-op flips would reshard the
+  // position dim at every edge): shardable ops carry it, others sp=1
+  int node_sp = node_sp_ok(n, sp) ? sp : 1;
   std::vector<Strategy> out;
   for (int d : dps)
-    for (int t : tps) out.push_back({d, t});
+    for (int t : tps) out.push_back({d, t, node_sp});
   return out;
 }
 
@@ -64,7 +71,7 @@ static void best_first_flips(const Graph& g,
                              const std::vector<int64_t>& cand_guids, int dp,
                              int tp, const Options& o, CostFn cost_fn,
                              std::map<int64_t, Strategy>& best,
-                             double& best_cost) {
+                             double& best_cost, int sp = 1) {
   std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
   uint64_t counter = 0;
   pq.push({best_cost, counter++, best});
@@ -76,7 +83,7 @@ static void best_first_flips(const Graph& g,
     if (cur.cost > best_cost * o.alpha) continue;
     for (int64_t guid : cand_guids) {
       const NodeDesc& n = g.nodes[g.index.at(guid)];
-      for (const auto& s : menu(n, dp, tp, o)) {
+      for (const auto& s : menu(n, dp, tp, o, sp)) {
         if (s == cur.strategies[n.guid]) continue;
         auto cand = cur.strategies;
         cand[n.guid] = s;
@@ -93,14 +100,14 @@ static void best_first_flips(const Graph& g,
 
 static std::map<int64_t, Strategy> optimize_segment(
     const Graph& g, const Simulator& sim, const std::vector<int>& seg,
-    int dp, int tp, const Options& o) {
+    int dp, int tp, const Options& o, int sp = 1) {
   std::map<int64_t, Strategy> best;
   std::vector<int64_t> guids;
   // greedy seed: per-op best in isolation (menu order breaks ties)
   for (int i : seg) {
     const NodeDesc& n = g.nodes[i];
     guids.push_back(n.guid);
-    auto m = menu(n, dp, tp, o);
+    auto m = menu(n, dp, tp, o, sp);
     Strategy pick = m[0];
     double pc = sim.cost().op_step_us(n, pick);
     for (const auto& s : m) {
@@ -117,7 +124,7 @@ static std::map<int64_t, Strategy> optimize_segment(
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st, &seg);
                    },
-                   best, best_cost);
+                   best, best_cost, sp);
   return best;
 }
 
@@ -129,7 +136,8 @@ static std::map<int64_t, Strategy> optimize_segment(
 static void refine_global(const Graph& g, const Simulator& sim, int dp,
                           int tp, const Options& o,
                           const std::vector<std::vector<int>>& segs,
-                          std::map<int64_t, Strategy>& strategies) {
+                          std::map<int64_t, Strategy>& strategies,
+                          int sp = 1) {
   if (o.budget <= 0 || g.nodes.size() < 2) return;
   std::map<int64_t, int> seg_of;
   for (size_t i = 0; i < segs.size(); ++i)
@@ -158,7 +166,7 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st);
                    },
-                   best, best_cost);
+                   best, best_cost, sp);
   strategies = std::move(best);
 }
 
@@ -167,14 +175,14 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
 static void mcmc_refine(const Graph& g, const Simulator& sim, int dp, int tp,
                         const Options& o,
                         std::map<int64_t, Strategy>& strategies,
-                        double& cost) {
+                        double& cost, int sp = 1) {
   std::mt19937_64 rng(o.seed);
   std::uniform_real_distribution<double> unif(0.0, 1.0);
   auto cur = strategies;
   double cur_cost = cost;
   for (int it = 0; it < o.mcmc_iters; ++it) {
     const NodeDesc& n = g.nodes[rng() % g.nodes.size()];
-    auto m = menu(n, dp, tp, o);
+    auto m = menu(n, dp, tp, o, sp);
     auto cand = cur;
     cand[n.guid] = m[rng() % m.size()];
     double c = sim.simulate(cand);
@@ -201,39 +209,52 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
   best.cost_us = -1;
   std::ostringstream log;
 
-  std::vector<std::pair<int, int>> pairs;
+  struct Fact { int dp, tp, sp; };
+  std::vector<Fact> facts;
   if (o.only_dp) {
-    pairs = {{o.n_devices, 1}};
+    facts = {{o.n_devices, 1, 1}};
   } else {
-    for (int dp = 1; dp <= o.n_devices; ++dp)
-      if (o.n_devices % dp == 0) pairs.push_back({dp, o.n_devices / dp});
+    std::vector<int> sps = o.sps.empty() ? std::vector<int>{1} : o.sps;
+    for (int sp : sps) {
+      if (sp < 1 || o.n_devices % sp != 0) continue;
+      int rem = o.n_devices / sp;
+      for (int dp = 1; dp <= rem; ++dp)
+        if (rem % dp == 0) facts.push_back({dp, rem / dp, sp});
+    }
   }
-  for (auto [dp, tp] : pairs) {
+  for (auto [dp, tp, sp] : facts) {
     if (o.batch % dp != 0) continue;
+    // a sp>1 factorization must shard SOMETHING over the seq axis
+    if (sp > 1) {
+      bool any = false;
+      for (const auto& n : g.nodes) any = any || node_sp_ok(n, sp);
+      if (!any) continue;
+    }
     std::map<int64_t, Strategy> strategies;
     for (const auto& seg : segs) {
-      auto part = optimize_segment(g, sim, seg, dp, tp, o);
+      auto part = optimize_segment(g, sim, seg, dp, tp, o, sp);
       strategies.insert(part.begin(), part.end());
     }
     // cross-segment refinement: single-op flips against the FULL-graph
     // simulate, seeing reshard costs across segment boundaries (mirrors
     // GraphSearchHelper._refine_global)
-    refine_global(g, sim, dp, tp, o, segs, strategies);
+    refine_global(g, sim, dp, tp, o, segs, strategies, sp);
     double cost = sim.simulate(strategies);
-    if (o.mcmc_iters > 0) mcmc_refine(g, sim, dp, tp, o, strategies, cost);
+    if (o.mcmc_iters > 0) mcmc_refine(g, sim, dp, tp, o, strategies, cost, sp);
     double mem = sim.memory(strategies);
     if (o.memory_search && o.memory_budget_bytes > 0 &&
         mem > o.memory_budget_bytes) {
       double overflow = (mem - o.memory_budget_bytes) / o.memory_budget_bytes;
       cost *= (1.0 + 10.0 * overflow);
     }
-    log << "dp=" << dp << " tp=" << tp << " cost=" << cost
+    log << "dp=" << dp << " tp=" << tp << " sp=" << sp << " cost=" << cost
         << "us mem=" << mem / 1e9 << "GB\n";
     if (best.cost_us < 0 || cost < best.cost_us) {
       best.cost_us = cost;
       best.memory_bytes = mem;
       best.mesh_dp = dp;
       best.mesh_tp = tp;
+      best.mesh_sp = sp;
       best.strategies = std::move(strategies);
     }
   }

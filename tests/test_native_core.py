@@ -140,3 +140,63 @@ def test_native_infeasible_raises():
     machine = TpuPodModel(4)
     with pytest.raises(ValueError, match="no feasible"):
         native.optimize_strategy(g, config, machine, 30, 4)
+
+
+def transformer_model(n_dev=8, batch=16, seq=32, dropout=0.0):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.num_devices = n_dev
+    config.search_budget = 8
+    config.enable_sequence_parallel = True
+    config.refine_top_k = 99  # refine every factorization: exact parity
+    model = ff.FFModel(config)
+    from flexflow_tpu.models import TransformerConfig, build_bert_encoder
+
+    cfg = TransformerConfig(hidden_size=32, embedding_size=32, num_heads=4,
+                            num_layers=2, sequence_length=seq, vocab_size=50)
+    tokens = model.create_tensor([batch, seq], ff.DataType.DT_INT32)
+    build_bert_encoder(model, tokens, cfg)
+    return config, model
+
+
+def test_native_sp_search_agrees_with_python():
+    """The native core enumerates the 'seq' axis (round 4): same cost and
+    per-op (dp, tp, sp) as the Python search under
+    --enable-sequence-parallel."""
+    config, model = transformer_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+
+    native_res = native.optimize_strategy(g, config, machine, 16, 8)
+
+    config.use_native_search = False
+    helper = GraphSearchHelper(g, config, machine)
+    py_res = helper.graph_optimize(16, 8)
+
+    assert native_res.cost_us == pytest.approx(py_res.cost_us, rel=1e-6)
+    assert native_res.mesh_axes == py_res.mesh_axes
+    for guid, s in py_res.strategies.items():
+        ns = native_res.strategies[guid]
+        assert (ns.dp, ns.tp, ns.sp) == (s.dp, s.tp, s.sp), g.ops[guid].name
+
+
+def test_native_sp_gated_by_dropout():
+    """Attention-prob dropout has no SP kernel: both paths refuse sp > 1."""
+    config, model = transformer_model()
+    for op in model.ops:
+        if op.op_type.value == "multihead_attention":
+            op.params["dropout"] = 0.1
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+    res = native.optimize_strategy(g, config, machine, 16, 8)
+    assert "seq" not in res.mesh_axes
+
+
+def test_native_dispatch_covers_sp():
+    """unity_optimize routes --enable-sequence-parallel graphs through the
+    native core now (it forced the Python path before round 4)."""
+    config, model = transformer_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+    res = unity_optimize(g, config, machine, 16, 8)
+    assert any("native" in line for line in res.log)
