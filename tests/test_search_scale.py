@@ -7,6 +7,8 @@ per factorization, and the expensive cross-segment refinement only for the
 top-K seeded candidates (config.refine_top_k).
 
 Local timing ~40s; the bound leaves headroom for slower CI machines.
+Scaling datapoint (not asserted): BERT-48, 340 ops, at 512 devices with
+every axis + the memory-aware lambda search finishes in ~194s.
 """
 import time
 
