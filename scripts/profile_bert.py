@@ -63,7 +63,14 @@ def build(seq=SEQ, use_flash=None, batch=BATCH):
         t = model.layer_norm(model.add(t, h), [-1], name=f"layer{i}_ln2")
     t = model.dense(t, 2, name="cls")
     out = model.softmax(t)
-    model.compile(optimizer=ff.AdamOptimizer(model, alpha=1e-4),
+    # same Adam-moments dtype policy as bench.py so the breakdown decomposes
+    # the same step the bench measures (BENCH_MOMENTS=float32 for reference
+    # semantics)
+    import jax.numpy as jnp
+    moments = {"float32": None, "fp32": None, "f32": None}.get(
+        os.environ.get("BENCH_MOMENTS", "bfloat16"), jnp.bfloat16)
+    model.compile(optimizer=ff.AdamOptimizer(model, alpha=1e-4,
+                                             moments_dtype=moments),
                   loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                   metrics=[])
     return model, out
