@@ -108,29 +108,73 @@ class MultiHeadAttentionOp(Op):
             k_in = lax.slice_in_dim(k_in, 0, min(L, k_in.shape[1]), axis=1)
             v_in = lax.slice_in_dim(v_in, 0, min(L, v_in.shape[1]), axis=1)
 
-        # note: a fused q/k/v projection (one wide matmul + split) wins on an
-        # isolated micro-benchmark (~17%) but measured ~6% SLOWER end-to-end
-        # on v5e — the split's forced materialization breaks XLA's
-        # projection+attention fusion — so the three einsums stay separate
-        q = jnp.einsum("ble,ehd->blhd", q_in.astype(cdt), weights["wq"].astype(cdt))
-        k = jnp.einsum("ble,ehd->blhd", k_in.astype(cdt), weights["wk"].astype(cdt))
-        v = jnp.einsum("ble,ehd->blhd", v_in.astype(cdt), weights["wv"].astype(cdt))
-        if "bq" in weights:
-            q = q + weights["bq"].astype(cdt)
-            k = k + weights["bk"].astype(cdt)
-            v = v + weights["bv"].astype(cdt)
-
         scale = 1.0 / np.sqrt(kdim)
         causal = p.get("causal", False)
         rate = p.get("dropout", 0.0)
         dropout_active = rate > 0.0 and ctx.mode == CompMode.COMP_MODE_TRAINING
+
+        # Path selection happens BEFORE the projections. The pure-flash path
+        # uses the PACKED kernel (kernels/flash_attention.py
+        # flash_attention_packed): projections stay (b, l, heads*head_dim) —
+        # exactly the shape the projection matmuls emit — and heads are
+        # iterated inside the kernel body. A custom call can't absorb a
+        # layout change, so the [b,h,l,d] kernels cost real transposes
+        # between projection and kernel (~5 ms/step, 13%, at the BERT bench
+        # config in the r4 xprof trace); the packed path has none. Every
+        # other consumer (ring / ulysses shard_map, KV-cache fill/decode,
+        # einsum core) keeps the logical [b, l, h, d].
+        flash_selected = (
+            self._use_flash(ctx) and not dropout_active and kdim == vdim
+            and not seq_parallel_active
+        )
+        kc = (ctx.state.get((self.name, "k_cache"))
+              if hasattr(ctx, "state") else None)
+        kv_cache_active = kc is not None and (
+            getattr(ctx, "decode_pos", None) is not None
+            or getattr(ctx, "fill_kv_cache", False))
+        # packed is incompatible with tensor-parallel head sharding: the
+        # (e, h, d) -> (e, h*d) weight reshape merges the 'model'-sharded
+        # heads axis into lanes, which would force GSPMD to all-gather the
+        # projections — TP meshes stay on the blhd kernels
+        tp = 1
+        if ctx.mesh is not None:
+            tp = dict(getattr(ctx.mesh, "shape", {})).get("model", 1)
+        use_packed = flash_selected and not kv_cache_active and tp == 1
+
+        if use_packed:
+            e_q, e_k, e_v = (t.shape[-1] for t in (q_in, k_in, v_in))
+            q = q_in.astype(cdt) @ weights["wq"].reshape(
+                e_q, heads * kdim).astype(cdt)
+            k = k_in.astype(cdt) @ weights["wk"].reshape(
+                e_k, heads * kdim).astype(cdt)
+            v = v_in.astype(cdt) @ weights["wv"].reshape(
+                e_v, heads * vdim).astype(cdt)
+            if "bq" in weights:
+                q = q + weights["bq"].reshape(-1).astype(cdt)
+                k = k + weights["bk"].reshape(-1).astype(cdt)
+                v = v + weights["bv"].reshape(-1).astype(cdt)
+        else:
+            # note: a fused q/k/v projection (one wide matmul + split) wins
+            # on an isolated micro-benchmark (~17%) but measured ~6% SLOWER
+            # end-to-end on v5e — the split's forced materialization breaks
+            # XLA's projection+attention fusion — so the three einsums stay
+            # separate
+            q = jnp.einsum("ble,ehd->blhd", q_in.astype(cdt),
+                           weights["wq"].astype(cdt))
+            k = jnp.einsum("ble,ehd->blhd", k_in.astype(cdt),
+                           weights["wk"].astype(cdt))
+            v = jnp.einsum("ble,ehd->blhd", v_in.astype(cdt),
+                           weights["wv"].astype(cdt))
+            if "bq" in weights:
+                q = q + weights["bq"].astype(cdt)
+                k = k + weights["bk"].astype(cdt)
+                v = v + weights["bv"].astype(cdt)
 
         # KV-cache paths for autoregressive serving (serving/generate.py;
         # reference role: the incremental-decoding half of the Triton
         # prototype). fill_kv_cache: a full (prefill) pass also writes its
         # K/V into the session cache. decode_pos: q is one new token; attend
         # against the cache up to the traced position.
-        kc = ctx.state.get((self.name, "k_cache")) if hasattr(ctx, "state") else None
         if kc is not None and getattr(ctx, "decode_pos", None) is not None:
             return [self._decode_step(ctx, q, k, v, weights, scale)]
         if kc is not None and getattr(ctx, "fill_kv_cache", False):
@@ -174,9 +218,20 @@ class MultiHeadAttentionOp(Op):
                 raise ValueError(
                     f"unknown sequence_parallel_mode {mode!r}: "
                     "expected 'ring' or 'ulysses'")
-        elif self._use_flash(ctx) and not dropout_active and kdim == vdim:
-            # hot path: Pallas flash attention — VMEM-tiled online softmax,
-            # no L x L score matrix in HBM (kernels/flash_attention.py)
+        elif use_packed:
+            # hot path: Pallas flash attention in the packed (b, l, e)
+            # layout — VMEM-tiled online softmax, no L x L score matrix in
+            # HBM, no layout transposes (kernels/flash_attention.py)
+            from ..kernels.flash_attention import flash_attention_packed
+
+            ctxv = flash_attention_packed(
+                q, k, v, heads, scale=scale, causal=causal,
+                interpret=jax.default_backend() != "tpu",
+            )
+        elif flash_selected:
+            # flash with a KV cache being filled: the cache needs the
+            # logical [b, l, h, d] tensors, so the transpose-based wrapper
+            # applies
             from ..kernels.flash_attention import flash_attention
 
             ctxv = flash_attention(
@@ -217,11 +272,15 @@ class MultiHeadAttentionOp(Op):
             ctxv = attn_core(q, k, v, drop_key)
 
         odt = emit_dtype(ctx.config, self.outputs[0].dtype)
-        out = jnp.einsum(
-            "bqhd,hde->bqe",
-            ctxv.astype(cdt),
-            weights["wo"].astype(cdt),
-        ).astype(odt)
+        if use_packed:
+            out = (ctxv.astype(cdt) @ weights["wo"].reshape(
+                heads * vdim, embed).astype(cdt)).astype(odt)
+        else:
+            out = jnp.einsum(
+                "bqhd,hde->bqe",
+                ctxv.astype(cdt),
+                weights["wo"].astype(cdt),
+            ).astype(odt)
         if "bo" in weights:
             out = out + weights["bo"].astype(odt)
         if out.shape[1] < full_q_len:  # truncated: pad back to declared shape
@@ -258,12 +317,14 @@ class MultiHeadAttentionOp(Op):
         return out
 
     def _use_flash(self, ctx) -> bool:
-        """Auto policy, measured on v5e: XLA's fused einsum attention is
-        fastest through seq ~4k (it beats both our Pallas kernel and jax's
-        shipped one in wall time), so flash auto-enables only when the
-        b*h*lq*lk f32 score matrix would stress HBM — there the einsum path
-        slows or OOMs while flash stays O(seq). Explicit use_flash=True/False
-        overrides (tests force True with interpret-mode Pallas on CPU)."""
+        """Auto policy, measured on v5e. Since the kernel's bf16-MXU-input
+        fix (round 3) the Pallas flash path wins from seq ~512 up (r4
+        ablation: 39.1 ms/step flash vs 44.0 einsum at the BERT bench
+        config, where the per-chip f32 score matrix is 134 MB); below that
+        the blocks are too small to fill the grid and XLA's fused einsum
+        attention stays ahead. The threshold is the score-matrix size at
+        the measured crossover. Explicit use_flash=True/False overrides
+        (tests force True with interpret-mode Pallas on CPU)."""
         setting = self.params.get("use_flash")
         if setting is not None:
             return bool(setting)
@@ -276,7 +337,7 @@ class MultiHeadAttentionOp(Op):
             dp = dict(getattr(ctx.mesh, "shape", {})).get("data", 1)
         score_bytes = (4.0 * q.dims[0] * self.params["num_heads"]
                        * q.dims[1] * k.dims[1]) / max(dp, 1)
-        return score_bytes > 2e9
+        return score_bytes > 1e8
 
     def flops(self) -> float:
         q, k, v, embed, heads, kdim, vdim = self._dims()

@@ -38,6 +38,16 @@ ITERS = int(os.environ.get("BENCH_ITERS", 20))
 
 
 def build(seq=SEQ, use_flash=None, batch=BATCH):
+    # pin the attention path the same way bench.py does, so the traced /
+    # ablated step is the same program the bench measures
+    if use_flash is None:
+        pinned = os.environ.get("BENCH_ATTENTION_PATH", "")
+        if pinned:
+            if pinned not in ("einsum", "flash"):
+                raise ValueError(
+                    f"BENCH_ATTENTION_PATH={pinned!r}: must be 'einsum' or "
+                    "'flash'")
+            use_flash = pinned == "flash"
     import flexflow_tpu as ff
     from flexflow_tpu.models import TransformerConfig
 
@@ -148,10 +158,13 @@ def main():
                 holder[0] = gstep(model.params, model.state, inputs, label, key)
 
             def gsync():
-                jax.tree_util.tree_map(
-                    lambda a: a.block_until_ready(), holder[0])
-                # tunnel-safe: fetch one scalar
-                float(np.asarray(jax.tree_util.tree_leaves(holder[0])[0].ravel()[0]))
+                # tunnel-safe: fetch ONE scalar from the last grad leaf.
+                # (tree_map(block_until_ready) costs one tunnel RPC per grad
+                # array — ~300 round trips measured as 687 ms/step of pure
+                # sync noise in the r4 profile — while a single scalar fetch
+                # forces completion of the whole dependency chain.)
+                float(np.asarray(
+                    jax.tree_util.tree_leaves(holder[0])[-1].ravel()[0]))
 
             dt = timeit(gfn, gsync)
             results["grad"] = {"ms": round(dt * 1e3, 2)}

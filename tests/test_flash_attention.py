@@ -91,6 +91,152 @@ def test_bert_train_step_through_flash():
     assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-6
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_bhld_layout_matches_blhd(causal):
+    """layout="bhld" (projection-fused layout, no swapaxes) is numerically
+    identical to the default layout on the same logical tensors."""
+    b, l, h, d = 2, 48, 2, 16
+    q, k, v = _rand((b, l, h, d), 9), _rand((b, l, h, d), 10), _rand((b, l, h, d), 11)
+
+    def loss(fn):
+        def wrapped(q, k, v):
+            return jnp.sum(jnp.sin(fn(q, k, v)))
+        return wrapped
+
+    f_blhd = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=32, block_k=32, interpret=True))
+    f_bhld = loss(lambda q, k, v: jnp.swapaxes(flash_attention(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        causal=causal, block_q=32, block_k=32, interpret=True,
+        layout="bhld"), 1, 2))
+    np.testing.assert_allclose(np.asarray(f_blhd(q, k, v)),
+                               np.asarray(f_bhld(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(f_blhd, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_bhld, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [32,   # multi-block: online softmax path
+                                   64])  # single padded block: plain softmax
+def test_packed_kernel_matches_reference(causal, block):
+    """flash_attention_packed on (b, l, h*d) matches the naive oracle on the
+    equivalent (b, l, h, d) tensors — values and input gradients."""
+    from flexflow_tpu.kernels.flash_attention import flash_attention_packed
+
+    b, l, h, d = 2, 48, 4, 16
+    q, k, v = _rand((b, l, h, d), 12), _rand((b, l, h, d), 13), _rand((b, l, h, d), 14)
+
+    def loss_packed(q, k, v):
+        out = flash_attention_packed(
+            q.reshape(b, l, h * d), k.reshape(b, l, h * d),
+            v.reshape(b, l, h * d), h, causal=causal, block_q=block,
+            block_k=block, interpret=True)
+        return jnp.sum(jnp.sin(out.reshape(b, l, h, d)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(attention_reference(q, k, v, causal=causal)))
+
+    np.testing.assert_allclose(np.asarray(loss_packed(q, k, v)),
+                               np.asarray(loss_ref(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    gp = jax.grad(loss_packed, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_flash_vs_einsum_attention_op_grads_parity():
+    """Weight gradients agree between the einsum path and the flash (bhld)
+    path — guards the projection-layout restructuring in the op's lower()."""
+    import flexflow_tpu as ff
+
+    batch, seq, hidden, heads = 2, 24, 32, 4
+    grads = []
+    for use_flash in (False, True):
+        config = ff.FFConfig()
+        config.batch_size = batch
+        config.allow_mixed_precision = False
+        model = ff.FFModel(config)
+        inp = model.create_tensor([batch, seq, hidden])
+        model.multihead_attention(inp, inp, inp, hidden, heads,
+                                  use_flash=use_flash, name="attn")
+        model.compile(
+            optimizer=ff.SGDOptimizer(model, lr=0.0),
+            loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[],
+        )
+        x = np.random.RandomState(1).randn(batch, seq, hidden).astype(np.float32)
+        y = np.random.RandomState(2).randn(batch, seq, hidden).astype(np.float32)
+        key = jax.random.PRNGKey(0)
+        inputs = {model.input_ops[0].name: model.executor.shard_batch(x)}
+        grads.append(model._grad_step(model.params, model.state, inputs,
+                                      jnp.asarray(y), key))
+    flat0 = jax.tree_util.tree_leaves(grads[0])
+    flat1 = jax.tree_util.tree_leaves(grads[1])
+    assert len(flat0) == len(flat1) and len(flat0) > 0
+    for a, b_ in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_tp_heads_matches_single_device(tmp_path):
+    """use_flash=True under a model=2 mesh (heads tensor-parallel) matches
+    single-device numerics — regression for the packed path's TP guard:
+    the packed (e, h*d) weight reshape would merge the sharded heads axis,
+    so TP meshes must stay on the head-separated kernels."""
+    import json
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import CompMode
+
+    batch, seq, hidden, heads = 2, 24, 32, 4
+    x = np.random.RandomState(3).randn(batch, seq, hidden).astype(np.float32)
+
+    def build(import_file=None):
+        config = ff.FFConfig()
+        config.batch_size = batch
+        config.allow_mixed_precision = False
+        if import_file:
+            config.import_strategy_file = import_file
+        model = ff.FFModel(config)
+        inp = model.create_tensor([batch, seq, hidden])
+        t = model.multihead_attention(inp, inp, inp, hidden, heads,
+                                      use_flash=True, name="attn")
+        model.final_tensor = t
+        model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                      loss_type=ff.LossType.LOSS_IDENTITY)
+        return model, t
+
+    single, out_s = build()
+    feeds = {single.input_ops[0].name: x}
+    vals, _, _ = single.executor.forward_values(
+        single.params, single.state, feeds, None,
+        CompMode.COMP_MODE_INFERENCE)
+    ref = np.asarray(vals[out_s.guid])
+
+    strat = {
+        "mesh_axes": {"model": 2},
+        "cost_us": 0.0, "memory_bytes": 0.0,
+        "ops": {"attn": {"dp": 1, "tp": 2, "ep": 1, "ap": 1,
+                         "tp_row": False}},
+    }
+    path = str(tmp_path / "strategy.json")
+    with open(path, "w") as f:
+        json.dump(strat, f)
+    sharded, out_p = build(import_file=path)
+    feeds = {sharded.input_ops[0].name: x}
+    vals_p, _, _ = sharded.executor.forward_values(
+        sharded.params, sharded.state, feeds, None,
+        CompMode.COMP_MODE_INFERENCE)
+    np.testing.assert_allclose(np.asarray(vals_p[out_p.guid]), ref,
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_vs_einsum_attention_op_parity():
     """The attention op produces the same output with use_flash on and off."""
     import flexflow_tpu as ff
