@@ -31,6 +31,7 @@ class Executor:
         self.mesh = mesh
         self.topo = graph.topo_order()
         self._train_step = None
+        self._multi_step = None
         self._eval_step = None
         self._forward_jit = None
         # pipeline parallelism: a 'stage' mesh axis routes the repeated-block
@@ -295,6 +296,37 @@ class Executor:
 
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
         return self._train_step
+
+    def build_multi_step(self, optimizer, loss_fn, metrics: Metrics,
+                         final_tensor, input_names: List[str], reg_fn=None):
+        """K train steps in ONE dispatch via lax.scan — the
+        steps_per_execution role of tf.keras (and the reference's
+        iterations-per-launch batching of task graphs). Each host->device
+        dispatch through a TPU tunnel costs ~ms of latency; at the BERT
+        bench config the device step is ~32 ms but the dispatched wall step
+        ~36 ms, so one dispatch per K steps recovers most of that gap.
+
+        The returned fn takes (params, opt_state, state, inputs_k, label_k,
+        rng_k) where inputs_k/label_k carry a leading K axis and rng_k is
+        jax.random.split(key, K); it returns stacked (K,) metric values."""
+        gstep = self.build_grad_metrics_step(loss_fn, metrics, final_tensor,
+                                             reg_fn)
+
+        def one(carry, xs):
+            params, opt_state, state = carry
+            inputs, label, rng = xs
+            grads, mvals, new_state = gstep(params, state, inputs, label, rng)
+            new_params, new_opt_state = optimizer.update(
+                params, grads, opt_state)
+            return (new_params, new_opt_state, new_state), mvals
+
+        def multi_step(params, opt_state, state, inputs_k, label_k, rng_k):
+            (params, opt_state, state), mvals = jax.lax.scan(
+                one, (params, opt_state, state), (inputs_k, label_k, rng_k))
+            return params, opt_state, state, mvals
+
+        self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2))
+        return self._multi_step
 
     def build_eval_step(self, loss_fn, metrics: Metrics, final_tensor):
         def eval_step(params, state, inputs, label):

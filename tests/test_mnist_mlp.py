@@ -93,6 +93,55 @@ def test_dataloader_fit():
     assert len(history) == 2
 
 
+def test_steps_per_execution_matches_single_step():
+    """fit(steps_per_execution=4) — K optimizer steps per jitted dispatch —
+    produces the same final params and losses as plain fit, to float
+    tolerance (the scan body IS the single train step; the model has no
+    dropout, so the documented rng-stream difference between the two paths
+    cannot affect numerics). n=20 with bs*K=16 exercises the trailing-
+    samples path: the last update of each epoch runs single-step, keeping
+    updates-per-epoch equal to plain fit's n//bs."""
+    import flexflow_tpu as ff
+
+    def build():
+        config = ff.FFConfig()
+        config.batch_size = 4
+        config.allow_mixed_precision = False
+        config.seed = 11
+        model = ff.FFModel(config)
+        x = model.create_tensor([4, 6])
+        t = model.dense(x, 8, ff.ActiMode.AC_MODE_RELU)
+        model.softmax(model.dense(t, 3))
+        model.compile(optimizer=ff.AdamOptimizer(model, alpha=0.01),
+                      loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      metrics=[ff.MetricsType.METRICS_ACCURACY])
+        return model
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(20, 6).astype(np.float32)
+    Y = rng.randint(0, 3, size=(20, 1)).astype(np.int32)
+
+    plain = build()
+    chunked = build()
+    h1 = plain.fit(x=X, y=Y, epochs=2)
+    h2 = chunked.fit(x=X, y=Y, epochs=2, steps_per_execution=4)
+
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(chunked.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+    # epoch summaries agree (same updates, same metric accounting weights)
+    for k in ("loss", "accuracy"):
+        np.testing.assert_allclose(h1[-1][k], h2[-1][k], atol=1e-5, rtol=1e-5)
+    # mutual exclusion with accumulation
+    import pytest
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        plain.fit(x=X, y=Y, epochs=1, accum_steps=2, steps_per_execution=2)
+
+
 def test_gradient_accumulation_matches_large_batch():
     """SGD with fit(accum_steps=2) at microbatch 4 must match one batch-8
     step exactly (per-batch mean losses: the accumulated average IS the
