@@ -78,7 +78,9 @@ def measure_steps(model, x, y):
     gstep = model._grad_step
     for _ in range(5):  # warmup: compile + stabilize (first windows run hot)
         g = gstep(model.params, model.state, inputs, label, key)
-    sync_grad(g)
+        sync_grad(g)  # per-iteration: 5 queued full-grad-tree executions
+        #               is exactly the deep-queue pattern that wedges the
+        #               tunnel backend (bench.py module docstring)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         g = gstep(model.params, model.state, inputs, label, key)
@@ -127,6 +129,18 @@ def main():
 
         force_platform(platform)
     import jax
+
+    # persistent compile cache, same location as bench.py: the BERT step
+    # here is the benched program — recompiling it remotely costs minutes
+    # per run of this script
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        pass
 
     out = {"backend": jax.default_backend()}
     builders = [("bert", build_bert)]
