@@ -68,7 +68,11 @@ class BaseModel:
             layer.name = name
 
     def compile(self, optimizer, loss=None, metrics=None, ffconfig=None,
-                parallel_axes: Optional[Dict[str, int]] = None, **kwargs):
+                parallel_axes: Optional[Dict[str, int]] = None,
+                steps_per_execution: int = 1, **kwargs):
+        """steps_per_execution mirrors tf.keras: K optimizer steps per
+        jitted device dispatch (FFModel.fit's flag of the same name)."""
+        self._steps_per_execution = int(steps_per_execution)
         self.ffconfig = ffconfig or FFConfig()
         self.ffmodel = FFModel(self.ffconfig)
         self._stabilize_layer_names()
@@ -103,7 +107,9 @@ class BaseModel:
             cbs.on_epoch_begin(epoch)
             logs = self.ffmodel.fit(
                 x, y, batch_size=batch_size, epochs=1,
-                accum_steps=accum_steps, verbose=verbose
+                accum_steps=accum_steps,
+                steps_per_execution=getattr(self, "_steps_per_execution", 1),
+                verbose=verbose
             )[0]
             if validation_data is not None:
                 vx, vy = validation_data

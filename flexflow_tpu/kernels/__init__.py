@@ -7,11 +7,23 @@ from .ring_attention import ring_attention, ring_attention_sharded
 __all__ = ["ring_attention", "ring_attention_sharded", "get_shard_map"]
 
 
-def get_shard_map():
+def get_shard_map(check_vma: bool = True):
     """jax>=0.8 moved shard_map out of experimental — one shim for all
-    kernels."""
+    kernels. check_vma=False disables the varying-mesh-axes output check
+    (needed when the body contains a pallas_call, whose ShapeDtypeStruct
+    outputs carry no vma annotation); the flag is translated to the old
+    API's check_rep on the experimental fallback."""
+    import functools
+
     try:
         from jax import shard_map  # jax >= 0.8
+
+        if not check_vma:
+            return functools.partial(shard_map, check_vma=False)
+        return shard_map
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
-    return shard_map
+
+        if not check_vma:
+            return functools.partial(shard_map, check_rep=False)
+        return shard_map

@@ -52,10 +52,18 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # (B, L, H/s, D)
 
     if use_flash:
-        from .flash_attention import flash_attention
+        # packed kernel on the free (B, L, (H/s)*D) view — the trailing
+        # head/depth dims are contiguous, so the reshape is a bitcast and
+        # the custom call needs no [b,h,l,d] transposes (the r4 finding
+        # that motivated the packed kernels applies per shard here too)
+        from .flash_attention import flash_attention_packed
 
-        ctx = flash_attention(qh, kh, vh, scale=scale, causal=causal,
-                              interpret=interpret)
+        b, l, hh, d = qh.shape
+        ctx = flash_attention_packed(
+            qh.reshape(b, l, hh * d), kh.reshape(b, l, hh * d),
+            vh.reshape(b, l, hh * d), hh, scale=scale, causal=causal,
+            interpret=interpret,
+        ).reshape(b, l, hh, d)
     else:
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                             preferred_element_type=jnp.float32) * scale
@@ -85,7 +93,10 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
 
     from . import get_shard_map
 
-    shard_map = get_shard_map()
+    # the flash local core is a pallas_call, whose outputs carry no vma
+    # annotation — disable the varying-mesh-axes check only on that path
+    # (the shim translates the flag for older jax)
+    shard_map = get_shard_map(check_vma=not use_flash)
 
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     if q.shape[2] % axis_size:

@@ -129,17 +129,21 @@ class MultiHeadAttentionOp(Op):
         )
         kc = (ctx.state.get((self.name, "k_cache"))
               if hasattr(ctx, "state") else None)
-        kv_cache_active = kc is not None and (
-            getattr(ctx, "decode_pos", None) is not None
-            or getattr(ctx, "fill_kv_cache", False))
+        decode_active = (kc is not None
+                         and getattr(ctx, "decode_pos", None) is not None)
+        fill_active = (kc is not None
+                       and getattr(ctx, "fill_kv_cache", False))
         # packed is incompatible with tensor-parallel head sharding: the
         # (e, h, d) -> (e, h*d) weight reshape merges the 'model'-sharded
         # heads axis into lanes, which would force GSPMD to all-gather the
-        # projections — TP meshes stay on the blhd kernels
+        # projections — TP meshes stay on the blhd kernels. KV-cache
+        # prefill works packed (the cache's [b, l, h, d] view is a free
+        # trailing-dim reshape); the single-token decode step stays on the
+        # einsum path it always used.
         tp = 1
         if ctx.mesh is not None:
             tp = dict(getattr(ctx.mesh, "shape", {})).get("model", 1)
-        use_packed = flash_selected and not kv_cache_active and tp == 1
+        use_packed = flash_selected and not decode_active and tp == 1
 
         if use_packed:
             e_q, e_k, e_v = (t.shape[-1] for t in (q_in, k_in, v_in))
@@ -175,16 +179,22 @@ class MultiHeadAttentionOp(Op):
         # prototype). fill_kv_cache: a full (prefill) pass also writes its
         # K/V into the session cache. decode_pos: q is one new token; attend
         # against the cache up to the traced position.
-        if kc is not None and getattr(ctx, "decode_pos", None) is not None:
+        if decode_active:
             return [self._decode_step(ctx, q, k, v, weights, scale)]
-        if kc is not None and getattr(ctx, "fill_kv_cache", False):
+        if fill_active:
+            # the cache stores [b, l, h, d]; the packed (b, l, h*d)
+            # projections view into it with a free trailing-dim reshape
+            k4 = (k.reshape(k.shape[0], k.shape[1], heads, kdim)
+                  if use_packed else k)
+            v4 = (v.reshape(v.shape[0], v.shape[1], heads, vdim)
+                  if use_packed else v)
             vc = ctx.state[(self.name, "v_cache")]
             ctx.state_updates[(self.name, "k_cache")] = (
                 jax.lax.dynamic_update_slice(
-                    kc, k.astype(kc.dtype), (0, 0, 0, 0)))
+                    kc, k4.astype(kc.dtype), (0, 0, 0, 0)))
             ctx.state_updates[(self.name, "v_cache")] = (
                 jax.lax.dynamic_update_slice(
-                    vc, v.astype(vc.dtype), (0, 0, 0, 0)))
+                    vc, v4.astype(vc.dtype), (0, 0, 0, 0)))
 
         if seq_parallel_active:
             # sequence/context parallelism over the 'seq' mesh axis — two
@@ -229,9 +239,9 @@ class MultiHeadAttentionOp(Op):
                 interpret=jax.default_backend() != "tpu",
             )
         elif flash_selected:
-            # flash with a KV cache being filled: the cache needs the
-            # logical [b, l, h, d] tensors, so the transpose-based wrapper
-            # applies
+            # flash on a TP head-sharded mesh: head-separated [b,l,h,d]
+            # projections (shardable on the heads axis) with the
+            # transpose-based kernel wrapper
             from ..kernels.flash_attention import flash_attention
 
             ctxv = flash_attention(

@@ -7,7 +7,8 @@ from flexflow_tpu.ffconst import CompMode
 from flexflow_tpu.serving.generate import GenerativeSession
 
 
-def _build_lm(batch, window, vocab=50, hidden=32, heads=4, layers=2):
+def _build_lm(batch, window, vocab=50, hidden=32, heads=4, layers=2,
+              use_flash=None):
     config = ff.FFConfig()
     config.batch_size = batch
     config.allow_mixed_precision = False
@@ -17,6 +18,7 @@ def _build_lm(batch, window, vocab=50, hidden=32, heads=4, layers=2):
                         name="emb")
     for i in range(layers):
         attn = model.multihead_attention(t, t, t, hidden, heads, causal=True,
+                                         use_flash=use_flash,
                                          name=f"l{i}_attn")
         t = model.layer_norm(model.add(t, attn), [-1], name=f"l{i}_ln1")
         h = model.dense(t, hidden * 2, ff.ActiMode.AC_MODE_GELU,
@@ -53,6 +55,20 @@ def test_kv_cache_generate_matches_naive_loop():
     b, window, n_new = 2, 12, 5
     model = _build_lm(b, window)
     prompt = np.random.RandomState(0).randint(1, 50, size=(b, 4)).astype(np.int32)
+
+    ref = _naive_generate(model, prompt, n_new, window)
+    session = GenerativeSession(model, max_len=window)
+    got = session.generate(prompt, n_new)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_kv_cache_generate_flash_prefill_matches_naive_loop():
+    """use_flash=True prefill: the packed kernel fills the KV cache (its
+    [b,l,h,d] view is a reshape of the packed projections) and decode steps
+    attend against it — same tokens as the naive full-recompute loop."""
+    b, window, n_new = 2, 12, 5
+    model = _build_lm(b, window, use_flash=True)
+    prompt = np.random.RandomState(4).randint(1, 50, size=(b, 4)).astype(np.int32)
 
     ref = _naive_generate(model, prompt, n_new, window)
     session = GenerativeSession(model, max_len=window)

@@ -32,13 +32,16 @@ def qkv():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_ulysses_matches_full(qkv, causal):
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ulysses_matches_full(qkv, causal, use_flash):
     q, k, v = qkv
     mesh = make_mesh({"seq": 8})
 
     @jax.jit
     def uly(q, k, v):
-        return ulysses_attention_sharded(q, k, v, mesh, "seq", causal=causal)
+        return ulysses_attention_sharded(q, k, v, mesh, "seq", causal=causal,
+                                         use_flash=use_flash,
+                                         interpret=use_flash)
 
     out = uly(q, k, v)
     ref = full_attention(q, k, v, causal=causal)
