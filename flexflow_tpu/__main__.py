@@ -135,7 +135,8 @@ def main(argv=None):
     n = y.shape[0]
     t0 = time.time()
     hist = model.fit(xs, y, batch_size=config.batch_size,
-                     epochs=config.epochs)
+                     epochs=config.epochs,
+                     steps_per_execution=config.steps_per_execution)
     dt = time.time() - t0
     thru = n * config.epochs / max(dt, 1e-9)
     print(f"[{model_name}] {config.epochs} epoch(s) in {dt:.2f}s "

@@ -42,11 +42,24 @@ class FFConfig:
     `--enable-attribute-parallel`, `--search-overlap-backward-update`,
     `--base-optimize-threshold`, `--substitution-json`, `--export`/`--import`,
     `--memory-search`, `--profiling`, `--fusion`.
+
+    TPU-native additions beyond the reference surface:
+    `--steps-per-execution` (K optimizer steps per jitted dispatch) and
+    `--flash-block-q`/`--flash-block-k` (Pallas flash-attention tiling,
+    swept by scripts/sweep_flash.py).
     """
 
     batch_size: int = 64
     epochs: int = 1
     iterations: int = 1
+    # K optimizer steps per jitted device dispatch (tf.keras
+    # steps_per_execution role; FFModel.fit flag of the same name)
+    steps_per_execution: int = 1
+    # Pallas flash-attention block sizes (kernels/flash_attention.py).
+    # 512x512 measured best at the BERT bench config on v5e;
+    # scripts/sweep_flash.py sweeps these on the live chip.
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
@@ -159,6 +172,12 @@ class FFConfig:
                 self.epochs = int(take())
             elif a in ("-i", "--iterations"):
                 self.iterations = int(take())
+            elif a == "--steps-per-execution":
+                self.steps_per_execution = int(take())
+            elif a == "--flash-block-q":
+                self.flash_block_q = int(take())
+            elif a == "--flash-block-k":
+                self.flash_block_k = int(take())
             elif a in ("--lr", "--learning-rate"):
                 self.learning_rate = float(take())
             elif a in ("--wd", "--weight-decay"):

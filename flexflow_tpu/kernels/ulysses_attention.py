@@ -32,6 +32,7 @@ import numpy as np
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                       scale: Optional[float] = None, use_flash: bool = False,
+                      block_q: int = 512, block_k: int = 512,
                       interpret: bool = False):
     """Runs INSIDE shard_map: q,k,v are local sequence blocks
     (B, L_local, H, D). Returns the local output block (B, L_local, H, Dv).
@@ -62,7 +63,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         ctx = flash_attention_packed(
             qh.reshape(b, l, hh * d), kh.reshape(b, l, hh * d),
             vh.reshape(b, l, hh * d), hh, scale=scale, causal=causal,
-            interpret=interpret,
+            block_q=block_q, block_k=block_k, interpret=interpret,
         ).reshape(b, l, hh, d)
     else:
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
@@ -81,6 +82,7 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
                               causal: bool = False,
                               scale: Optional[float] = None,
                               use_flash: bool = False,
+                              block_q: int = 512, block_k: int = 512,
                               interpret: bool = False):
     """GSPMD-land entry: q,k,v are GLOBAL (B, L, H, D) values; shard_map
     partitions L over `axis_name`, one all_to_all re-shards to heads, exact
@@ -113,6 +115,7 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
     spec = P(batch_axis, axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal, scale=scale, use_flash=use_flash,
+                           block_q=block_q, block_k=block_k,
                            interpret=interpret)
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

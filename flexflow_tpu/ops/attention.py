@@ -215,6 +215,8 @@ class MultiHeadAttentionOp(Op):
                     # same measured auto-policy picks flash vs einsum
                     use_flash=(self._use_flash(ctx) and not dropout_active
                                and kdim == vdim),
+                    block_q=getattr(ctx.config, "flash_block_q", 512),
+                    block_k=getattr(ctx.config, "flash_block_k", 512),
                     interpret=jax.default_backend() != "tpu",
                 )
             elif mode == "ring":
@@ -236,6 +238,8 @@ class MultiHeadAttentionOp(Op):
 
             ctxv = flash_attention_packed(
                 q, k, v, heads, scale=scale, causal=causal,
+                block_q=getattr(ctx.config, "flash_block_q", 512),
+                block_k=getattr(ctx.config, "flash_block_k", 512),
                 interpret=jax.default_backend() != "tpu",
             )
         elif flash_selected:
@@ -246,6 +250,8 @@ class MultiHeadAttentionOp(Op):
 
             ctxv = flash_attention(
                 q, k, v, scale=scale, causal=causal,
+                block_q=getattr(ctx.config, "flash_block_q", 512),
+                block_k=getattr(ctx.config, "flash_block_k", 512),
                 interpret=jax.default_backend() != "tpu",
             )
         else:

@@ -43,29 +43,54 @@ def main():
     k = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
     v = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
 
-    def timeit(f):
-        g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2),
-                             argnums=(0, 1, 2)))
-        r = g(q, k, v)
+    def timeit(f, operands=None):
+        ops_ = operands if operands is not None else (q, k, v)
+        g = jax.jit(jax.grad(
+            lambda q_, k_, v_: jnp.sum(f(q_, k_, v_).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        r = g(*ops_)
         float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))  # warm + sync
         t0 = time.perf_counter()
         for _ in range(ITERS):
-            r = g(q, k, v)
+            r = g(*ops_)
         float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))
         return (time.perf_counter() - t0) / ITERS * 1e3
+
+    from flexflow_tpu.kernels.flash_attention import flash_attention_packed
+
+    qp = q.reshape(B, L, H * D)
+    kp = k.reshape(B, L, H * D)
+    vp = v.reshape(B, L, H * D)
+
+    def timeit_packed(f):
+        return timeit(f, operands=(qp, kp, vp))
 
     results = {}
     for bq, bk in itertools.product(BLOCKS, BLOCKS):
         if bq > L or bk > L:
             continue
 
-        def fa(q, k, v, bq=bq, bk=bk):
-            return flash_attention(q, k, v, block_q=bq, block_k=bk,
+        # packed layout: the production path (ops/attention.py use_packed;
+        # block sizes reachable via FFConfig flash_block_q/k)
+        def fp(q_, k_, v_, bq=bq, bk=bk):
+            return flash_attention_packed(q_, k_, v_, H, block_q=bq,
+                                          block_k=bk, interpret=interpret)
+
+        try:
+            results[f"packed_{bq}x{bk}"] = round(timeit_packed(fp), 3)
+        except Exception as e:  # a tiling the backend rejects: record, move on
+            results[f"packed_{bq}x{bk}"] = f"error: {type(e).__name__}"
+        print(f"packed {bq}x{bk}: {results[f'packed_{bq}x{bk}']}",
+              file=sys.stderr)
+
+        # bhld layout kept for comparison (the TP-sharded path)
+        def fa(q_, k_, v_, bq=bq, bk=bk):
+            return flash_attention(q_, k_, v_, block_q=bq, block_k=bk,
                                    interpret=interpret)
 
         try:
             results[f"flash_{bq}x{bk}"] = round(timeit(fa), 3)
-        except Exception as e:  # a tiling the backend rejects: record, move on
+        except Exception as e:
             results[f"flash_{bq}x{bk}"] = f"error: {type(e).__name__}"
         print(f"flash {bq}x{bk}: {results[f'flash_{bq}x{bk}']}", file=sys.stderr)
 

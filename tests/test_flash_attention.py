@@ -184,6 +184,34 @@ def test_flash_vs_einsum_attention_op_grads_parity():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_config_flash_block_sizes_reach_kernel():
+    """FFConfig.flash_block_q/k plumb through to the packed kernel: a
+    non-default block size still reproduces einsum-path numerics."""
+    import flexflow_tpu as ff
+
+    batch, seq, hidden, heads = 2, 48, 32, 4
+    preds = []
+    for use_flash, blocks in ((False, None), (True, 16)):
+        config = ff.FFConfig()
+        config.batch_size = batch
+        config.allow_mixed_precision = False
+        if blocks:
+            config.flash_block_q = blocks
+            config.flash_block_k = blocks
+        model = ff.FFModel(config)
+        inp = model.create_tensor([batch, seq, hidden])
+        model.multihead_attention(inp, inp, inp, hidden, heads,
+                                  use_flash=use_flash, name="attn")
+        model.compile(
+            optimizer=ff.SGDOptimizer(model, lr=0.0),
+            loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[],
+        )
+        x = np.random.RandomState(5).randn(batch, seq, hidden).astype(np.float32)
+        preds.append(model.predict([x]))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=2e-5, atol=2e-5)
+
+
 def test_flash_attention_tp_heads_matches_single_device(tmp_path):
     """use_flash=True under a model=2 mesh (heads tensor-parallel) matches
     single-device numerics — regression for the packed path's TP guard:

@@ -93,6 +93,30 @@ def test_dataloader_fit():
     assert len(history) == 2
 
 
+def test_dataloader_fit_steps_per_execution():
+    """Attached dataloaders drive the chunked path: load_host pulls K
+    sequential batches per dispatch, so the prefetch ring and shuffle
+    stream stay aligned with the x/y pairing."""
+    config = ff.FFConfig()
+    config.batch_size = 16
+    config.epochs = 2
+    x, y = make_synthetic(n=96, dim=32)
+    model = ff.FFModel(config)
+    inp = model.create_tensor([16, 32])
+    model.softmax(model.dense(inp, 10))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.05),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    ff.SingleDataLoader(model, inp, x, 96)
+    ff.SingleDataLoader(model, model.label_tensor, y, 96)
+    history = model.fit(steps_per_execution=3)
+    assert len(history) == 2
+    assert np.isfinite(history[-1]["loss"])
+    assert history[-1]["loss"] < history[0]["loss"] + 1e-6
+
+
 def test_steps_per_execution_matches_single_step():
     """fit(steps_per_execution=4) — K optimizer steps per jitted dispatch —
     produces the same final params and losses as plain fit, to float
