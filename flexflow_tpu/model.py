@@ -1207,7 +1207,15 @@ class FFModel:
             else DataType.DT_FLOAT
         )
         pm = PerfMetrics()
+
+        def absorb(pending):
+            cnt, mv = pending
+            pm.update(cnt, {k: float(v) for k, v in mv.items()})
+
         num_batches = (n + bs - 1) // bs  # include the tail partial batch
+        pending = None  # one-deep pipeline: the host-side float() fetch of
+        #                 batch i happens after batch i+1 is dispatched, so
+        #                 metric transfers overlap device execution
         for it in range(num_batches):
             lo, hi = it * bs, min((it + 1) * bs, n)
             if hi <= lo:
@@ -1217,7 +1225,11 @@ class FFModel:
                 np.ascontiguousarray(y[lo:hi]).astype(label_dtype.np_dtype)
             )
             mvals, _ = self._eval_step(self.params, self.state, inputs, label)
-            pm.update(hi - lo, {k: float(v) for k, v in mvals.items()})
+            if pending is not None:
+                absorb(pending)
+            pending = (hi - lo, mvals)
+        if pending is not None:
+            absorb(pending)
         return pm.summary()
 
     # -- manual loop parity (reference: forward/zero_gradients/backward/update)
@@ -1286,15 +1298,26 @@ class FFModel:
             x = [x]
         bs = batch_size or self.config.batch_size
         n = x[0].shape[0]
-        outs = []
-        for lo in range(0, n, bs):
-            hi = min(lo + bs, n)
-            inputs = self._prep_inputs(x, lo, hi)
-            pred, _ = self._infer_fn(self.params, self.state, inputs, self._next_rng())
+        def fetch(pred):
             arr = np.asarray(pred)
             if arr.dtype.kind == "V":  # bf16 (ml_dtypes) under mixed precision
                 arr = arr.astype(np.float32)
-            outs.append(arr)
+            return arr
+
+        outs = []
+        pending = None  # one-deep pipeline: fetch batch i's output after
+        #                 batch i+1 is dispatched (device->host transfer
+        #                 overlaps device execution)
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            inputs = self._prep_inputs(x, lo, hi)
+            pred, _ = self._infer_fn(self.params, self.state, inputs,
+                                     self._next_rng())
+            if pending is not None:
+                outs.append(fetch(pending))
+            pending = pred
+        if pending is not None:
+            outs.append(fetch(pending))
         return np.concatenate(outs, axis=0)
 
     def reset_metrics(self):
