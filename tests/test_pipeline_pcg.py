@@ -65,6 +65,18 @@ def test_plan_finds_transformer_body():
     assert plan2.segs_per_stage == 2
 
 
+def test_pipeline_fit_steps_per_execution():
+    """Chunked fit on a stage mesh: the K-step scan wraps the GPipe scan
+    (scan-inside-scan) and trains — loss stays finite and falls."""
+    model = _build(axes={"stage": 2}, ndev=2)
+    x, y = _data()
+    xs = np.tile(x, (4, 1))
+    ys = np.tile(y, (4, 1, 1))
+    hist = model.fit([xs], ys, epochs=2, steps_per_execution=2)
+    assert np.isfinite(hist[-1]["loss"])
+    assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-6
+
+
 def test_plan_loud_on_unpipelineable_graph():
     """No repeated structure -> a loud error naming the constraint."""
     config = ff.FFConfig()

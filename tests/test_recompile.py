@@ -34,6 +34,50 @@ def test_trigger_alter_fires_once():
     assert rs.fired == 1
 
 
+def test_recompile_mid_epoch_with_steps_per_execution():
+    """A recompile trigger firing between chunks of fit(steps_per_execution)
+    must take effect for the REMAINING chunks of the same epoch: the
+    chunked loop re-resolves the jitted multi-step after the alter
+    invalidates it (regression for the stale-captured-mstep bug)."""
+    config = ff.FFConfig()
+    config.batch_size = 4
+    model = ff.FFModel(config)
+    inp = model.create_tensor([4, 16])
+    model.softmax(model.dense(inp, 4))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    seen_fns = []
+
+    def alter(m):
+        # what graph-mutating alters do at the end (e.g. moe_cache_alter,
+        # recompile.py): invalidate every compiled step
+        m.invalidate_compiled_steps()
+
+    rs = RecompileState(trigger=lambda m: m._step_count >= 2, alter=alter)
+    model.recompile_on_condition(rs)
+    x = np.random.RandomState(0).randn(32, 16).astype(np.float32)
+    y = np.zeros((32, 1), dtype=np.int32)
+
+    # watch which multi-step object each chunk uses
+    orig_get = model._get_multi_step
+
+    def spy():
+        fn = orig_get()
+        seen_fns.append(fn)
+        return fn
+
+    model._get_multi_step = spy
+    model.fit(x, y, epochs=1, steps_per_execution=2)  # 4 chunks of 2
+    assert rs.fired == 1
+    # the alter rebuilt the step functions, so later chunks used a NEW
+    # jitted multi-step object
+    assert len(set(map(id, seen_fns))) == 2, (
+        "chunks after the recompile kept the stale jitted multi-step")
+
+
 def test_moe_cache_switch_end_to_end():
     """Cache op serves live input until scores stabilize, then the alter
     flips it to cached mode and the step recompiles."""
