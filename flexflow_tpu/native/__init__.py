@@ -149,10 +149,13 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
         )
         # sequence-parallel candidates (feasibility is Python-side: op
         # coverage, dropout gate, seq-length/head divisibility)
-        from ..search.unity import feasible_sp_values
+        from ..search.unity import feasible_ep_values, feasible_sp_values
 
         sps = feasible_sp_values(graph, config, n_devices)
         lines.append("sps " + " ".join(str(v) for v in sps))
+        # expert-parallel candidates (divisors of every expert count)
+        eps = feasible_ep_values(graph, config, n_devices)
+        lines.append("eps " + " ".join(str(v) for v in eps))
     inert_types = (OpType.INPUT, OpType.NOOP, OpType.WEIGHT)
     for op in graph.topo_order():
         weight_bytes = sum(
@@ -173,12 +176,28 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
               else (op.outputs[0].dtype.np_dtype.itemsize
                     if op.outputs else 4))
         sp_kv_base = attn_kv_bytes(op, el)
+        # expert-parallel fields: capacity-buffer ELEMENT counts via the
+        # same helper the Python cost model uses (simulator.py
+        # ep_collective_time_us); native multiplies by its effective dtype
+        ep_capable = op.op_type == OpType.EXPERTS
+        ep_divisor = ep_disp = ep_comb = 0
+        if ep_capable:
+            from ..ops.moe import moe_capacity
+
+            x = op.inputs[0]
+            n_exp = op.params["n"]
+            cap = moe_capacity(x.dims[0], op.inputs[2].dims[1], n_exp,
+                               op.params.get("alpha", 1.0))
+            ep_divisor = n_exp
+            ep_disp = n_exp * cap * x.dims[1]
+            ep_comb = n_exp * cap * op.params["out_dim"]
         lines.append(
             f"node {op.guid} {op.flops()} {op.bytes_accessed()} "
             f"{weight_bytes} {act_bytes} {out_elems} {dtype_bytes} "
             f"{int(op.op_type in TP_CAPABLE)} {_tp_divisor(op)} "
             f"{int(op.op_type in inert_types)} "
-            f"{int(sp_capable)} {sp_divisor} {sp_kv_base}"
+            f"{int(sp_capable)} {sp_divisor} {sp_kv_base} "
+            f"{int(ep_capable)} {ep_divisor} {ep_disp} {ep_comb}"
         )
     for e in graph.edges():
         t = graph.ops[e.src].outputs[e.src_idx]
@@ -208,7 +227,7 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
     )
     out = run(text)
     cost = mem = 0.0
-    mesh_dp = mesh_tp = mesh_sp = 1
+    mesh_dp = mesh_tp = mesh_sp = mesh_ep = 1
     strategies: Dict[int, OpStrategy] = {}
     log: List[str] = ["native ffcore search"]
     for line in out.splitlines():
@@ -223,10 +242,13 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
             mesh_dp, mesh_tp = int(parts[1]), int(parts[2])
             if len(parts) > 3:
                 mesh_sp = int(parts[3])
+            if len(parts) > 4:
+                mesh_ep = int(parts[4])
         elif parts[0] == "strategy":
             strategies[int(parts[1])] = OpStrategy(
                 dp=int(parts[2]), tp=int(parts[3]),
                 sp=int(parts[4]) if len(parts) > 4 else 1,
+                ep=int(parts[5]) if len(parts) > 5 else 1,
             )
         elif parts[0] == "log":
             log.append(line[4:])
@@ -240,6 +262,8 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
         axes["model"] = mesh_tp
     if mesh_sp > 1 and any(s.sp > 1 for s in strategies.values()):
         axes["seq"] = mesh_sp
+    if mesh_ep > 1 and any(s.ep > 1 for s in strategies.values()):
+        axes["expert"] = mesh_ep
     return SearchResult(strategies, axes, cost, mem, log)
 
 

@@ -172,6 +172,20 @@ def feasible_sp_values(graph: Graph, config, n_devices: int) -> List[int]:
     return out
 
 
+def feasible_ep_values(graph: Graph, config, n_devices: int) -> List[int]:
+    """Concrete ep candidates (always includes 1) — the native search's
+    `eps` protocol line. Mirrors _parallelize's ep gate: ep must divide
+    every EXPERTS op's expert count and the device count."""
+    expert_counts = [op.params["n"] for op in graph.ops.values()
+                     if op.op_type == OpType.EXPERTS]
+    out = [1]
+    if expert_counts and not config.only_data_parallel:
+        out += [ep for ep in range(2, n_devices + 1)
+                if n_devices % ep == 0
+                and all(n % ep == 0 for n in expert_counts)]
+    return out
+
+
 @dataclasses.dataclass
 class SearchResult:
     strategies: Dict[int, OpStrategy]
@@ -818,12 +832,12 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                               measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
-    # a TASO rule file constrains the TP menu; expert parallelism and the
-    # joint substitution search are Python-search capabilities — only the
-    # Python search implements those
+    # a TASO rule file constrains the TP menu; attribute parallelism, row
+    # TP, the lambda memory search, pipeline parallelism, and the joint
+    # substitution search are Python-search capabilities — the native core
+    # covers (dp, tp, sp, ep)
     from .substitution import search_rules_from_spec
 
-    has_experts = any(op.op_type == OpType.EXPERTS for op in graph.ops.values())
     wants_attr = (config.enable_attribute_parallel
                   and any(op.op_type in AP_CAPABLE
                           for op in graph.ops.values()))
@@ -843,7 +857,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                 for fn in search_rules_from_spec(
                     spec, is_taso, parsed=taso_rules).values())
     )
-    if (simulator is None and not is_taso and not has_experts
+    if (simulator is None and not is_taso
             and not wants_attr and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
             and not config.enable_parameter_parallel  # row-TP is Python-only

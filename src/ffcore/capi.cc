@@ -15,11 +15,11 @@ std::string format_search_result(const SearchResult& r) {
   out.precision(17);
   out << "cost " << r.cost_us << "\n";
   out << "memory " << r.memory_bytes << "\n";
-  out << "mesh " << r.mesh_dp << " " << r.mesh_tp << " " << r.mesh_sp
-      << "\n";
+  out << "mesh " << r.mesh_dp << " " << r.mesh_tp << " " << r.mesh_sp << " "
+      << r.mesh_ep << "\n";
   for (const auto& [guid, s] : r.strategies)
     out << "strategy " << guid << " " << s.dp << " " << s.tp << " " << s.sp
-        << "\n";
+        << " " << s.ep << "\n";
   return out.str();
 }
 
@@ -49,16 +49,25 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
         n.tp_divisor >> inert;
     n.tp_capable = tp_capable;
     n.inert = inert;
-    // optional trailing sp fields (older senders omit them)
+    // optional trailing sp / ep fields (older senders omit them)
     int sp_capable = 0;
     if (ss >> sp_capable >> n.sp_divisor >> n.sp_kv_base)
       n.sp_capable = sp_capable;
+    int ep_capable = 0;
+    if (ss >> ep_capable >> n.ep_divisor >> n.ep_disp_elems >>
+        n.ep_comb_elems)
+      n.ep_capable = ep_capable;
     g.nodes.push_back(n);
   } else if (kind == "sps") {
     o.sps.clear();
     int v;
     while (ss >> v) o.sps.push_back(v);
     if (o.sps.empty()) o.sps.push_back(1);
+  } else if (kind == "eps") {
+    o.eps.clear();
+    int v;
+    while (ss >> v) o.eps.push_back(v);
+    if (o.eps.empty()) o.eps.push_back(1);
   } else if (kind == "edge") {
     EdgeDesc e;
     ss >> e.src >> e.dst >> e.bytes;

@@ -35,6 +35,7 @@ struct MachineSpec {
   double p2p_us(double bytes) const;
   double allgather_us(double bytes_per_shard, int n) const;
   double reduce_scatter_us(double bytes, int n) const;
+  double all_to_all_us(double bytes, int n) const;
   double memory_budget_bytes() const { return hbm_gb * 1e9; }
 };
 
@@ -56,7 +57,28 @@ struct NodeDesc {
   bool sp_capable = false;   // dim 1 is a position dim (not channels)
   int64_t sp_divisor = 0;    // position-dim size; sp must divide; 0 = never
   double sp_kv_base = 0;     // attention: 2*B*L_k*heads*kdim*dtype_bytes
+  // expert parallelism (ep): EXPERTS ops only. Python computes the
+  // capacity-buffer element counts (simulator.py ep_collective_time_us);
+  // the dtype multiplier is applied native-side via eff_dtype_bytes so
+  // the mixed-precision policy cannot drift between the two cost models
+  bool ep_capable = false;   // op is a fused EXPERTS op
+  int64_t ep_divisor = 0;    // number of experts n; ep must divide; 0=never
+  double ep_disp_elems = 0;  // dispatch all_to_all elements: n*cap*in_dim
+  double ep_comb_elems = 0;  // combine all_to_all elements: n*cap*out_dim
 };
+
+// Shared feasibility predicates — the search's menu enumeration and the
+// cost model must agree on them or strategies get priced as infeasible
+// (or vice versa) with no error.
+inline bool sp_feasible(const NodeDesc& n, int sp) {
+  // mirrors simulator.py sp_shardable: type/layout capability is computed
+  // Python-side (sp_capable); divisibility of the position dim here
+  return sp > 1 && n.sp_capable && n.sp_divisor > 0 && n.sp_divisor % sp == 0;
+}
+
+inline bool ep_feasible(const NodeDesc& n, int ep) {
+  return ep > 1 && n.ep_capable && n.ep_divisor > 0 && n.ep_divisor % ep == 0;
+}
 
 struct EdgeDesc {
   int64_t src = 0;
@@ -96,14 +118,18 @@ struct Options {
   // candidate sequence-parallel degrees (feasibility computed Python-side:
   // --enable-sequence-parallel, seq lens/heads divide, no attn dropout)
   std::vector<int> sps{1};
+  // candidate expert-parallel degrees (Python-side: divisors of every
+  // EXPERTS op's expert count)
+  std::vector<int> eps{1};
 };
 
 struct Strategy {
   int dp = 1;
   int tp = 1;
   int sp = 1;  // graph-wide per factorization; 1 on non-shardable ops
+  int ep = 1;  // EXPERTS ops only; 1 elsewhere
   bool operator==(const Strategy& o) const {
-    return dp == o.dp && tp == o.tp && sp == o.sp;
+    return dp == o.dp && tp == o.tp && sp == o.sp && ep == o.ep;
   }
 };
 
@@ -115,6 +141,7 @@ struct SearchResult {
   int mesh_dp = 1;
   int mesh_tp = 1;
   int mesh_sp = 1;
+  int mesh_ep = 1;
   std::map<int64_t, Strategy> strategies;
   std::string log;
 };
@@ -129,6 +156,7 @@ class CostModel {
   double backward_us(const NodeDesc& n, const Strategy& s) const;
   double tp_collective_us(const NodeDesc& n, const Strategy& s) const;
   double sp_collective_us(const NodeDesc& n, const Strategy& s) const;
+  double ep_collective_us(const NodeDesc& n, const Strategy& s) const;
   double tp_boundary_us(double bytes, const NodeDesc& src_n,
                         const Strategy& src, const Strategy& dst,
                         bool backward) const;

@@ -46,22 +46,37 @@ double MachineSpec::p2p_us(double bytes) const {
   return bytes / (ici_gbps * 1e9) * 1e6 + 1.0;
 }
 
+double MachineSpec::all_to_all_us(double bytes, int n) const {
+  if (n <= 1) return 0.0;
+  // each chip sends (n-1)/n of its bytes; torus bisection limits this
+  // (mirrors machine_model.py all_to_all_time_us)
+  return (double)(n - 1) / n * bytes / link_bw(n) * 1e6 + 1.0;
+}
+
 // ---------------------------------------------------------------- costs
 static const double kBwdFactor = 2.0;  // two grad GEMMs per fwd GEMM
-
-static bool sp_ok(const NodeDesc& n, int sp) {
-  // mirrors simulator.py sp_shardable: type/layout capability is computed
-  // Python-side (sp_capable); divisibility of the position dim here
-  return sp > 1 && n.sp_capable && n.sp_divisor > 0 && n.sp_divisor % sp == 0;
-}
 
 double CostModel::forward_us(const NodeDesc& n, const Strategy& s) const {
   if (n.inert) return 0.0;
   double shards = (double)s.dp * (n.tp_capable ? s.tp : 1);
-  if (sp_ok(n, s.sp)) shards *= s.sp;
+  if (sp_feasible(n, s.sp)) shards *= s.sp;
+  if (ep_feasible(n, s.ep)) shards *= s.ep;
   if (shards < 1) shards = 1;
   return m_.compute_time_us(n.flops / shards, n.bytes_accessed / shards,
                             eff_dtype_bytes(n));
+}
+
+double CostModel::ep_collective_us(const NodeDesc& n,
+                                   const Strategy& s) const {
+  // token routing of expert parallelism: all_to_all of the capacity
+  // buffers to resident experts and back (fwd) + the mirrored bwd pair
+  // (simulator.py ep_collective_time_us; element bases from Python)
+  if (s.ep <= 1 || !n.ep_capable) return 0.0;
+  double shard = std::max(1, s.dp * s.ep);
+  int db = eff_dtype_bytes(n);
+  double disp = n.ep_disp_elems * db / shard;
+  double comb = n.ep_comb_elems * db / shard;
+  return 2.0 * (m_.all_to_all_us(disp, s.ep) + m_.all_to_all_us(comb, s.ep));
 }
 
 double CostModel::sp_collective_us(const NodeDesc& n,
@@ -111,20 +126,27 @@ double CostModel::tp_boundary_us(double bytes, const NodeDesc& src_n,
 
 double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
   if (s.dp <= 1 || n.weight_bytes <= 0) return 0.0;
-  double wb = n.weight_bytes / std::max(1, s.tp);
+  // expert weights shard over the expert axis (simulator.py
+  // _grad_sync_uncached: wshard = ep for EXPERTS else tp)
+  double wb = n.weight_bytes /
+              std::max(1, n.ep_capable ? s.ep : s.tp);
   return m_.allreduce_us(wb, s.dp);
 }
 
 double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
-  double wb = n.weight_bytes / (n.tp_capable ? std::max(1, s.tp) : 1);
+  int wshard = n.ep_capable ? std::max(1, s.ep)
+                            : (n.tp_capable ? std::max(1, s.tp) : 1);
+  double wb = n.weight_bytes / wshard;
+  // EXPERTS outputs are data-sharded only — the expert axis shards
+  // weights/buffers, not activations (simulator.py op_memory_bytes)
   double ab = n.act_bytes / std::max(1, s.dp * s.tp);
-  if (sp_ok(n, s.sp)) ab /= s.sp;  // position-sharded activations
+  if (sp_feasible(n, s.sp)) ab /= s.sp;  // position-sharded activations
   return 3.0 * wb + ab;
 }
 
 double CostModel::op_step_us(const NodeDesc& n, const Strategy& s) const {
   return forward_us(n, s) + backward_us(n, s) + tp_collective_us(n, s) +
-         sp_collective_us(n, s);
+         sp_collective_us(n, s) + ep_collective_us(n, s);
 }
 
 // ------------------------------------------------------------- simulator
@@ -193,7 +215,9 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.forward_us(n, s), ready);
-    out_ready[n.guid] = run_comm(0.5 * cost_.sp_collective_us(n, s), fin);
+    out_ready[n.guid] = run_comm(
+        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s)),
+        fin);
   }
   // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
   std::map<int64_t, double> bwd_end;
@@ -209,7 +233,9 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.backward_us(n, s), ready);
-    fin = run_comm(0.5 * cost_.sp_collective_us(n, s), fin);
+    fin = run_comm(
+        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s)),
+        fin);
     bwd_end[n.guid] = fin;
     update_ready =
         std::max(update_ready, run_comm(cost_.grad_sync_us(n, s), fin));

@@ -15,12 +15,8 @@
 
 namespace ffcore {
 
-static bool node_sp_ok(const NodeDesc& n, int sp) {
-  return sp > 1 && n.sp_capable && n.sp_divisor > 0 && n.sp_divisor % sp == 0;
-}
-
 static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
-                                  const Options& o, int sp = 1) {
+                                  const Options& o, int sp = 1, int ep = 1) {
   std::vector<int> dps;
   if (o.batch % dp == 0) dps.push_back(dp);
   if (dp != 1) dps.push_back(1);
@@ -30,12 +26,17 @@ static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
                (n.tp_divisor == 0 ||
                 (n.tp_divisor > 0 && n.tp_divisor % tp == 0));
   if (tp_ok) tps = {tp, 1};
+  // per-op ep choice for EXPERTS ops (mirrors unity.py op_strategy_menu's
+  // eps = [ep, 1]); everything else runs ep=1
+  std::vector<int> eps = {1};
+  if (ep_feasible(n, ep) && !o.only_dp) eps = {ep, 1};
   // sp is graph-wide per factorization (per-op flips would reshard the
   // position dim at every edge): shardable ops carry it, others sp=1
-  int node_sp = node_sp_ok(n, sp) ? sp : 1;
+  int node_sp = sp_feasible(n, sp) ? sp : 1;
   std::vector<Strategy> out;
   for (int d : dps)
-    for (int t : tps) out.push_back({d, t, node_sp});
+    for (int t : tps)
+      for (int e : eps) out.push_back({d, t, node_sp, e});
   return out;
 }
 
@@ -71,7 +72,7 @@ static void best_first_flips(const Graph& g,
                              const std::vector<int64_t>& cand_guids, int dp,
                              int tp, const Options& o, CostFn cost_fn,
                              std::map<int64_t, Strategy>& best,
-                             double& best_cost, int sp = 1) {
+                             double& best_cost, int sp = 1, int ep = 1) {
   std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
   uint64_t counter = 0;
   pq.push({best_cost, counter++, best});
@@ -83,7 +84,7 @@ static void best_first_flips(const Graph& g,
     if (cur.cost > best_cost * o.alpha) continue;
     for (int64_t guid : cand_guids) {
       const NodeDesc& n = g.nodes[g.index.at(guid)];
-      for (const auto& s : menu(n, dp, tp, o, sp)) {
+      for (const auto& s : menu(n, dp, tp, o, sp, ep)) {
         if (s == cur.strategies[n.guid]) continue;
         auto cand = cur.strategies;
         cand[n.guid] = s;
@@ -100,14 +101,14 @@ static void best_first_flips(const Graph& g,
 
 static std::map<int64_t, Strategy> optimize_segment(
     const Graph& g, const Simulator& sim, const std::vector<int>& seg,
-    int dp, int tp, const Options& o, int sp = 1) {
+    int dp, int tp, const Options& o, int sp = 1, int ep = 1) {
   std::map<int64_t, Strategy> best;
   std::vector<int64_t> guids;
   // greedy seed: per-op best in isolation (menu order breaks ties)
   for (int i : seg) {
     const NodeDesc& n = g.nodes[i];
     guids.push_back(n.guid);
-    auto m = menu(n, dp, tp, o, sp);
+    auto m = menu(n, dp, tp, o, sp, ep);
     Strategy pick = m[0];
     double pc = sim.cost().op_step_us(n, pick);
     for (const auto& s : m) {
@@ -124,7 +125,7 @@ static std::map<int64_t, Strategy> optimize_segment(
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st, &seg);
                    },
-                   best, best_cost, sp);
+                   best, best_cost, sp, ep);
   return best;
 }
 
@@ -137,7 +138,7 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
                           int tp, const Options& o,
                           const std::vector<std::vector<int>>& segs,
                           std::map<int64_t, Strategy>& strategies,
-                          int sp = 1) {
+                          int sp = 1, int ep = 1) {
   if (o.budget <= 0 || g.nodes.size() < 2) return;
   std::map<int64_t, int> seg_of;
   for (size_t i = 0; i < segs.size(); ++i)
@@ -166,7 +167,7 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st);
                    },
-                   best, best_cost, sp);
+                   best, best_cost, sp, ep);
   strategies = std::move(best);
 }
 
@@ -175,14 +176,14 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
 static void mcmc_refine(const Graph& g, const Simulator& sim, int dp, int tp,
                         const Options& o,
                         std::map<int64_t, Strategy>& strategies,
-                        double& cost, int sp = 1) {
+                        double& cost, int sp = 1, int ep = 1) {
   std::mt19937_64 rng(o.seed);
   std::uniform_real_distribution<double> unif(0.0, 1.0);
   auto cur = strategies;
   double cur_cost = cost;
   for (int it = 0; it < o.mcmc_iters; ++it) {
     const NodeDesc& n = g.nodes[rng() % g.nodes.size()];
-    auto m = menu(n, dp, tp, o, sp);
+    auto m = menu(n, dp, tp, o, sp, ep);
     auto cand = cur;
     cand[n.guid] = m[rng() % m.size()];
     double c = sim.simulate(cand);
@@ -209,52 +210,63 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
   best.cost_us = -1;
   std::ostringstream log;
 
-  struct Fact { int dp, tp, sp; };
+  struct Fact { int dp, tp, sp, ep; };
   std::vector<Fact> facts;
   if (o.only_dp) {
-    facts = {{o.n_devices, 1, 1}};
+    facts = {{o.n_devices, 1, 1, 1}};
   } else {
     std::vector<int> sps = o.sps.empty() ? std::vector<int>{1} : o.sps;
+    std::vector<int> eps = o.eps.empty() ? std::vector<int>{1} : o.eps;
     for (int sp : sps) {
       if (sp < 1 || o.n_devices % sp != 0) continue;
-      int rem = o.n_devices / sp;
-      for (int dp = 1; dp <= rem; ++dp)
-        if (rem % dp == 0) facts.push_back({dp, rem / dp, sp});
+      for (int ep : eps) {
+        if (ep < 1 || (o.n_devices / sp) % ep != 0) continue;
+        int rem = o.n_devices / (sp * ep);
+        for (int dp = 1; dp <= rem; ++dp)
+          if (rem % dp == 0) facts.push_back({dp, rem / dp, sp, ep});
+      }
     }
   }
-  for (auto [dp, tp, sp] : facts) {
+  for (auto [dp, tp, sp, ep] : facts) {
     if (o.batch % dp != 0) continue;
-    // a sp>1 factorization must shard SOMETHING over the seq axis
+    // a sp>1 (ep>1) factorization must shard SOMETHING over its axis
     if (sp > 1) {
       bool any = false;
-      for (const auto& n : g.nodes) any = any || node_sp_ok(n, sp);
+      for (const auto& n : g.nodes) any = any || sp_feasible(n, sp);
+      if (!any) continue;
+    }
+    if (ep > 1) {
+      bool any = false;
+      for (const auto& n : g.nodes) any = any || ep_feasible(n, ep);
       if (!any) continue;
     }
     std::map<int64_t, Strategy> strategies;
     for (const auto& seg : segs) {
-      auto part = optimize_segment(g, sim, seg, dp, tp, o, sp);
+      auto part = optimize_segment(g, sim, seg, dp, tp, o, sp, ep);
       strategies.insert(part.begin(), part.end());
     }
     // cross-segment refinement: single-op flips against the FULL-graph
     // simulate, seeing reshard costs across segment boundaries (mirrors
     // GraphSearchHelper._refine_global)
-    refine_global(g, sim, dp, tp, o, segs, strategies, sp);
+    refine_global(g, sim, dp, tp, o, segs, strategies, sp, ep);
     double cost = sim.simulate(strategies);
-    if (o.mcmc_iters > 0) mcmc_refine(g, sim, dp, tp, o, strategies, cost, sp);
+    if (o.mcmc_iters > 0)
+      mcmc_refine(g, sim, dp, tp, o, strategies, cost, sp, ep);
     double mem = sim.memory(strategies);
     if (o.memory_search && o.memory_budget_bytes > 0 &&
         mem > o.memory_budget_bytes) {
       double overflow = (mem - o.memory_budget_bytes) / o.memory_budget_bytes;
       cost *= (1.0 + 10.0 * overflow);
     }
-    log << "dp=" << dp << " tp=" << tp << " sp=" << sp << " cost=" << cost
-        << "us mem=" << mem / 1e9 << "GB\n";
+    log << "dp=" << dp << " tp=" << tp << " sp=" << sp << " ep=" << ep
+        << " cost=" << cost << "us mem=" << mem / 1e9 << "GB\n";
     if (best.cost_us < 0 || cost < best.cost_us) {
       best.cost_us = cost;
       best.memory_bytes = mem;
       best.mesh_dp = dp;
       best.mesh_tp = tp;
       best.mesh_sp = sp;
+      best.mesh_ep = ep;
       best.strategies = std::move(strategies);
     }
   }

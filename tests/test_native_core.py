@@ -200,3 +200,53 @@ def test_native_dispatch_covers_sp():
     machine = TpuPodModel(8)
     res = unity_optimize(g, config, machine, 16, 8)
     assert any("native" in line for line in res.log)
+
+
+def moe_model(n_dev=8, batch=512):
+    """Expert-FFN-dominated graph: the winning strategy should shard the
+    EXPERTS op over the expert axis (mirrors test_experts.py's search
+    test, here for native/Python parity)."""
+    B, F, n, k, H = batch, 1024, 8, 2, 4096
+    config = ff.FFConfig()
+    config.batch_size = B
+    config.num_devices = n_dev
+    config.search_budget = 8
+    config.refine_top_k = 99  # refine every factorization: exact parity
+    model = ff.FFModel(config)
+    inp = model.create_tensor([B, F])
+    out = model.moe(inp, n, k, H, alpha=float(n), fused=True, name="moe")
+    model.dense(out, 3)
+    return config, model
+
+
+def test_native_ep_search_agrees_with_python():
+    """The native core enumerates the 'expert' axis (round 4, session 3):
+    same cost and per-op (dp, tp, ep) as the Python search on an
+    expert-dominated MoE graph — and BOTH pick ep > 1."""
+    config, model = moe_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+
+    native_res = native.optimize_strategy(g, config, machine, 512, 8)
+
+    config.use_native_search = False
+    helper = GraphSearchHelper(g, config, machine)
+    py_res = helper.graph_optimize(512, 8)
+
+    assert native_res.cost_us == pytest.approx(py_res.cost_us, rel=1e-6)
+    assert native_res.mesh_axes == py_res.mesh_axes
+    assert py_res.mesh_axes.get("expert", 1) > 1, py_res.log
+    for guid, s in py_res.strategies.items():
+        ns = native_res.strategies[guid]
+        assert (ns.dp, ns.tp, ns.ep) == (s.dp, s.tp, s.ep), g.ops[guid].name
+
+
+def test_native_dispatch_covers_experts():
+    """unity_optimize routes EXPERTS graphs through the native core now
+    (has_experts forced the Python path before round 4 session 3)."""
+    config, model = moe_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+    res = unity_optimize(g, config, machine, 512, 8)
+    assert any("native" in line for line in res.log), res.log
+    assert res.mesh_axes.get("expert", 1) > 1, res.log
