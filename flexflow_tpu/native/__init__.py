@@ -124,7 +124,8 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
     """Render the PCG + machine + options into the ffcore line protocol."""
     from ..ffconst import OpType
     from .. import search  # noqa: F401  (ensures simulator constants import)
-    from ..search.simulator import TP_CAPABLE, attn_kv_bytes, sp_capability
+    from ..search.simulator import (AP_CAPABLE, TP_CAPABLE, ap_halo_elems,
+                                    attn_kv_bytes, sp_capability)
 
     lines: List[str] = []
     if machine is not None:
@@ -149,13 +150,19 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
         )
         # sequence-parallel candidates (feasibility is Python-side: op
         # coverage, dropout gate, seq-length/head divisibility)
-        from ..search.unity import feasible_ep_values, feasible_sp_values
+        from ..search.unity import (feasible_ap_values,
+                                    feasible_ep_values,
+                                    feasible_sp_values)
 
         sps = feasible_sp_values(graph, config, n_devices)
         lines.append("sps " + " ".join(str(v) for v in sps))
         # expert-parallel candidates (divisors of every expert count)
         eps = feasible_ep_values(graph, config, n_devices)
         lines.append("eps " + " ".join(str(v) for v in eps))
+        # attribute/spatial candidates (--enable-attribute-parallel;
+        # per-op H divisibility is checked native-side via the ap fields)
+        aps = feasible_ap_values(graph, config, n_devices)
+        lines.append("aps " + " ".join(str(v) for v in aps))
     inert_types = (OpType.INPUT, OpType.NOOP, OpType.WEIGHT)
     for op in graph.topo_order():
         weight_bytes = sum(
@@ -191,13 +198,26 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             ep_divisor = n_exp
             ep_disp = n_exp * cap * x.dims[1]
             ep_comb = n_exp * cap * op.params["out_dim"]
+        # attribute/spatial fields (simulator.py AP_CAPABLE +
+        # ap_halo_time_us; divisibility checked native-side)
+        ap_capable = (op.op_type in AP_CAPABLE and op.inputs
+                      and len(op.inputs[0].dims) == 4 and op.outputs
+                      and len(op.outputs[0].dims) == 4)
+        ap_h = ap_out_h = ap_halo = 0
+        ap_stride = 1
+        if ap_capable:
+            ap_h = op.inputs[0].dims[2]
+            ap_out_h = op.outputs[0].dims[2]
+            ap_stride = max(1, op.params.get("stride_h", 1))
+            ap_halo = ap_halo_elems(op)
         lines.append(
             f"node {op.guid} {op.flops()} {op.bytes_accessed()} "
             f"{weight_bytes} {act_bytes} {out_elems} {dtype_bytes} "
             f"{int(op.op_type in TP_CAPABLE)} {_tp_divisor(op)} "
             f"{int(op.op_type in inert_types)} "
             f"{int(sp_capable)} {sp_divisor} {sp_kv_base} "
-            f"{int(ep_capable)} {ep_divisor} {ep_disp} {ep_comb}"
+            f"{int(ep_capable)} {ep_divisor} {ep_disp} {ep_comb} "
+            f"{int(ap_capable)} {ap_h} {ap_out_h} {ap_stride} {ap_halo}"
         )
     for e in graph.edges():
         t = graph.ops[e.src].outputs[e.src_idx]
@@ -227,7 +247,7 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
     )
     out = run(text)
     cost = mem = 0.0
-    mesh_dp = mesh_tp = mesh_sp = mesh_ep = 1
+    mesh_dp = mesh_tp = mesh_sp = mesh_ep = mesh_ap = 1
     strategies: Dict[int, OpStrategy] = {}
     log: List[str] = ["native ffcore search"]
     for line in out.splitlines():
@@ -244,11 +264,14 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
                 mesh_sp = int(parts[3])
             if len(parts) > 4:
                 mesh_ep = int(parts[4])
+            if len(parts) > 5:
+                mesh_ap = int(parts[5])
         elif parts[0] == "strategy":
             strategies[int(parts[1])] = OpStrategy(
                 dp=int(parts[2]), tp=int(parts[3]),
                 sp=int(parts[4]) if len(parts) > 4 else 1,
                 ep=int(parts[5]) if len(parts) > 5 else 1,
+                ap=int(parts[6]) if len(parts) > 6 else 1,
             )
         elif parts[0] == "log":
             log.append(line[4:])
@@ -264,6 +287,8 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
         axes["seq"] = mesh_sp
     if mesh_ep > 1 and any(s.ep > 1 for s in strategies.values()):
         axes["expert"] = mesh_ep
+    if mesh_ap > 1 and any(s.ap > 1 for s in strategies.values()):
+        axes["attr"] = mesh_ap
     return SearchResult(strategies, axes, cost, mem, log)
 
 

@@ -165,6 +165,23 @@ def attn_kv_bytes(op: Op, dtype_bytes: int) -> float:
     return 2.0 * k_in.dims[0] * k_in.dims[1] * heads * kdim * dtype_bytes
 
 
+def ap_halo_elems(op: Op) -> float:
+    """Full (undivided) ELEMENT count of one spatial-sharding halo
+    exchange: b * c * max(0, kernel_h - stride_h) * w over the NCHW input.
+    0 when the op has no 4D input or no kernel overlap (1x1 convs,
+    non-overlapping pools). Shared with the native core's serialization so
+    the two cost models cannot drift."""
+    if not op.inputs or len(op.inputs[0].dims) != 4:
+        return 0.0
+    kh = op.params.get("kernel_h", 1)
+    stride = max(1, op.params.get("stride_h", 1))
+    halo_rows = max(0, kh - stride)
+    if halo_rows == 0:
+        return 0.0
+    b, c, _, w = op.inputs[0].dims
+    return float(b) * c * halo_rows * w
+
+
 def sp_shardable(op: Op, sp: int) -> bool:
     """Sequence sharding applies to ops whose output carries a position dim
     at index 1 (ndim >= 3, dim 1 divisible). EXPERTS excluded: its
@@ -234,19 +251,12 @@ class CostModel:
         kernel-overlap boundary rows with its neighbors per step (GSPMD
         emits collective-permutes for the sharded conv). kernel_h == stride_h
         (1x1 convs, non-overlapping pools) needs no halo and costs none."""
-        if s.ap <= 1 or op.op_type not in AP_CAPABLE or not op.inputs:
+        if s.ap <= 1 or op.op_type not in AP_CAPABLE:
             return 0.0
-        x = op.inputs[0]  # NCHW
-        if len(x.dims) != 4:
+        elems = ap_halo_elems(op)
+        if elems <= 0:
             return 0.0
-        kh = op.params.get("kernel_h", 1)
-        stride = max(1, op.params.get("stride_h", 1))
-        halo_rows = max(0, kh - stride)
-        if halo_rows == 0:
-            return 0.0
-        b, c, _, w = x.dims
-        halo_bytes = (b / max(1, s.dp)) * c * halo_rows * w * \
-            self.op_dtype_bytes(op)
+        halo_bytes = elems * self.op_dtype_bytes(op) / max(1, s.dp)
         # exchanged once fwd + mirrored bwd
         return 2.0 * self.machine.p2p_time_us(halo_bytes)
 

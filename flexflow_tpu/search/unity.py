@@ -186,6 +186,21 @@ def feasible_ep_values(graph: Graph, config, n_devices: int) -> List[int]:
     return out
 
 
+def feasible_ap_values(graph: Graph, config, n_devices: int) -> List[int]:
+    """Concrete ap candidates (always includes 1) — the native search's
+    `aps` protocol line. Mirrors _parallelize's ap gate: the flag must be
+    on and some spatial op must divide (per-op divisibility re-checked
+    native-side via the node ap fields)."""
+    out = [1]
+    if (config.enable_attribute_parallel
+            and not config.only_data_parallel):
+        out += [ap for ap in range(2, n_devices + 1)
+                if n_devices % ap == 0
+                and any(op.op_type in AP_CAPABLE and _ap_divides(op, ap)
+                        for op in graph.ops.values())]
+    return out
+
+
 @dataclasses.dataclass
 class SearchResult:
     strategies: Dict[int, OpStrategy]
@@ -832,15 +847,11 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                               measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
-    # a TASO rule file constrains the TP menu; attribute parallelism, row
-    # TP, the lambda memory search, pipeline parallelism, and the joint
-    # substitution search are Python-search capabilities — the native core
-    # covers (dp, tp, sp, ep)
+    # a TASO rule file constrains the TP menu; row TP, the lambda memory
+    # search, pipeline parallelism, and the joint substitution search are
+    # Python-search capabilities — the native core covers
+    # (dp, tp, sp, ep, ap)
     from .substitution import search_rules_from_spec
-
-    wants_attr = (config.enable_attribute_parallel
-                  and any(op.op_type in AP_CAPABLE
-                          for op in graph.ops.values()))
     # parse TASO Rule objects once; threaded to every consumer below
     taso_rules = None
     if is_taso:
@@ -858,7 +869,7 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                     spec, is_taso, parsed=taso_rules).values())
     )
     if (simulator is None and not is_taso
-            and not wants_attr and not rewrites_applicable
+            and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
             and not config.enable_parameter_parallel  # row-TP is Python-only
             and not getattr(config, "enable_pipeline_parallel", False)

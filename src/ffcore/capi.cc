@@ -16,10 +16,10 @@ std::string format_search_result(const SearchResult& r) {
   out << "cost " << r.cost_us << "\n";
   out << "memory " << r.memory_bytes << "\n";
   out << "mesh " << r.mesh_dp << " " << r.mesh_tp << " " << r.mesh_sp << " "
-      << r.mesh_ep << "\n";
+      << r.mesh_ep << " " << r.mesh_ap << "\n";
   for (const auto& [guid, s] : r.strategies)
     out << "strategy " << guid << " " << s.dp << " " << s.tp << " " << s.sp
-        << " " << s.ep << "\n";
+        << " " << s.ep << " " << s.ap << "\n";
   return out.str();
 }
 
@@ -57,6 +57,10 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     if (ss >> ep_capable >> n.ep_divisor >> n.ep_disp_elems >>
         n.ep_comb_elems)
       n.ep_capable = ep_capable;
+    int ap_capable = 0;
+    if (ss >> ap_capable >> n.ap_h >> n.ap_out_h >> n.ap_stride >>
+        n.ap_halo_elems)
+      n.ap_capable = ap_capable;
     g.nodes.push_back(n);
   } else if (kind == "sps") {
     o.sps.clear();
@@ -68,6 +72,11 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     int v;
     while (ss >> v) o.eps.push_back(v);
     if (o.eps.empty()) o.eps.push_back(1);
+  } else if (kind == "aps") {
+    o.aps.clear();
+    int v;
+    while (ss >> v) o.aps.push_back(v);
+    if (o.aps.empty()) o.aps.push_back(1);
   } else if (kind == "edge") {
     EdgeDesc e;
     ss >> e.src >> e.dst >> e.bytes;

@@ -65,6 +65,14 @@ struct NodeDesc {
   int64_t ep_divisor = 0;    // number of experts n; ep must divide; 0=never
   double ep_disp_elems = 0;  // dispatch all_to_all elements: n*cap*in_dim
   double ep_comb_elems = 0;  // combine all_to_all elements: n*cap*out_dim
+  // attribute/spatial parallelism (ap): CONV2D/POOL2D, gated Python-side
+  // by --enable-attribute-parallel (simulator.py AP_CAPABLE +
+  // unity.py _ap_divides / ap_halo_time_us)
+  bool ap_capable = false;
+  int64_t ap_h = 0;          // input H (NCHW)
+  int64_t ap_out_h = 0;      // output H
+  int64_t ap_stride = 1;     // stride_h: shards must stride-align
+  double ap_halo_elems = 0;  // b*c*max(0,kernel_h-stride_h)*w
 };
 
 // Shared feasibility predicates — the search's menu enumeration and the
@@ -78,6 +86,13 @@ inline bool sp_feasible(const NodeDesc& n, int sp) {
 
 inline bool ep_feasible(const NodeDesc& n, int ep) {
   return ep > 1 && n.ep_capable && n.ep_divisor > 0 && n.ep_divisor % ep == 0;
+}
+
+inline bool ap_feasible(const NodeDesc& n, int ap) {
+  // mirrors unity.py _ap_divides: input AND output H divide; stride-align
+  return ap > 1 && n.ap_capable && n.ap_h > 0 && n.ap_h % ap == 0 &&
+         n.ap_out_h > 0 && n.ap_out_h % ap == 0 &&
+         (n.ap_h / ap) % (n.ap_stride > 1 ? n.ap_stride : 1) == 0;
 }
 
 struct EdgeDesc {
@@ -121,6 +136,8 @@ struct Options {
   // candidate expert-parallel degrees (Python-side: divisors of every
   // EXPERTS op's expert count)
   std::vector<int> eps{1};
+  // candidate attribute/spatial degrees (--enable-attribute-parallel)
+  std::vector<int> aps{1};
 };
 
 struct Strategy {
@@ -128,8 +145,10 @@ struct Strategy {
   int tp = 1;
   int sp = 1;  // graph-wide per factorization; 1 on non-shardable ops
   int ep = 1;  // EXPERTS ops only; 1 elsewhere
+  int ap = 1;  // CONV2D/POOL2D spatial sharding; 1 elsewhere
   bool operator==(const Strategy& o) const {
-    return dp == o.dp && tp == o.tp && sp == o.sp && ep == o.ep;
+    return dp == o.dp && tp == o.tp && sp == o.sp && ep == o.ep &&
+           ap == o.ap;
   }
 };
 
@@ -142,6 +161,7 @@ struct SearchResult {
   int mesh_tp = 1;
   int mesh_sp = 1;
   int mesh_ep = 1;
+  int mesh_ap = 1;
   std::map<int64_t, Strategy> strategies;
   std::string log;
 };
@@ -157,6 +177,7 @@ class CostModel {
   double tp_collective_us(const NodeDesc& n, const Strategy& s) const;
   double sp_collective_us(const NodeDesc& n, const Strategy& s) const;
   double ep_collective_us(const NodeDesc& n, const Strategy& s) const;
+  double ap_halo_us(const NodeDesc& n, const Strategy& s) const;
   double tp_boundary_us(double bytes, const NodeDesc& src_n,
                         const Strategy& src, const Strategy& dst,
                         bool backward) const;

@@ -61,6 +61,7 @@ double CostModel::forward_us(const NodeDesc& n, const Strategy& s) const {
   double shards = (double)s.dp * (n.tp_capable ? s.tp : 1);
   if (sp_feasible(n, s.sp)) shards *= s.sp;
   if (ep_feasible(n, s.ep)) shards *= s.ep;
+  if (ap_feasible(n, s.ap)) shards *= s.ap;
   if (shards < 1) shards = 1;
   return m_.compute_time_us(n.flops / shards, n.bytes_accessed / shards,
                             eff_dtype_bytes(n));
@@ -77,6 +78,16 @@ double CostModel::ep_collective_us(const NodeDesc& n,
   double disp = n.ep_disp_elems * db / shard;
   double comb = n.ep_comb_elems * db / shard;
   return 2.0 * (m_.all_to_all_us(disp, s.ep) + m_.all_to_all_us(comb, s.ep));
+}
+
+double CostModel::ap_halo_us(const NodeDesc& n, const Strategy& s) const {
+  // halo exchange of spatial (H) sharding: each chip swaps the
+  // kernel-overlap boundary rows with its neighbors, fwd + mirrored bwd
+  // (simulator.py ap_halo_time_us; element base from Python, zero when
+  // kernel_h == stride_h)
+  if (s.ap <= 1 || !n.ap_capable || n.ap_halo_elems <= 0) return 0.0;
+  double halo = n.ap_halo_elems * eff_dtype_bytes(n) / std::max(1, s.dp);
+  return 2.0 * m_.p2p_us(halo);
 }
 
 double CostModel::sp_collective_us(const NodeDesc& n,
@@ -125,12 +136,15 @@ double CostModel::tp_boundary_us(double bytes, const NodeDesc& src_n,
 }
 
 double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
-  if (s.dp <= 1 || n.weight_bytes <= 0) return 0.0;
+  // weights are replicated across attr shards: their grads all-reduce
+  // over the dp x ap group (simulator.py grad_sync_time_us)
+  int sync = s.dp * (n.ap_capable ? std::max(1, s.ap) : 1);
+  if (sync <= 1 || n.weight_bytes <= 0) return 0.0;
   // expert weights shard over the expert axis (simulator.py
   // _grad_sync_uncached: wshard = ep for EXPERTS else tp)
   double wb = n.weight_bytes /
               std::max(1, n.ep_capable ? s.ep : s.tp);
-  return m_.allreduce_us(wb, s.dp);
+  return m_.allreduce_us(wb, sync);
 }
 
 double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
@@ -141,12 +155,13 @@ double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
   // weights/buffers, not activations (simulator.py op_memory_bytes)
   double ab = n.act_bytes / std::max(1, s.dp * s.tp);
   if (sp_feasible(n, s.sp)) ab /= s.sp;  // position-sharded activations
+  if (ap_feasible(n, s.ap)) ab /= s.ap;  // spatially-sharded activations
   return 3.0 * wb + ab;
 }
 
 double CostModel::op_step_us(const NodeDesc& n, const Strategy& s) const {
   return forward_us(n, s) + backward_us(n, s) + tp_collective_us(n, s) +
-         sp_collective_us(n, s) + ep_collective_us(n, s);
+         sp_collective_us(n, s) + ep_collective_us(n, s) + ap_halo_us(n, s);
 }
 
 // ------------------------------------------------------------- simulator
@@ -216,7 +231,8 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
     }
     double fin = run_compute(cost_.forward_us(n, s), ready);
     out_ready[n.guid] = run_comm(
-        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s)),
+        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
+               cost_.ap_halo_us(n, s)),
         fin);
   }
   // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
@@ -234,7 +250,8 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
     }
     double fin = run_compute(cost_.backward_us(n, s), ready);
     fin = run_comm(
-        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s)),
+        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
+               cost_.ap_halo_us(n, s)),
         fin);
     bwd_end[n.guid] = fin;
     update_ready =

@@ -16,7 +16,8 @@
 namespace ffcore {
 
 static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
-                                  const Options& o, int sp = 1, int ep = 1) {
+                                  const Options& o, int sp = 1, int ep = 1,
+                                  int ap = 1) {
   std::vector<int> dps;
   if (o.batch % dp == 0) dps.push_back(dp);
   if (dp != 1) dps.push_back(1);
@@ -30,13 +31,16 @@ static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
   // eps = [ep, 1]); everything else runs ep=1
   std::vector<int> eps = {1};
   if (ep_feasible(n, ep) && !o.only_dp) eps = {ep, 1};
+  std::vector<int> aps = {1};
+  if (ap_feasible(n, ap) && !o.only_dp) aps = {ap, 1};
   // sp is graph-wide per factorization (per-op flips would reshard the
   // position dim at every edge): shardable ops carry it, others sp=1
   int node_sp = sp_feasible(n, sp) ? sp : 1;
   std::vector<Strategy> out;
   for (int d : dps)
     for (int t : tps)
-      for (int e : eps) out.push_back({d, t, node_sp, e});
+      for (int e : eps)
+        for (int a : aps) out.push_back({d, t, node_sp, e, a});
   return out;
 }
 
@@ -72,7 +76,7 @@ static void best_first_flips(const Graph& g,
                              const std::vector<int64_t>& cand_guids, int dp,
                              int tp, const Options& o, CostFn cost_fn,
                              std::map<int64_t, Strategy>& best,
-                             double& best_cost, int sp = 1, int ep = 1) {
+                             double& best_cost, int sp = 1, int ep = 1, int ap = 1) {
   std::priority_queue<Candidate, std::vector<Candidate>, std::greater<>> pq;
   uint64_t counter = 0;
   pq.push({best_cost, counter++, best});
@@ -84,7 +88,7 @@ static void best_first_flips(const Graph& g,
     if (cur.cost > best_cost * o.alpha) continue;
     for (int64_t guid : cand_guids) {
       const NodeDesc& n = g.nodes[g.index.at(guid)];
-      for (const auto& s : menu(n, dp, tp, o, sp, ep)) {
+      for (const auto& s : menu(n, dp, tp, o, sp, ep, ap)) {
         if (s == cur.strategies[n.guid]) continue;
         auto cand = cur.strategies;
         cand[n.guid] = s;
@@ -101,14 +105,15 @@ static void best_first_flips(const Graph& g,
 
 static std::map<int64_t, Strategy> optimize_segment(
     const Graph& g, const Simulator& sim, const std::vector<int>& seg,
-    int dp, int tp, const Options& o, int sp = 1, int ep = 1) {
+    int dp, int tp, const Options& o, int sp = 1, int ep = 1,
+    int ap = 1) {
   std::map<int64_t, Strategy> best;
   std::vector<int64_t> guids;
   // greedy seed: per-op best in isolation (menu order breaks ties)
   for (int i : seg) {
     const NodeDesc& n = g.nodes[i];
     guids.push_back(n.guid);
-    auto m = menu(n, dp, tp, o, sp, ep);
+    auto m = menu(n, dp, tp, o, sp, ep, ap);
     Strategy pick = m[0];
     double pc = sim.cost().op_step_us(n, pick);
     for (const auto& s : m) {
@@ -125,7 +130,7 @@ static std::map<int64_t, Strategy> optimize_segment(
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st, &seg);
                    },
-                   best, best_cost, sp, ep);
+                   best, best_cost, sp, ep, ap);
   return best;
 }
 
@@ -138,7 +143,7 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
                           int tp, const Options& o,
                           const std::vector<std::vector<int>>& segs,
                           std::map<int64_t, Strategy>& strategies,
-                          int sp = 1, int ep = 1) {
+                          int sp = 1, int ep = 1, int ap = 1) {
   if (o.budget <= 0 || g.nodes.size() < 2) return;
   std::map<int64_t, int> seg_of;
   for (size_t i = 0; i < segs.size(); ++i)
@@ -167,7 +172,7 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
                    [&](const std::map<int64_t, Strategy>& st) {
                      return sim.simulate(st);
                    },
-                   best, best_cost, sp, ep);
+                   best, best_cost, sp, ep, ap);
   strategies = std::move(best);
 }
 
@@ -176,14 +181,14 @@ static void refine_global(const Graph& g, const Simulator& sim, int dp,
 static void mcmc_refine(const Graph& g, const Simulator& sim, int dp, int tp,
                         const Options& o,
                         std::map<int64_t, Strategy>& strategies,
-                        double& cost, int sp = 1, int ep = 1) {
+                        double& cost, int sp = 1, int ep = 1, int ap = 1) {
   std::mt19937_64 rng(o.seed);
   std::uniform_real_distribution<double> unif(0.0, 1.0);
   auto cur = strategies;
   double cur_cost = cost;
   for (int it = 0; it < o.mcmc_iters; ++it) {
     const NodeDesc& n = g.nodes[rng() % g.nodes.size()];
-    auto m = menu(n, dp, tp, o, sp, ep);
+    auto m = menu(n, dp, tp, o, sp, ep, ap);
     auto cand = cur;
     cand[n.guid] = m[rng() % m.size()];
     double c = sim.simulate(cand);
@@ -210,26 +215,30 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
   best.cost_us = -1;
   std::ostringstream log;
 
-  struct Fact { int dp, tp, sp, ep; };
+  struct Fact { int dp, tp, sp, ep, ap; };
   std::vector<Fact> facts;
   if (o.only_dp) {
-    facts = {{o.n_devices, 1, 1, 1}};
+    facts = {{o.n_devices, 1, 1, 1, 1}};
   } else {
     std::vector<int> sps = o.sps.empty() ? std::vector<int>{1} : o.sps;
     std::vector<int> eps = o.eps.empty() ? std::vector<int>{1} : o.eps;
+    std::vector<int> aps = o.aps.empty() ? std::vector<int>{1} : o.aps;
     for (int sp : sps) {
       if (sp < 1 || o.n_devices % sp != 0) continue;
       for (int ep : eps) {
         if (ep < 1 || (o.n_devices / sp) % ep != 0) continue;
-        int rem = o.n_devices / (sp * ep);
-        for (int dp = 1; dp <= rem; ++dp)
-          if (rem % dp == 0) facts.push_back({dp, rem / dp, sp, ep});
+        for (int ap : aps) {
+          if (ap < 1 || (o.n_devices / sp / ep) % ap != 0) continue;
+          int rem = o.n_devices / (sp * ep * ap);
+          for (int dp = 1; dp <= rem; ++dp)
+            if (rem % dp == 0) facts.push_back({dp, rem / dp, sp, ep, ap});
+        }
       }
     }
   }
-  for (auto [dp, tp, sp, ep] : facts) {
+  for (auto [dp, tp, sp, ep, ap] : facts) {
     if (o.batch % dp != 0) continue;
-    // a sp>1 (ep>1) factorization must shard SOMETHING over its axis
+    // a sp>1 (ep>1, ap>1) factorization must shard SOMETHING over its axis
     if (sp > 1) {
       bool any = false;
       for (const auto& n : g.nodes) any = any || sp_feasible(n, sp);
@@ -240,18 +249,23 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
       for (const auto& n : g.nodes) any = any || ep_feasible(n, ep);
       if (!any) continue;
     }
+    if (ap > 1) {
+      bool any = false;
+      for (const auto& n : g.nodes) any = any || ap_feasible(n, ap);
+      if (!any) continue;
+    }
     std::map<int64_t, Strategy> strategies;
     for (const auto& seg : segs) {
-      auto part = optimize_segment(g, sim, seg, dp, tp, o, sp, ep);
+      auto part = optimize_segment(g, sim, seg, dp, tp, o, sp, ep, ap);
       strategies.insert(part.begin(), part.end());
     }
     // cross-segment refinement: single-op flips against the FULL-graph
     // simulate, seeing reshard costs across segment boundaries (mirrors
     // GraphSearchHelper._refine_global)
-    refine_global(g, sim, dp, tp, o, segs, strategies, sp, ep);
+    refine_global(g, sim, dp, tp, o, segs, strategies, sp, ep, ap);
     double cost = sim.simulate(strategies);
     if (o.mcmc_iters > 0)
-      mcmc_refine(g, sim, dp, tp, o, strategies, cost, sp, ep);
+      mcmc_refine(g, sim, dp, tp, o, strategies, cost, sp, ep, ap);
     double mem = sim.memory(strategies);
     if (o.memory_search && o.memory_budget_bytes > 0 &&
         mem > o.memory_budget_bytes) {
@@ -259,7 +273,8 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
       cost *= (1.0 + 10.0 * overflow);
     }
     log << "dp=" << dp << " tp=" << tp << " sp=" << sp << " ep=" << ep
-        << " cost=" << cost << "us mem=" << mem / 1e9 << "GB\n";
+        << " ap=" << ap << " cost=" << cost << "us mem=" << mem / 1e9
+        << "GB\n";
     if (best.cost_us < 0 || cost < best.cost_us) {
       best.cost_us = cost;
       best.memory_bytes = mem;
@@ -267,6 +282,7 @@ SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o) {
       best.mesh_tp = tp;
       best.mesh_sp = sp;
       best.mesh_ep = ep;
+      best.mesh_ap = ap;
       best.strategies = std::move(strategies);
     }
   }
