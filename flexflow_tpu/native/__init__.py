@@ -146,7 +146,8 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             f"{int(config.search_overlap_backward_update)} "
             f"{int(config.memory_search)} "
             f"{config.memory_budget_mb * 1e6 if config.memory_search else 0} "
-            f"{mcmc_iters} {config.seed}"
+            f"{mcmc_iters} {config.seed} "
+            f"{int(config.enable_parameter_parallel)}"
         )
         # sequence-parallel candidates (feasibility is Python-side: op
         # coverage, dropout gate, seq-length/head divisibility)
@@ -198,6 +199,17 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             ep_divisor = n_exp
             ep_disp = n_exp * cap * x.dims[1]
             ep_comb = n_exp * cap * op.params["out_dim"]
+        # row-parallel ("parameter"-parallel) linear fields: kernel bytes
+        # (the bias stays replicated under row sharding) and the in-feature
+        # divisor (unity.py op_strategy_menu tp_row gate)
+        row_capable = op.op_type == OpType.LINEAR
+        row_divisor = kernel_bytes = 0
+        if row_capable:
+            row_divisor = op.inputs[0].dims[-1]
+            kernel_bytes = sum(
+                w.num_elements() * w.dtype.np_dtype.itemsize
+                for w in op.weights
+                if w._weight_spec.name == "kernel")
         # attribute/spatial fields (simulator.py AP_CAPABLE +
         # ap_halo_time_us; divisibility checked native-side)
         ap_capable = (op.op_type in AP_CAPABLE and op.inputs
@@ -217,7 +229,8 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             f"{int(op.op_type in inert_types)} "
             f"{int(sp_capable)} {sp_divisor} {sp_kv_base} "
             f"{int(ep_capable)} {ep_divisor} {ep_disp} {ep_comb} "
-            f"{int(ap_capable)} {ap_h} {ap_out_h} {ap_stride} {ap_halo}"
+            f"{int(ap_capable)} {ap_h} {ap_out_h} {ap_stride} {ap_halo} "
+            f"{int(row_capable)} {row_divisor} {kernel_bytes}"
         )
     for e in graph.edges():
         t = graph.ops[e.src].outputs[e.src_idx]
@@ -272,6 +285,7 @@ def optimize_strategy(graph, config, machine, batch: int, n_devices: int,
                 sp=int(parts[4]) if len(parts) > 4 else 1,
                 ep=int(parts[5]) if len(parts) > 5 else 1,
                 ap=int(parts[6]) if len(parts) > 6 else 1,
+                tp_row=bool(int(parts[7])) if len(parts) > 7 else False,
             )
         elif parts[0] == "log":
             log.append(line[4:])

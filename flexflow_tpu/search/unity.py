@@ -871,7 +871,6 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
     if (simulator is None and not is_taso
             and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
-            and not config.enable_parameter_parallel  # row-TP is Python-only
             and not getattr(config, "enable_pipeline_parallel", False)
             and getattr(config, "use_native_search", True)):
         from .. import native

@@ -19,7 +19,7 @@ std::string format_search_result(const SearchResult& r) {
       << r.mesh_ep << " " << r.mesh_ap << "\n";
   for (const auto& [guid, s] : r.strategies)
     out << "strategy " << guid << " " << s.dp << " " << s.tp << " " << s.sp
-        << " " << s.ep << " " << s.ap << "\n";
+        << " " << s.ep << " " << s.ap << " " << (s.tp_row ? 1 : 0) << "\n";
   return out.str();
 }
 
@@ -41,6 +41,9 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     o.mixed = mixed;
     o.overlap = overlap;
     o.memory_search = memory_search;
+    // optional trailing flag (older senders omit it)
+    int param_parallel = 0;
+    if (ss >> param_parallel) o.param_parallel = param_parallel;
   } else if (kind == "node") {
     NodeDesc n;
     int tp_capable, inert;
@@ -61,6 +64,9 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     if (ss >> ap_capable >> n.ap_h >> n.ap_out_h >> n.ap_stride >>
         n.ap_halo_elems)
       n.ap_capable = ap_capable;
+    int row_capable = 0;
+    if (ss >> row_capable >> n.row_divisor >> n.kernel_bytes)
+      n.row_capable = row_capable;
     g.nodes.push_back(n);
   } else if (kind == "sps") {
     o.sps.clear();

@@ -73,6 +73,12 @@ struct NodeDesc {
   int64_t ap_out_h = 0;      // output H
   int64_t ap_stride = 1;     // stride_h: shards must stride-align
   double ap_halo_elems = 0;  // b*c*max(0,kernel_h-stride_h)*w
+  // row-parallel ("parameter"-parallel) linear: the kernel shards on the
+  // IN-feature dim, the partial-sum output all-reduces
+  // (--enable-parameter-parallel; unity.py op_strategy_menu tp_row)
+  bool row_capable = false;  // LINEAR op
+  int64_t row_divisor = 0;   // in-features; tp must divide; 0 = never
+  double kernel_bytes = 0;   // kernel weight bytes (bias replicated in row)
 };
 
 // Shared feasibility predicates — the search's menu enumeration and the
@@ -86,6 +92,13 @@ inline bool sp_feasible(const NodeDesc& n, int sp) {
 
 inline bool ep_feasible(const NodeDesc& n, int ep) {
   return ep > 1 && n.ep_capable && n.ep_divisor > 0 && n.ep_divisor % ep == 0;
+}
+
+inline bool row_feasible(const NodeDesc& n, int tp) {
+  // mirrors unity.py: enable_parameter_parallel (Options.param_parallel),
+  // LINEAR, in-features divisible
+  return tp > 1 && n.row_capable && n.row_divisor > 0 &&
+         n.row_divisor % tp == 0;
 }
 
 inline bool ap_feasible(const NodeDesc& n, int ap) {
@@ -138,6 +151,8 @@ struct Options {
   std::vector<int> eps{1};
   // candidate attribute/spatial degrees (--enable-attribute-parallel)
   std::vector<int> aps{1};
+  // row-parallel linears join the menu (--enable-parameter-parallel)
+  bool param_parallel = false;
 };
 
 struct Strategy {
@@ -146,9 +161,10 @@ struct Strategy {
   int sp = 1;  // graph-wide per factorization; 1 on non-shardable ops
   int ep = 1;  // EXPERTS ops only; 1 elsewhere
   int ap = 1;  // CONV2D/POOL2D spatial sharding; 1 elsewhere
+  bool tp_row = false;  // row-parallel linear (kernel on in-features)
   bool operator==(const Strategy& o) const {
     return dp == o.dp && tp == o.tp && sp == o.sp && ep == o.ep &&
-           ap == o.ap;
+           ap == o.ap && tp_row == o.tp_row;
   }
 };
 

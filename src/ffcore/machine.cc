@@ -105,8 +105,15 @@ double CostModel::backward_us(const NodeDesc& n, const Strategy& s) const {
 }
 
 double CostModel::tp_collective_us(const NodeDesc& n, const Strategy& s) const {
-  if (s.tp <= 1 || !n.tp_capable || n.out_elems <= 0) return 0.0;
+  if (s.tp <= 1 || !(n.tp_capable || n.row_capable) || n.out_elems <= 0)
+    return 0.0;
   double bytes = n.out_elems * eff_dtype_bytes(n) / std::max(1, s.dp);
+  if (s.tp_row) {
+    // the Megatron pair costs TWO allreduces per step: fwd partial sums
+    // here, plus the bwd allreduce at the pair entry; simulate() charges
+    // half in each pass (simulator.py tp_collective_time_us)
+    return 2.0 * m_.allreduce_us(bytes, s.tp);
+  }
   return m_.allgather_us(bytes / s.tp, s.tp) +
          m_.reduce_scatter_us(bytes, s.tp);
 }
@@ -120,15 +127,18 @@ double CostModel::xfer_us(double bytes, const Strategy& src,
 }
 
 // TP reshard on an edge: a column-parallel producer's sharded output costs
-// an allgather in fwd / gradient reduce_scatter in bwd for any consumer.
-// (The free Megatron column->row pairing needs the row-parallel mode, which
-// only the Python search emits — --enable-parameter-parallel routes there.)
-// Mirrors simulator.py tp_boundary_time_us for tp_row=False strategies.
+// an allgather in fwd / gradient reduce_scatter in bwd for any consumer,
+// EXCEPT the free Megatron column->row pairing and row producers (whose
+// outputs are replicated after their all-reduce).
 double CostModel::tp_boundary_us(double bytes, const NodeDesc& src_n,
                                  const Strategy& src, const Strategy& dst,
                                  bool backward) const {
-  (void)dst;
-  if (!src_n.tp_capable || src.tp <= 1) return 0.0;
+  // a row-parallel producer's output is replicated after its all-reduce
+  // (free edges); a column producer feeding a SAME-degree row consumer
+  // stays sharded for free — the Megatron pairing
+  // (simulator.py tp_boundary_time_us)
+  if (!src_n.tp_capable || src.tp <= 1 || src.tp_row) return 0.0;
+  if (dst.tp == src.tp && dst.tp_row) return 0.0;
   if (backward)
     return m_.reduce_scatter_us(bytes / std::max(1, src.dp), src.tp);
   double shard = bytes / std::max(1, src.dp * src.tp);
@@ -149,11 +159,20 @@ double CostModel::grad_sync_us(const NodeDesc& n, const Strategy& s) const {
 
 double CostModel::memory_bytes(const NodeDesc& n, const Strategy& s) const {
   int wshard = n.ep_capable ? std::max(1, s.ep)
-                            : (n.tp_capable ? std::max(1, s.tp) : 1);
-  double wb = n.weight_bytes / wshard;
+                            : ((n.tp_capable || n.row_capable)
+                                   ? std::max(1, s.tp) : 1);
+  double wb;
+  if (s.tp_row) {
+    // row-parallel: only the kernel shards; the bias stays replicated
+    wb = n.kernel_bytes / wshard + (n.weight_bytes - n.kernel_bytes);
+  } else {
+    wb = n.weight_bytes / wshard;
+  }
   // EXPERTS outputs are data-sharded only — the expert axis shards
-  // weights/buffers, not activations (simulator.py op_memory_bytes)
-  double ab = n.act_bytes / std::max(1, s.dp * s.tp);
+  // weights/buffers, not activations; row-parallel outputs are
+  // replicated after their all-reduce (simulator.py op_memory_bytes)
+  double ab = n.act_bytes /
+              std::max(1, s.dp * (s.tp_row ? 1 : s.tp));
   if (sp_feasible(n, s.sp)) ab /= s.sp;  // position-sharded activations
   if (ap_feasible(n, s.ap)) ab /= s.ap;  // spatially-sharded activations
   return 3.0 * wb + ab;
@@ -230,10 +249,11 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.forward_us(n, s), ready);
-    out_ready[n.guid] = run_comm(
+    double intra =
         0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
-               cost_.ap_halo_us(n, s)),
-        fin);
+               cost_.ap_halo_us(n, s));
+    if (s.tp_row) intra += 0.5 * cost_.tp_collective_us(n, s);
+    out_ready[n.guid] = run_comm(intra, fin);
   }
   // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
   std::map<int64_t, double> bwd_end;
@@ -249,10 +269,11 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.backward_us(n, s), ready);
-    fin = run_comm(
+    double intra =
         0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
-               cost_.ap_halo_us(n, s)),
-        fin);
+               cost_.ap_halo_us(n, s));
+    if (s.tp_row) intra += 0.5 * cost_.tp_collective_us(n, s);  // pair entry
+    fin = run_comm(intra, fin);
     bwd_end[n.guid] = fin;
     update_ready =
         std::max(update_ready, run_comm(cost_.grad_sync_us(n, s), fin));

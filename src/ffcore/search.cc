@@ -22,11 +22,18 @@ static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
   if (o.batch % dp == 0) dps.push_back(dp);
   if (dp != 1) dps.push_back(1);
   if (dps.empty()) dps.push_back(1);
-  std::vector<int> tps = {1};
+  // (tp, row) pairs, mirroring unity.py op_strategy_menu: column TP when
+  // the out-dim divides; row-parallel LINEAR additionally under
+  // --enable-parameter-parallel when the IN-dim divides (row can exist
+  // even where column TP is infeasible)
+  struct TpChoice { int tp; bool row; };
+  std::vector<TpChoice> tps = {{1, false}};
   bool tp_ok = tp > 1 && n.tp_capable && !o.only_dp &&
                (n.tp_divisor == 0 ||
                 (n.tp_divisor > 0 && n.tp_divisor % tp == 0));
-  if (tp_ok) tps = {tp, 1};
+  if (tp_ok) tps = {{tp, false}, {1, false}};
+  if (o.param_parallel && !o.only_dp && row_feasible(n, tp))
+    tps.push_back({tp, true});
   // per-op ep choice for EXPERTS ops (mirrors unity.py op_strategy_menu's
   // eps = [ep, 1]); everything else runs ep=1
   std::vector<int> eps = {1};
@@ -38,9 +45,10 @@ static std::vector<Strategy> menu(const NodeDesc& n, int dp, int tp,
   int node_sp = sp_feasible(n, sp) ? sp : 1;
   std::vector<Strategy> out;
   for (int d : dps)
-    for (int t : tps)
+    for (const auto& t : tps)
       for (int e : eps)
-        for (int a : aps) out.push_back({d, t, node_sp, e, a});
+        for (int a : aps)
+          out.push_back({d, t.tp, node_sp, e, a, t.row});
   return out;
 }
 

@@ -309,3 +309,43 @@ def test_native_dispatch_covers_attr():
     res = unity_optimize(g, config, machine, 4, 8)
     assert any("native" in line for line in res.log), res.log
     assert res.mesh_axes.get("attr", 1) > 1, res.log
+
+
+def megatron_model(n_dev=8, batch=8):
+    """Big paired linears under --enable-parameter-parallel: the winning
+    layout is the Megatron column->row pair."""
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.num_devices = n_dev
+    config.search_budget = 8
+    config.enable_parameter_parallel = True
+    config.refine_top_k = 99
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, 4096])
+    t = model.dense(inp, 8192, ff.ActiMode.AC_MODE_RELU, name="up")
+    t = model.dense(t, 4096, name="down")
+    model.softmax(model.dense(t, 4, name="cls"))
+    return config, model
+
+
+def test_native_row_tp_search_agrees_with_python():
+    """The native core emits row-parallel strategies (round 4, session 3):
+    same cost and per-op (dp, tp, tp_row) as the Python search under
+    --enable-parameter-parallel, and BOTH pick the column->row pairing."""
+    config, model = megatron_model()
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+
+    native_res = native.optimize_strategy(g, config, machine, 8, 8)
+
+    config.use_native_search = False
+    helper = GraphSearchHelper(g, config, machine)
+    py_res = helper.graph_optimize(8, 8)
+
+    assert native_res.cost_us == pytest.approx(py_res.cost_us, rel=1e-6)
+    assert native_res.mesh_axes == py_res.mesh_axes
+    assert any(s.tp_row for s in py_res.strategies.values()), py_res.log
+    for guid, s in py_res.strategies.items():
+        ns = native_res.strategies[guid]
+        assert (ns.dp, ns.tp, ns.tp_row) == (s.dp, s.tp, s.tp_row), \
+            g.ops[guid].name
