@@ -1,0 +1,46 @@
+"""Shared harness bits for the kernel benchmark scripts (sweep_flash,
+bench_longcontext): one platform bootstrap and one warm+sync timing idiom,
+so a fix to either applies to every script."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def force_platform_from_env() -> None:
+    """BENCH_PLATFORM=cpu validates a script off-TPU (same hook as
+    bench.py; the env var alone is ignored once the TPU site hook has
+    registered)."""
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        from flexflow_tpu.runtime.platform import force_platform
+
+        force_platform(platform)
+
+
+def timeit_grad(loss_fn, operands, iters: int, argnums=(0, 1, 2)) -> float:
+    """fwd+bwd ms/iter of `loss_fn(*operands)`: jit(grad(...)), one warm
+    call, then `iters` timed calls. Sync is a single scalar fetch — through
+    the axon tunnel, block_until_ready returns immediately for tunneled
+    buffers and per-array syncs cost one RPC each (bench.py docstring), so
+    one element forces the whole chain."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    g = jax.jit(jax.grad(loss_fn, argnums=argnums))
+
+    def sync(r):
+        leaf = r[0] if isinstance(r, (tuple, list)) else r
+        float(np.asarray(leaf.ravel()[0].astype(jnp.float32)))
+
+    sync(g(*operands))  # warm / compile
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(iters):
+        r = g(*operands)
+    sync(r)
+    return (time.perf_counter() - t0) / iters * 1e3
