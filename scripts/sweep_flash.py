@@ -12,11 +12,11 @@ import itertools
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+from _bench_util import force_platform_from_env, timeit_grad  # noqa: E402
 
 B = int(os.environ.get("SWEEP_B", 8))
 H = int(os.environ.get("SWEEP_H", 16))
@@ -27,11 +27,7 @@ ITERS = int(os.environ.get("SWEEP_ITERS", 20))
 
 
 def main():
-    platform = os.environ.get("BENCH_PLATFORM", "")
-    if platform:
-        from flexflow_tpu.runtime.platform import force_platform
-
-        force_platform(platform)
+    force_platform_from_env()
     import jax
     import jax.numpy as jnp
 
@@ -45,16 +41,9 @@ def main():
 
     def timeit(f, operands=None):
         ops_ = operands if operands is not None else (q, k, v)
-        g = jax.jit(jax.grad(
+        return timeit_grad(
             lambda q_, k_, v_: jnp.sum(f(q_, k_, v_).astype(jnp.float32) ** 2),
-            argnums=(0, 1, 2)))
-        r = g(*ops_)
-        float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))  # warm + sync
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            r = g(*ops_)
-        float(np.asarray(r[0].ravel()[0].astype(jnp.float32)))
-        return (time.perf_counter() - t0) / ITERS * 1e3
+            ops_, ITERS)
 
     from flexflow_tpu.kernels.flash_attention import flash_attention_packed
 
