@@ -62,6 +62,51 @@ def test_kv_cache_generate_matches_naive_loop():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_chunked_decode_matches_per_step_loop():
+    """tokens_per_dispatch > 1 (K decode steps per jitted scan dispatch)
+    is token-identical to the per-step loop, including a ragged final
+    chunk."""
+    b, window, n_new = 2, 12, 5
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(2).randint(1, 50, size=(b, 4)).astype(np.int32)
+
+    ref = GenerativeSession(model, max_len=window).generate(prompt, n_new)
+    got = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, tokens_per_dispatch=3)  # chunks of 3, 1 ragged
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_chunked_decode_eos_stops_same_step():
+    """With an eos_id, the chunked path stops emitting on the same step as
+    the per-step loop (speculative in-flight compute is discarded).
+    batch=1 so finished.all() genuinely fires, mid-chunk for K=4."""
+    b, window, n_new = 1, 12, 8
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(3).randint(1, 50, size=(b, 4)).astype(np.int32)
+
+    ref = GenerativeSession(model, max_len=window).generate(prompt, n_new)
+    # synthetic EOS: the token the unchunked run emits at step 1, so the
+    # stop lands mid-chunk for tokens_per_dispatch=4
+    eos = int(ref[0, 1])
+    ref_eos = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, eos_id=eos)
+    assert ref_eos.shape[1] < n_new, ref_eos  # the stop actually fired
+    got_eos = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, eos_id=eos, tokens_per_dispatch=4)
+    np.testing.assert_array_equal(got_eos, ref_eos)
+
+
+def test_generate_zero_tokens_returns_empty():
+    """max_new_tokens=0: both paths return an empty (b, 0) array."""
+    b, window = 2, 12
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(5).randint(1, 50, size=(b, 4)).astype(np.int32)
+    for k in (1, 4):
+        got = GenerativeSession(model, max_len=window).generate(
+            prompt, 0, tokens_per_dispatch=k)
+        assert got.shape == (b, 0), got.shape
+
+
 def test_kv_cache_generate_flash_prefill_matches_naive_loop():
     """use_flash=True prefill: the packed kernel fills the KV cache (its
     [b,l,h,d] view is a reshape of the packed projections) and decode steps
