@@ -125,6 +125,9 @@ class GenerativeSession:
         assert prompt_len <= window, "prompt longer than the prefill window"
         assert prompt_len + max_new_tokens <= self.max_len, "cache too small"
 
+        if max_new_tokens <= 0:
+            return np.zeros((b, 0), dtype=np.int32)
+
         padded = np.zeros((b, window), dtype=np.int32)
         padded[:, :prompt_len] = prompt_ids
         state = {**model.state, **self._caches}
@@ -132,8 +135,6 @@ class GenerativeSession:
         # next token from the last REAL prompt position
         tok = jnp.argmax(probs[:, prompt_len - 1, :], axis=-1).astype(jnp.int32)
 
-        if max_new_tokens <= 0:
-            return np.zeros((b, 0), dtype=np.int32)
         out = []
         finished = np.zeros(b, dtype=bool)
         K = max(1, int(tokens_per_dispatch))

@@ -55,6 +55,9 @@ class InferenceServer:
     def __init__(self):
         self._models: Dict[str, DynamicBatcher] = {}
         self._metrics: Dict[str, ModelMetrics] = {}
+        # name -> (GenerativeSession, lock): sessions serialize on their
+        # device state chain, so one request at a time per session
+        self._generative: Dict[str, tuple] = {}
 
     def register(self, name: str, model, max_batch_size: int = 64,
                  max_delay_ms: float = 2.0,
@@ -69,6 +72,7 @@ class InferenceServer:
 
     def unregister(self, name: str) -> None:
         b = self._models.pop(name, None)
+        self._generative.pop(name, None)
         self._metrics.pop(name, None)
         if b:
             b.stop()
@@ -95,6 +99,37 @@ class InferenceServer:
             metrics.record((time.perf_counter() - t0) * 1e3, ok=True)
         return out
 
+    def register_generative(self, name: str, session,
+                            tokens_per_dispatch: int = 8) -> None:
+        """Register a GenerativeSession for POST
+        /v2/models/<name>/generate (the incremental-decoding half of the
+        reference's Triton prototype). The session's model has a fixed
+        batch size; prompts must match it. tokens_per_dispatch is a
+        SERVER-side policy (each distinct chunk size jits a scan — letting
+        clients choose would be a compile-DoS surface)."""
+        self._generative[name] = (session, threading.Lock(),
+                                  max(1, int(tokens_per_dispatch)))
+        self._metrics.setdefault(name, ModelMetrics())
+
+    def generate(self, name: str, prompt_ids: np.ndarray,
+                 max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> np.ndarray:
+        if name not in self._generative:
+            raise KeyError(f"no generative session {name!r}")
+        session, lock, k = self._generative[name]
+        metrics = self._metrics.setdefault(name, ModelMetrics())
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with lock:
+                out = session.generate(
+                    prompt_ids, max_new_tokens, eos_id=eos_id,
+                    tokens_per_dispatch=k)
+            ok = True
+            return out
+        finally:
+            metrics.record((time.perf_counter() - t0) * 1e3, ok)
+
     def stats(self, name: Optional[str] = None):
         if name is not None:
             return self._metrics[name].stats()
@@ -119,7 +154,7 @@ class InferenceServer:
         return "\n".join(lines) + "\n"
 
     def shutdown(self):
-        for name in list(self._models):
+        for name in list(self._models) + list(self._generative):
             self.unregister(name)
 
     # -- optional HTTP endpoint ---------------------------------------
@@ -165,6 +200,31 @@ class InferenceServer:
 
             def do_POST(self):
                 parts = self.path.strip("/").split("/")
+                if (len(parts) == 4 and parts[0] == "v2"
+                        and parts[3] == "generate"):
+                    if parts[2] not in server_ref._generative:
+                        self._reply(
+                            404, {"error": f"no generative session "
+                                           f"{parts[2]!r}"})
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                        if "prompt" not in req:
+                            self._reply(
+                                400, {"error": "missing 'prompt' field"})
+                            return
+                        prompt = np.asarray(req["prompt"], dtype=np.int32)
+                        toks = server_ref.generate(
+                            parts[2], prompt,
+                            int(req.get("max_new_tokens", 16)),
+                            eos_id=req.get("eos_id"),
+                        )
+                        self._reply(200, {"tokens": toks.tolist()})
+                    except Exception as e:
+                        self._reply(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 # v2/models/<name>/infer
                 if len(parts) != 4 or parts[0] != "v2" or parts[3] != "infer":
                     self._reply(404, {"error": "not found"})

@@ -57,6 +57,54 @@ def test_dynamic_batcher_matches_direct_and_coalesces():
     np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-6)
 
 
+def test_server_generate_endpoint():
+    """POST /v2/models/<name>/generate: the server-side incremental
+    decoding role of the reference's Triton prototype — tokens match a
+    direct GenerativeSession run, stats are recorded."""
+    from tests.test_generate import _build_lm
+    from flexflow_tpu.serving.generate import GenerativeSession
+
+    b, window, n_new = 2, 12, 5
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(1).randint(1, 50, size=(b, 4)).astype(np.int32)
+    ref = GenerativeSession(model, max_len=window).generate(prompt, n_new)
+
+    server = InferenceServer()
+    # chunk size is server policy (client-chosen sizes would be a
+    # compile-DoS surface); 3 exercises ragged chunking against n_new=5
+    server.register_generative("lm", GenerativeSession(model, max_len=window),
+                               tokens_per_dispatch=3)
+    httpd = server.serve_http(port=0)
+    try:
+        port = httpd.server_address[1]
+        req = json.dumps({"prompt": prompt.tolist(),
+                          "max_new_tokens": n_new}).encode()
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v2/models/lm/generate", data=req,
+                headers={"Content-Type": "application/json"}),
+        ) as r:
+            toks = np.asarray(json.load(r)["tokens"], np.int32)
+        np.testing.assert_array_equal(toks, ref)
+        assert server.stats("lm")["requests"] == 1
+        # unknown session -> 404; malformed body -> 400
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v2/models/nope/generate",
+                    data=b"{}"))
+        assert e404.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e400:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v2/models/lm/generate",
+                    data=b"{}"))
+        assert e400.value.code == 400
+    finally:
+        httpd.shutdown()
+        server.shutdown()
+
+
 def test_batcher_propagates_errors():
     model = make_model()
     im = InferenceModel(model, batch_buckets=(4,))
