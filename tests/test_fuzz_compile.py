@@ -76,7 +76,12 @@ def test_random_graph_compiles_and_trains(seed):
         loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
         metrics=[ff.MetricsType.METRICS_ACCURACY],
     )
-    hist = model.fit(x=X, y=Y, epochs=1, verbose=False)
+    # sometimes chunk K optimizer steps per dispatch (the dataset is
+    # 4*batch samples, so K=4 is exactly one full-chunk epoch and K=2/3
+    # exercise the trailing single-step path)
+    k = int(rng.choice([1, 1, 2, 3, 4]))
+    hist = model.fit(x=X, y=Y, epochs=1, verbose=False,
+                     steps_per_execution=k)
     assert np.isfinite(hist[-1]["loss"]), hist
     pred = model.predict(X[: model.config.batch_size])
     assert np.all(np.isfinite(np.asarray(pred, np.float32)))
