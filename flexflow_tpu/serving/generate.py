@@ -73,26 +73,57 @@ class GenerativeSession:
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode, donate_argnums=(1,))
         self._decode_raw = decode
-        self._decode_scans: Dict[int, object] = {}
+        self._decode_scans: Dict[tuple, object] = {}
 
-    def _decode_scan(self, k: int):
+    @staticmethod
+    def _pick(probs, pos, base_key, temperature: float,
+              top_k: Optional[int]):
+        """Next token from a (b, vocab) distribution. temperature<=0 =
+        greedy argmax; otherwise categorical sampling at the given
+        temperature, optionally truncated to the top_k most likely tokens.
+        The key is fold_in(base_key, pos) — a function of the POSITION, so
+        chunked and per-step decoding draw identical samples."""
+        import jax
+        import jax.numpy as jnp
+
+        if temperature <= 0.0:
+            return jnp.argmax(probs, axis=-1).astype(jnp.int32)
+        logits = jnp.log(probs.astype(jnp.float32) + 1e-9) / temperature
+        if top_k is not None:
+            kk = int(top_k)
+            if kk < 1:
+                raise ValueError(f"top_k={top_k}: must be >= 1")
+            kk = min(kk, logits.shape[-1])
+            # kth-largest threshold via lax.top_k (O(V log k), the hot
+            # decode path) rather than a full sort
+            kth = jax.lax.top_k(logits, kk)[0][:, -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        key = jax.random.fold_in(base_key, pos)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def _decode_scan(self, k: int, temperature: float,
+                     top_k: Optional[int]):
         """Jitted scan of k greedy decode steps — ONE dispatch per k tokens
         (the fit(steps_per_execution) insight applied to serving: each
         dispatch through a TPU tunnel costs ~65 ms of latency, fatal at
         one-dispatch-per-token)."""
-        fn = self._decode_scans.get(k)
+        cache_key = (k, float(temperature), top_k)
+        fn = self._decode_scans.get(cache_key)
         if fn is not None:
             return fn
         import jax
-        import jax.numpy as jnp
 
         decode = self._decode_raw
+        pick = self._pick
 
-        def chunk(params, state, tok, pos0):
+        def chunk(params, state, tok, pos0, base_key):
+            import jax.numpy as jnp
+
             def body(carry, i):
                 state, tok = carry
                 probs, state = decode(params, state, tok[:, None], pos0 + i)
-                tok = jnp.argmax(probs[:, 0, :], axis=-1).astype(jnp.int32)
+                tok = pick(probs[:, 0, :], pos0 + i, base_key, temperature,
+                           top_k)
                 return (state, tok), tok
 
             (state, tok), toks = jax.lax.scan(
@@ -100,14 +131,20 @@ class GenerativeSession:
             return state, tok, toks  # toks: (k, batch)
 
         fn = jax.jit(chunk, donate_argnums=(1,))
-        self._decode_scans[k] = fn
+        self._decode_scans[cache_key] = fn
         return fn
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  eos_id: Optional[int] = None,
-                 tokens_per_dispatch: int = 1) -> np.ndarray:
-        """Greedy decoding. prompt_ids: (batch, prompt_len) int tokens.
-        Returns (batch, generated) token ids.
+                 tokens_per_dispatch: int = 1,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 seed: int = 0) -> np.ndarray:
+        """Decoding. prompt_ids: (batch, prompt_len) int tokens. Returns
+        (batch, generated) token ids. temperature=0 (default) is greedy
+        argmax; temperature>0 samples categorically (optionally truncated
+        to top_k), with per-POSITION rng keys so the same seed yields the
+        same tokens at any tokens_per_dispatch.
 
         tokens_per_dispatch > 1: K decode steps run in one jitted scan
         dispatch, with the NEXT chunk dispatched before the previous
@@ -131,9 +168,14 @@ class GenerativeSession:
         padded = np.zeros((b, window), dtype=np.int32)
         padded[:, :prompt_len] = prompt_ids
         state = {**model.state, **self._caches}
+        import jax
+
+        base_key = jax.random.PRNGKey(seed)
         probs, state = self._prefill(model.params, state, jnp.asarray(padded))
         # next token from the last REAL prompt position
-        tok = jnp.argmax(probs[:, prompt_len - 1, :], axis=-1).astype(jnp.int32)
+        tok = self._pick(probs[:, prompt_len - 1, :],
+                         jnp.asarray(prompt_len - 1, jnp.int32), base_key,
+                         temperature, top_k)
 
         out = []
         finished = np.zeros(b, dtype=bool)
@@ -165,8 +207,10 @@ class GenerativeSession:
             pending = tok[None, :]  # (1, b) device array
             while dispatched < max_new_tokens:
                 k = min(K, max_new_tokens - dispatched)
-                state, tok, toks = self._decode_scan(k)(
-                    model.params, state, tok, jnp.asarray(pos, jnp.int32))
+                state, tok, toks = self._decode_scan(
+                    k, temperature, top_k)(
+                    model.params, state, tok, jnp.asarray(pos, jnp.int32),
+                    base_key)
                 pos += k
                 dispatched += k
                 if absorb(pending):  # overlap: toks still computing
@@ -183,5 +227,6 @@ class GenerativeSession:
             pos = jnp.asarray(prompt_len + step, jnp.int32)
             probs, state = self._decode(
                 model.params, state, tok[:, None], pos)
-            tok = jnp.argmax(probs[:, 0, :], axis=-1).astype(jnp.int32)
+            tok = self._pick(probs[:, 0, :], pos, base_key, temperature,
+                             top_k)
         return np.stack(out, axis=1)

@@ -55,8 +55,10 @@ class InferenceServer:
     def __init__(self):
         self._models: Dict[str, DynamicBatcher] = {}
         self._metrics: Dict[str, ModelMetrics] = {}
-        # name -> (GenerativeSession, lock): sessions serialize on their
-        # device state chain, so one request at a time per session
+        # name -> (GenerativeSession, lock, policy dict): sessions
+        # serialize on their device state chain (one request at a time per
+        # session); the policy dict holds the registration-time decode
+        # knobs (tokens_per_dispatch/temperature/top_k)
         self._generative: Dict[str, tuple] = {}
 
     def register(self, name: str, model, max_batch_size: int = 64,
@@ -100,23 +102,29 @@ class InferenceServer:
         return out
 
     def register_generative(self, name: str, session,
-                            tokens_per_dispatch: int = 8) -> None:
+                            tokens_per_dispatch: int = 8,
+                            temperature: float = 0.0,
+                            top_k: Optional[int] = None) -> None:
         """Register a GenerativeSession for POST
         /v2/models/<name>/generate (the incremental-decoding half of the
         reference's Triton prototype). The session's model has a fixed
-        batch size; prompts must match it. tokens_per_dispatch is a
-        SERVER-side policy (each distinct chunk size jits a scan — letting
-        clients choose would be a compile-DoS surface)."""
-        self._generative[name] = (session, threading.Lock(),
-                                  max(1, int(tokens_per_dispatch)))
+        batch size; prompts must match it. tokens_per_dispatch,
+        temperature, and top_k are SERVER-side policy — each distinct
+        combination jits a decode scan, so letting clients choose them
+        would be a compile-DoS surface. Per-request `seed` is free (it is
+        an operand, not a cache key)."""
+        self._generative[name] = (
+            session, threading.Lock(),
+            {"tokens_per_dispatch": max(1, int(tokens_per_dispatch)),
+             "temperature": float(temperature), "top_k": top_k})
         self._metrics.setdefault(name, ModelMetrics())
 
     def generate(self, name: str, prompt_ids: np.ndarray,
-                 max_new_tokens: int,
-                 eos_id: Optional[int] = None) -> np.ndarray:
+                 max_new_tokens: int, eos_id: Optional[int] = None,
+                 seed: int = 0) -> np.ndarray:
         if name not in self._generative:
             raise KeyError(f"no generative session {name!r}")
-        session, lock, k = self._generative[name]
+        session, lock, policy = self._generative[name]
         metrics = self._metrics.setdefault(name, ModelMetrics())
         t0 = time.perf_counter()
         ok = False
@@ -124,7 +132,7 @@ class InferenceServer:
             with lock:
                 out = session.generate(
                     prompt_ids, max_new_tokens, eos_id=eos_id,
-                    tokens_per_dispatch=k)
+                    seed=seed, **policy)
             ok = True
             return out
         finally:
@@ -219,6 +227,7 @@ class InferenceServer:
                             parts[2], prompt,
                             int(req.get("max_new_tokens", 16)),
                             eos_id=req.get("eos_id"),
+                            seed=int(req.get("seed") or 0),
                         )
                         self._reply(200, {"tokens": toks.tolist()})
                     except Exception as e:

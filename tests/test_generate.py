@@ -96,6 +96,30 @@ def test_chunked_decode_eos_stops_same_step():
     np.testing.assert_array_equal(got_eos, ref_eos)
 
 
+def test_sampled_decode_chunk_invariant():
+    """temperature>0 sampling draws per-POSITION rng keys, so the same
+    seed yields identical tokens at any tokens_per_dispatch — and
+    different seeds yield different sequences."""
+    b, window, n_new = 2, 12, 6
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(6).randint(1, 50, size=(b, 4)).astype(np.int32)
+
+    kw = dict(temperature=1.0, top_k=10, seed=42)
+    ref = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, **kw)
+    got = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, tokens_per_dispatch=4, **kw)
+    np.testing.assert_array_equal(got, ref)
+    other = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, temperature=1.0, top_k=10, seed=43)
+    assert not np.array_equal(other, ref)
+    # temperature=0 stays exactly the greedy path
+    greedy = GenerativeSession(model, max_len=window).generate(prompt, n_new)
+    greedy0 = GenerativeSession(model, max_len=window).generate(
+        prompt, n_new, temperature=0.0, seed=7)
+    np.testing.assert_array_equal(greedy0, greedy)
+
+
 def test_generate_zero_tokens_returns_empty():
     """max_new_tokens=0: both paths return an empty (b, 0) array."""
     b, window = 2, 12
