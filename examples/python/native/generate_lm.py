@@ -37,7 +37,13 @@ def main():
     session = GenerativeSession(model, max_len=window)
     out = session.generate(prompt, max_new_tokens=10)
     print("prompt:", prompt.tolist())
-    print("generated:", out.tolist())
+    print("greedy:", out.tolist())
+    # chunked dispatch (K decode steps per jitted scan — the serving
+    # latency lever) + top-k sampling; same seed => same tokens at any K
+    sampled = session.generate(prompt, max_new_tokens=10,
+                               tokens_per_dispatch=5, temperature=0.8,
+                               top_k=20, seed=1)
+    print("sampled (top-k 20, T=0.8, K=5):", sampled.tolist())
 
 
 if __name__ == "__main__":
