@@ -493,6 +493,27 @@ def test_strategy_roundtrip_preserves_sp(tmp_path):
     assert all(s.sp == 4 and s.dp == 2 for s in loaded.values())
 
 
+def test_strategy_roundtrip_preserves_all_axes(tmp_path):
+    """Every per-op axis the searches emit — dp, tp(+row), ep, ap, sp —
+    survives export -> import (native results flow through the same
+    writer, so this also covers the native-search export path)."""
+    from flexflow_tpu.search.unity import SearchResult
+
+    model = build_mlp()
+    graph = Graph(model.ops)
+    strategies = {op.guid: OpStrategy(dp=2, tp=2, ep=2, ap=2, sp=1,
+                                      tp_row=True) for op in model.ops}
+    res = SearchResult(strategies,
+                       {"data": 2, "model": 2, "expert": 2, "attr": 2},
+                       1.0, 0.0, [])
+    path = str(tmp_path / "full_strategy.json")
+    export_strategy(res, graph, path)
+    loaded, axes = import_strategy(graph, path)
+    assert axes == {"data": 2, "model": 2, "expert": 2, "attr": 2}
+    for s in loaded.values():
+        assert (s.dp, s.tp, s.ep, s.ap, s.tp_row) == (2, 2, 2, 2, True)
+
+
 # -- MCMC user path (--strategy-search mcmc) ----------------------------
 def test_mcmc_flags_parse():
     cfg = ff.FFConfig()
