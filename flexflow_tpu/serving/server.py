@@ -129,12 +129,31 @@ class InferenceServer:
         t0 = time.perf_counter()
         ok = False
         try:
+            prompt_ids = np.asarray(prompt_ids)
+            if prompt_ids.ndim != 2 or prompt_ids.shape[0] < 1:
+                raise ValueError(
+                    "prompt must be a non-empty (n_prompts, prompt_len) "
+                    f"array of token ids; got shape {prompt_ids.shape}")
+            # partial batches pad to the session's compiled batch size by
+            # tiling the last real prompt; rows decode independently (each
+            # has its own KV-cache rows), so the real rows' tokens are
+            # exact. The eos early-stop then waits on the padded rows too
+            # — a compute, not correctness, cost.
+            b = session.model.config.batch_size
+            n_real = prompt_ids.shape[0]
+            if n_real > b:
+                raise ValueError(
+                    f"{n_real} prompts exceed the session batch size {b}")
+            padded = prompt_ids
+            if n_real < b:
+                pad = np.tile(prompt_ids[-1:], (b - n_real, 1))
+                padded = np.concatenate([prompt_ids, pad], axis=0)
             with lock:
                 out = session.generate(
-                    prompt_ids, max_new_tokens, eos_id=eos_id,
+                    padded, max_new_tokens, eos_id=eos_id,
                     seed=seed, **policy)
             ok = True
-            return out
+            return out[:n_real]
         finally:
             metrics.record((time.perf_counter() - t0) * 1e3, ok)
 
@@ -230,6 +249,8 @@ class InferenceServer:
                             seed=int(req.get("seed") or 0),
                         )
                         self._reply(200, {"tokens": toks.tolist()})
+                    except ValueError as e:  # malformed request shape
+                        self._reply(400, {"error": str(e)})
                     except Exception as e:
                         self._reply(
                             500, {"error": f"{type(e).__name__}: {e}"})

@@ -87,6 +87,17 @@ def test_server_generate_endpoint():
             toks = np.asarray(json.load(r)["tokens"], np.int32)
         np.testing.assert_array_equal(toks, ref)
         assert server.stats("lm")["requests"] == 1
+        # single-prompt request against the batch-2 session: rows decode
+        # independently, so the padded run's first row is exact
+        req1 = json.dumps({"prompt": prompt[:1].tolist(),
+                           "max_new_tokens": n_new}).encode()
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v2/models/lm/generate", data=req1,
+                headers={"Content-Type": "application/json"}),
+        ) as r:
+            toks1 = np.asarray(json.load(r)["tokens"], np.int32)
+        np.testing.assert_array_equal(toks1, ref[:1])
         # unknown session -> 404; malformed body -> 400
         with pytest.raises(urllib.error.HTTPError) as e404:
             urllib.request.urlopen(
@@ -100,6 +111,14 @@ def test_server_generate_endpoint():
                     f"http://127.0.0.1:{port}/v2/models/lm/generate",
                     data=b"{}"))
         assert e400.value.code == 400
+        # flat token list (not (n, L)) and oversize batches -> 400 too
+        for bad in ([1, 2, 3], [[1, 2]] * 5):
+            with pytest.raises(urllib.error.HTTPError) as ebad:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}/v2/models/lm/generate",
+                        data=json.dumps({"prompt": bad}).encode()))
+            assert ebad.value.code == 400, bad
     finally:
         httpd.shutdown()
         server.shutdown()
