@@ -157,13 +157,37 @@ class GenerativeSession:
         model = self.model
         b = model.config.batch_size
         window = model.input_ops[0].outputs[0].dims[1]
+        prompt_ids = np.asarray(prompt_ids)
+        if prompt_ids.ndim != 2 or prompt_ids.shape[0] < 1:
+            raise ValueError(
+                "prompt_ids must be a non-empty (n_prompts, prompt_len) "
+                f"array of token ids; got shape {prompt_ids.shape}")
+        n_real = prompt_ids.shape[0]
+        if n_real > b:
+            raise ValueError(
+                f"{n_real} prompts exceed the session batch size {b}")
+        if n_real < b:
+            # pad partial batches by tiling the last real prompt: rows
+            # decode independently (each has its own KV-cache rows), so
+            # the real rows' tokens are exact; with an eos_id the early
+            # stop waits on the padded rows too — compute, not
+            # correctness, cost
+            prompt_ids = np.concatenate(
+                [prompt_ids, np.tile(prompt_ids[-1:], (b - n_real, 1))],
+                axis=0)
         prompt_len = prompt_ids.shape[1]
-        assert prompt_ids.shape[0] == b, (prompt_ids.shape, b)
-        assert prompt_len <= window, "prompt longer than the prefill window"
-        assert prompt_len + max_new_tokens <= self.max_len, "cache too small"
+        if prompt_len > window:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the prefill window "
+                f"({window})")
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the cache capacity "
+                f"({self.max_len})")
 
         if max_new_tokens <= 0:
-            return np.zeros((b, 0), dtype=np.int32)
+            return np.zeros((n_real, 0), dtype=np.int32)
 
         padded = np.zeros((b, window), dtype=np.int32)
         padded[:, :prompt_len] = prompt_ids
@@ -214,10 +238,10 @@ class GenerativeSession:
                 pos += k
                 dispatched += k
                 if absorb(pending):  # overlap: toks still computing
-                    return np.stack(out, axis=1)
+                    return np.stack(out, axis=1)[:n_real]
                 pending = toks
             absorb(pending)
-            return np.stack(out, axis=1)
+            return np.stack(out, axis=1)[:n_real]
         for step in range(max_new_tokens):
             out.append(np.asarray(tok))
             if eos_id is not None:
@@ -229,4 +253,4 @@ class GenerativeSession:
                 model.params, state, tok[:, None], pos)
             tok = self._pick(probs[:, 0, :], pos, base_key, temperature,
                              top_k)
-        return np.stack(out, axis=1)
+        return np.stack(out, axis=1)[:n_real]

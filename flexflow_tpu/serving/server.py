@@ -113,6 +113,13 @@ class InferenceServer:
         combination jits a decode scan, so letting clients choose them
         would be a compile-DoS surface. Per-request `seed` is free (it is
         an operand, not a cache key)."""
+        # validate policy HERE: a bad registration must fail at server
+        # setup, not surface per-request (where a policy ValueError would
+        # be misreported as a client 400)
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k={top_k}: must be >= 1")
+        if float(temperature) < 0.0:
+            raise ValueError(f"temperature={temperature}: must be >= 0")
         self._generative[name] = (
             session, threading.Lock(),
             {"tokens_per_dispatch": max(1, int(tokens_per_dispatch)),
@@ -129,31 +136,15 @@ class InferenceServer:
         t0 = time.perf_counter()
         ok = False
         try:
-            prompt_ids = np.asarray(prompt_ids)
-            if prompt_ids.ndim != 2 or prompt_ids.shape[0] < 1:
-                raise ValueError(
-                    "prompt must be a non-empty (n_prompts, prompt_len) "
-                    f"array of token ids; got shape {prompt_ids.shape}")
-            # partial batches pad to the session's compiled batch size by
-            # tiling the last real prompt; rows decode independently (each
-            # has its own KV-cache rows), so the real rows' tokens are
-            # exact. The eos early-stop then waits on the padded rows too
-            # — a compute, not correctness, cost.
-            b = session.model.config.batch_size
-            n_real = prompt_ids.shape[0]
-            if n_real > b:
-                raise ValueError(
-                    f"{n_real} prompts exceed the session batch size {b}")
-            padded = prompt_ids
-            if n_real < b:
-                pad = np.tile(prompt_ids[-1:], (b - n_real, 1))
-                padded = np.concatenate([prompt_ids, pad], axis=0)
             with lock:
+                # partial batches are handled by the session itself
+                # (padding by tiling; rows decode independently); its
+                # ValueErrors describe malformed client prompts
                 out = session.generate(
-                    padded, max_new_tokens, eos_id=eos_id,
+                    np.asarray(prompt_ids), max_new_tokens, eos_id=eos_id,
                     seed=seed, **policy)
             ok = True
-            return out[:n_real]
+            return out
         finally:
             metrics.record((time.perf_counter() - t0) * 1e3, ok)
 

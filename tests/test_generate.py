@@ -120,6 +120,32 @@ def test_sampled_decode_chunk_invariant():
     np.testing.assert_array_equal(greedy0, greedy)
 
 
+def test_partial_batch_prompts_pad_and_slice():
+    """Fewer prompts than the compiled batch: the session pads by tiling
+    (rows decode independently) and returns only the real rows — exact
+    match with the corresponding rows of a full-batch run. Oversize and
+    malformed prompts raise ValueError."""
+    b, window, n_new = 2, 12, 5
+    model = _build_lm(b, window)
+    prompt = np.random.RandomState(8).randint(1, 50, size=(b, 4)).astype(np.int32)
+
+    full = GenerativeSession(model, max_len=window).generate(prompt, n_new)
+    one = GenerativeSession(model, max_len=window).generate(
+        prompt[:1], n_new, tokens_per_dispatch=3)
+    assert one.shape == (1, n_new)
+    np.testing.assert_array_equal(one, full[:1])
+
+    s = GenerativeSession(model, max_len=window)
+    import pytest
+
+    with pytest.raises(ValueError, match="exceed the session batch"):
+        s.generate(np.zeros((3, 4), np.int32), n_new)
+    with pytest.raises(ValueError, match="non-empty"):
+        s.generate(np.zeros((4,), np.int32), n_new)
+    with pytest.raises(ValueError, match="prefill window"):
+        s.generate(np.zeros((2, window + 1), np.int32), 1)
+
+
 def test_generate_zero_tokens_returns_empty():
     """max_new_tokens=0: both paths return an empty (b, 0) array."""
     b, window = 2, 12
