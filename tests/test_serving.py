@@ -124,6 +124,21 @@ def test_server_generate_endpoint():
         server.shutdown()
 
 
+def test_register_generative_validates_policy():
+    """Bad decode policy fails at REGISTRATION (a per-request failure would
+    be misreported as a client error)."""
+    from tests.test_generate import _build_lm
+    from flexflow_tpu.serving.generate import GenerativeSession
+
+    model = _build_lm(2, 12)
+    server = InferenceServer()
+    session = GenerativeSession(model, max_len=12)
+    with pytest.raises(ValueError, match="top_k"):
+        server.register_generative("lm", session, top_k=0)
+    with pytest.raises(ValueError, match="temperature"):
+        server.register_generative("lm", session, temperature=-1.0)
+
+
 def test_batcher_propagates_errors():
     model = make_model()
     im = InferenceModel(model, batch_buckets=(4,))
