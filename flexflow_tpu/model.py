@@ -937,11 +937,20 @@ class FFModel:
     # ------------------------------------------------------------------
     # training loop (reference: flexflow_cffi.py fit :2062 / eval :2106)
     # ------------------------------------------------------------------
-    def _next_rng(self):
+    def _next_rng(self, advance: int = 1):
+        """Fresh dropout key; advances the step counter by `advance`.
+
+        A K-steps-per-dispatch chunk passes advance=K so _step_count
+        counts OPTIMIZER steps, not dispatches — RecompileState warmup
+        and checkpointed step_count stay comparable across
+        steps_per_execution settings (the per-chunk key is derived from
+        the pre-increment count; the rng-stream difference vs K single
+        steps is documented at fit())."""
         import jax
 
-        self._step_count += 1
-        return jax.random.PRNGKey(self._rng_seed + self._step_count)
+        self._step_count += advance
+        return jax.random.PRNGKey(
+            self._rng_seed + self._step_count - advance + 1)
 
     def _prep_inputs(self, arrays: Sequence[np.ndarray], lo: int, hi: int):
         out = {}
@@ -985,6 +994,8 @@ class FFModel:
         trailing n mod (bs*K) samples run through the single-step path to
         keep updates-per-epoch identical. Mutually exclusive with
         accum_steps > 1."""
+        import jax
+
         assert self._compiled, "call compile() first"
         self._assert_trainable()
         if steps_per_execution > 1 and accum_steps > 1:
@@ -1083,8 +1094,6 @@ class FFModel:
                 )
 
             if steps_per_execution > 1:
-                import jax
-
                 K = steps_per_execution
                 chunks = n // (bs * K)
                 prev_mvals_k = None
@@ -1111,7 +1120,7 @@ class FFModel:
                     }
                     label_k = self.executor.shard_batch(
                         np.stack([b[1] for b in batches]), batch_axis=1)
-                    rng_k = jax.random.split(self._next_rng(), K)
+                    rng_k = jax.random.split(self._next_rng(advance=K), K)
                     # re-resolved every chunk: a recompile trigger (elastic
                     # graph alteration) invalidates and rebuilds the jitted
                     # steps mid-epoch
@@ -1160,14 +1169,19 @@ class FFModel:
                 base = step_i * accum_steps
                 inputs, label = load(base)
                 if accum_steps > 1:
+                    # ONE counter advance per optimizer update (microbatches
+                    # are sub-steps, not steps); each microbatch still gets a
+                    # distinct dropout key via split
+                    micro_keys = jax.random.split(self._next_rng(),
+                                                  accum_steps)
                     grads, mvals = self._accum_grad(
                         self.params, self.state, inputs, label,
-                        self._next_rng())
+                        micro_keys[0])
                     for k in range(1, accum_steps):
                         inputs, label = load(base + k)
                         g2, mv2 = self._accum_grad(
                             self.params, self.state, inputs, label,
-                            self._next_rng())
+                            micro_keys[k])
                         grads = self._accum_add(grads, g2)
                         mvals = {k2: mvals[k2] + mv2[k2] for k2 in mvals}
                     self.params, self.opt_state = self._accum_update(

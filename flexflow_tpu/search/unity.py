@@ -847,10 +847,10 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                               measured=get_op_cost_cache(config))
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
-    # a TASO rule file constrains the TP menu; row TP, the lambda memory
-    # search, pipeline parallelism, and the joint substitution search are
-    # Python-search capabilities — the native core covers
-    # (dp, tp, sp, ep, ap)
+    # a TASO rule file constrains the TP menu; the lambda memory search,
+    # pipeline parallelism, and the joint substitution search are
+    # Python-search capabilities — the native core covers the per-op axis
+    # space (dp, tp incl. row/Megatron pairs, sp, ep, ap)
     from .substitution import search_rules_from_spec
     # parse TASO Rule objects once; threaded to every consumer below
     taso_rules = None

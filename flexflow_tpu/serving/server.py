@@ -219,6 +219,7 @@ class InferenceServer:
             def do_POST(self):
                 parts = self.path.strip("/").split("/")
                 if (len(parts) == 4 and parts[0] == "v2"
+                        and parts[1] == "models"
                         and parts[3] == "generate"):
                     if parts[2] not in server_ref._generative:
                         self._reply(
@@ -247,7 +248,8 @@ class InferenceServer:
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 # v2/models/<name>/infer
-                if len(parts) != 4 or parts[0] != "v2" or parts[3] != "infer":
+                if (len(parts) != 4 or parts[0] != "v2"
+                        or parts[1] != "models" or parts[3] != "infer"):
                     self._reply(404, {"error": "not found"})
                     return
                 name = parts[2]

@@ -196,6 +196,10 @@ def test_steps_per_execution_matches_single_step():
     # epoch summaries agree (same updates, same metric accounting weights)
     for k in ("loss", "accuracy"):
         np.testing.assert_allclose(h1[-1][k], h2[-1][k], atol=1e-5, rtol=1e-5)
+    # _step_count counts OPTIMIZER steps under chunking, not dispatches
+    # (advisor r4: recompile warmup and checkpointed step_count must not
+    # silently mean K x more steps when fit is chunked)
+    assert plain._step_count == chunked._step_count
     # mutual exclusion with accumulation
     import pytest
 
@@ -272,3 +276,6 @@ def test_gradient_accumulation_matches_large_batch():
                     jax.tree_util.tree_leaves(small.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-5)
+    # microbatches are SUB-steps: one optimizer update advances the step
+    # counter once, same as the equivalent large-batch step
+    assert small._step_count == big._step_count == 1

@@ -177,6 +177,14 @@ def test_server_http_roundtrip():
                     f"http://127.0.0.1:{port}/v2/models/nope/infer",
                     data=b"{}"),
             )
+        # the route segment must literally be "models": /v2/<junk>/... is a
+        # 404, not an alias (advisor r4: the path matcher skipped parts[1])
+        for path in ("/v2/anything/mlp/infer", "/v2/anything/mlp/generate"):
+            with pytest.raises(urllib.error.HTTPError) as estrict:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}{path}", data=req))
+            assert estrict.value.code == 404, path
     finally:
         httpd.shutdown()
         server.shutdown()
