@@ -80,7 +80,10 @@ def _foldable(v) -> bool:
 def _fold(target: str, args, kwargs):
     """Evaluate trace-time tensor math (masks, position ids, size
     arithmetic) eagerly with numpy. Returns NotImplemented when the target
-    is not a known fold."""
+    is not a known fold. Folds run under errstate(ignore): traced models
+    legitimately build masks via log(0) -> -inf and cast +-inf sentinels
+    (HF attention masks), and a RuntimeWarning here is trace noise — or,
+    under -W error, a spurious fold failure."""
     a = [_npv(x) for x in args]
     k = {key: _npv(v) for key, v in kwargs.items()}
 
@@ -92,6 +95,13 @@ def _fold(target: str, args, kwargs):
             return tuple(rest[0])
         return tuple(rest)
 
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        # unknown targets and fold failures resolve to NotImplemented
+        # inside the dispatch
+        return _fold_dispatch(target, a, k, args, kwargs, wrap, shape_args)
+
+
+def _fold_dispatch(target, a, k, args, kwargs, wrap, shape_args):
     try:
         if target in ("add", "iadd"):
             return wrap(a[0] + a[1])

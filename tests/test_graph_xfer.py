@@ -17,7 +17,7 @@ from flexflow_tpu.search.graph_xfer import GraphXfer, xfers_from_rules
 from flexflow_tpu.search.substitution import SEARCH_RULES
 from flexflow_tpu.search.substitution_loader import load_substitution_file
 
-from tests.test_substitution_loader import REFERENCE_RULES  # noqa: E402
+from tests.test_substitution_loader import VENDORED_RULES  # noqa: E402
 
 RULES_PATH = "substitutions/tp_rules.json"
 
@@ -133,14 +133,12 @@ def test_xfer_does_not_stack_on_own_output():
     assert xfers[name](g) == []
 
 
-@pytest.mark.skipif(not os.path.exists(REFERENCE_RULES),
-                    reason="reference rule file not available")
 def test_osdi_rule_file_weight_semantics():
     """The full 640-rule OSDI file compiles into executable xfers, and
     TASO's shared-weight patterns (two linears referencing ONE weight
     external) correctly do NOT match graphs whose layers hold distinct
     weights — the binding-consistency check, not an arity accident."""
-    rules = load_substitution_file(REFERENCE_RULES)
+    rules = load_substitution_file(VENDORED_RULES)
     xfers = xfers_from_rules(rules)
     assert len(xfers) > 200  # most of the 640 compile to executable form
     config = ff.FFConfig()

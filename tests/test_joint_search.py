@@ -2,6 +2,8 @@
 GraphSearchHelper::base_optimize, substitution.cc:2229-2311): rewrites are
 best-first search actions costed by their optimal parallelization, which can
 beat greedily applying every rewrite first."""
+import os
+
 import numpy as np
 
 import flexflow_tpu as ff
@@ -71,7 +73,8 @@ def test_taso_file_activates_merge_template():
     )
     import json
 
-    with open("/root/reference/substitutions/graph_subst_3_v2.json") as f:
+    with open(os.path.join(os.path.dirname(__file__), "..", "substitutions",
+                           "graph_subst_3_v2.json")) as f:
         spec = json.load(f)
     rules = rules_from_spec(spec)
     templates = xfer_templates_from_rules(rules)

@@ -16,6 +16,11 @@ from flexflow_tpu.search.substitution_loader import (
 
 RULES_PATH = os.path.join(os.path.dirname(__file__), "..", "substitutions",
                           "tp_rules.json")
+# vendored conversion of the reference's public OSDI rule data
+# (tools/protobuf_to_json.py output, committed so the suite is
+# self-contained); the reference's own copy is a skippable cross-check
+VENDORED_RULES = os.path.join(os.path.dirname(__file__), "..",
+                              "substitutions", "graph_subst_3_v2.json")
 REFERENCE_RULES = "/root/reference/substitutions/graph_subst_3_v2.json"
 
 
@@ -58,16 +63,35 @@ def test_malformed_rule_rejected(tmp_path):
         load_substitution_file(str(p))
 
 
-@pytest.mark.skipif(not os.path.exists(REFERENCE_RULES),
-                    reason="reference rule file not mounted")
-def test_load_reference_rule_file():
-    """The loader parses the reference's full 640-rule OSDI artifact file."""
-    rules = load_substitution_file(REFERENCE_RULES)
+def test_load_full_osdi_rule_file():
+    """The loader parses the full 640-rule OSDI artifact file (vendored)."""
+    rules = load_substitution_file(VENDORED_RULES)
     assert len(rules) == 640
     s = summarize(rules)
     assert s["supported"] == len(rules)  # all op types in the file are mapped
     cands = tp_candidates_from_rules(rules)
     assert OpType.LINEAR in cands
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_RULES),
+                    reason="reference rule file not mounted")
+def test_vendored_rules_match_reference_copy():
+    """Cross-check: the vendored file parses to the same rules as the
+    reference's own JSON conversion."""
+    import json
+
+    ours = load_substitution_file(VENDORED_RULES)
+    ref = load_substitution_file(REFERENCE_RULES)
+    assert len(ours) == len(ref)
+    assert summarize(ours) == summarize(ref)
+    v = json.load(open(VENDORED_RULES))
+    r = json.load(open(REFERENCE_RULES))
+
+    def strip(rule):
+        return {k: rule[k] for k in ("srcOp", "dstOp", "mappedOutput")}
+
+    assert all(strip(a) == strip(b)
+               for a, b in zip(v["rule"], r["rule"]))
 
 
 def test_search_consumes_rule_file():

@@ -106,7 +106,9 @@ def _rule(buf, idx):  # Rule {srcOp=1*, dstOp=2*, mappedOutput=3*}
     f = _decode_message(buf)
     return {
         "_t": "Rule",
-        "name": f"rule_{idx}",
+        # same naming as the reference converter's output, so rule names in
+        # exported strategy files are interchangeable between the two
+        "name": f"taso_rule_{idx}",
         "srcOp": [_operator(b) for b in f.get(1, [])],
         "dstOp": [_operator(b) for b in f.get(2, [])],
         "mappedOutput": [_map_output(b) for b in f.get(3, [])],
