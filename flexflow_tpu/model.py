@@ -1414,6 +1414,56 @@ class FFModel:
                 return np.asarray(entry[weight_name][s])
         raise KeyError(f"no parameters for op {op_name!r}")
 
+    def adopt_params_from(self, other: "FFModel") -> None:
+        """Copy a sequentially-compiled model's parameters into this model,
+        restacking per-layer weights into the pipeline-stage tree when this
+        model is pipeline-parallel.
+
+        Use case: migrate trained weights onto a different parallelization
+        of the same graph (reference role: strategies are re-mapped onto new
+        MachineViews without re-initializing, model.cc recompile path); also
+        how GPipe == sequential numerics is asserted in tests/dryrun.
+        `other` must not itself be pipeline-parallel. The optimizer state is
+        re-initialized to match the adopted tree."""
+        import jax.numpy as jnp
+
+        if getattr(other.executor, "pipeline_plan", None) is not None:
+            raise ValueError("adopt_params_from needs a sequential source "
+                             "model (the stacked stage tree is not "
+                             "unstacked in this direction)")
+        params = dict(self.params)
+        for name in params:
+            if name == "__pipeline__":
+                continue
+            if name not in other.params:
+                raise KeyError(
+                    f"adopt_params_from: op {name!r} has no counterpart in "
+                    "the source model (same-graph models only)")
+            # copy, not alias: the source model's fit() may donate
+            params[name] = {k: jnp.array(np.asarray(v))
+                            for k, v in other.params[name].items()}
+        plan = getattr(self.executor, "pipeline_plan", None)
+        if plan is not None:
+            stacked = {}
+            for j in range(plan.segs_per_stage):
+                for r, template in enumerate(plan.segments[j]):
+                    if not template.weights:
+                        continue
+                    entry = {}
+                    for w in template.weights:
+                        wname = w._weight_spec.name
+                        slices = []
+                        for s in range(plan.n_stages):
+                            op_s = plan.segments[
+                                s * plan.segs_per_stage + j][r]
+                            slices.append(np.asarray(
+                                other.params[op_s.name][wname]))
+                        entry[wname] = jnp.stack(slices)
+                    stacked[self.executor._pp_key(j, r, template)] = entry
+            params["__pipeline__"] = stacked
+        self.params = params
+        self.opt_state = self.optimizer.init_state(self.params)
+
     def summary(self, print_fn=print) -> str:
         """Keras-style model summary: one row per op with output shape and
         parameter count; columns size to content (reference analog: the

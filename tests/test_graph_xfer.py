@@ -4,7 +4,6 @@ VERDICT r3 item 4: the rule-file loader must instantiate real source→target
 rewrites (reference: substitution_loader.h:94-187 → GraphXfer::create_xfers,
 substitution.h:119-121), not just a TP-degree menu.
 """
-import os
 
 import numpy as np
 import pytest

@@ -99,32 +99,10 @@ def test_pp_matches_sequential_numerics():
     assert plan is not None and plan.n_stages == 4
 
     # overwrite the pp model's weights with the sequential model's
-    import jax.numpy as jnp
-
-    pp_params = dict(m_pp.params)
-    stacked = {}
-    for j in range(plan.segs_per_stage):
-        for r, template in enumerate(plan.segments[j]):
-            if not template.weights:
-                continue
-            key = m_pp.executor._pp_key(j, r, template)
-            entry = {}
-            for wi, w in enumerate(template.weights):
-                wname = w._weight_spec.name
-                slices = []
-                for s in range(plan.n_stages):
-                    op_s = plan.segments[s * plan.segs_per_stage + j][r]
-                    slices.append(m_seq.params[op_s.name][wname])
-                entry[wname] = jnp.stack(slices)
-            stacked[key] = entry
-    pp_params["__pipeline__"] = stacked
-    # copy (not alias): m_seq.fit donates its params below
-    for name in pp_params:
-        if name != "__pipeline__":
-            pp_params[name] = {k: jnp.array(np.asarray(v))
-                               for k, v in m_seq.params[name].items()}
-    m_pp.params = pp_params
-    m_pp.opt_state = m_pp.optimizer.init_state(m_pp.params)
+    m_pp.adopt_params_from(m_seq)
+    # the reverse direction is explicitly unsupported
+    with pytest.raises(ValueError, match="sequential source"):
+        m_seq.adopt_params_from(m_pp)
 
     x, y = _data()
     h_seq = m_seq.fit(x, y, epochs=1, verbose=False)
