@@ -132,10 +132,12 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
         c = machine.chip
         link_mult = 2.0 if machine.version() >= 1 else 1.0
         chips_per_pod = getattr(machine, "chips_per_pod", 256)
+        channels = 1 if machine.comm_channels() else 0
         lines.append(
             f"machine {machine.num_chips} {c.peak_bf16_tflops} "
             f"{c.peak_f32_tflops} {c.hbm_gb} {c.hbm_bw_gbps} "
-            f"{c.ici_link_gbps} {c.dcn_gbps} {link_mult} {chips_per_pod}"
+            f"{c.ici_link_gbps} {c.dcn_gbps} {link_mult} {chips_per_pod} "
+            f"{channels}"
         )
     if config is not None:
         lines.append(

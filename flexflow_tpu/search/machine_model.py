@@ -104,6 +104,17 @@ class MachineModel:
     def p2p_time_us(self, bytes_: float) -> float:
         return bytes_ / (self.chip.ici_link_gbps * 1e9) * 1e6 + 1.0
 
+    def comm_channels(self) -> bool:
+        """True when the model can price independent mesh axes as disjoint
+        link sets (dp grad allreduce rides the 'data' rings while a tp
+        activation allreduce rides the 'model' rings concurrently; same-axis
+        collectives contend and serialize). This is the TPU-native analog of
+        the reference's per-link congestion queues
+        (EnhancedMachineModel, simulator.h:279-513): contention is modeled
+        at the granularity XLA's collectives actually use — torus axes —
+        instead of individual bus segments."""
+        return False
+
     def memory_budget_bytes(self) -> float:
         return self.chip.hbm_gb * 1e9
 
@@ -141,6 +152,9 @@ class TpuPodModel(MachineModel):
     def version(self) -> int:
         return 1
 
+    def comm_channels(self) -> bool:
+        return True  # a torus axis per mesh axis: disjoint link sets
+
     def link_bw(self, n_participants: int) -> float:
         if n_participants > self.chips_per_pod:
             return self.chip.dcn_gbps * 1e9
@@ -151,12 +165,18 @@ class TpuPodModel(MachineModel):
 class NetworkedMachineModel(MachineModel):
     """Explicit-topology model (reference: NetworkedMachineModel
     simulator.h:515 + network.cc routing): a chip-to-chip connection matrix
-    with per-link bandwidth; p2p cost uses BFS hop count, collectives use the
-    bottleneck link along a ring embedding."""
+    with per-link bandwidth. p2p transfers are multi-hop and SEGMENT
+    PIPELINED — a message is cut into `segment_mb` chunks so hop h forwards
+    chunk i while hop h+1 carries chunk i-1 (the reference's
+    segment-pipelining analog, network.cc) — and `routing="ecmp"` spreads a
+    transfer over the available equal-cost directions (network.cc:47
+    routing strategies). Collectives use the bottleneck link along a ring
+    embedding."""
 
     def __init__(self, num_chips: int, chip: Optional[ChipSpec] = None,
                  connection: Optional[np.ndarray] = None,
-                 link_gbps: float = 45.0):
+                 link_gbps: float = 45.0, segment_mb: float = 1.0,
+                 routing: str = "ecmp"):
         super().__init__(num_chips, chip or CHIP_SPECS["tpu-v5e"])
         if connection is None:
             # default: 1-D bidirectional ring
@@ -166,14 +186,25 @@ class NetworkedMachineModel(MachineModel):
                 connection[(i + 1) % num_chips][i] = 1
         self.connection = connection
         self.link_gbps = link_gbps
+        self.segment_bytes = segment_mb * 1e6
+        if routing not in ("ecmp", "single"):
+            raise ValueError(
+                f"routing={routing!r}: use 'ecmp' (split over equal-cost "
+                "directions) or 'single' (one path)")
+        self.routing = routing
+        self._avg_hops: Optional[float] = None
 
     def version(self) -> int:
         return 2
 
+    def comm_channels(self) -> bool:
+        return True
+
     @classmethod
     def from_json(cls, path: str, chip: Optional[ChipSpec] = None):
         """Load topology from a JSON file: {"num_chips": N, "links":
-        [[i, j, gbps], ...]} (role of --machine-model-file)."""
+        [[i, j, gbps], ...], "segment_mb": 1.0, "routing": "ecmp"} (role of
+        --machine-model-file + the reference's routing/segment knobs)."""
         with open(path) as f:
             spec = json.load(f)
         n = spec["num_chips"]
@@ -182,27 +213,69 @@ class NetworkedMachineModel(MachineModel):
         for i, j, g in spec.get("links", []):
             conn[i][j] = conn[j][i] = 1
             gbps = g
-        return cls(n, chip, conn, gbps)
+        return cls(n, chip, conn, gbps,
+                   segment_mb=float(spec.get("segment_mb", 1.0)),
+                   routing=spec.get("routing", "ecmp"))
 
-    def hop_count(self, src: int, dst: int) -> int:
+    def _adjacency(self) -> List[List[int]]:
+        adj = getattr(self, "_adj", None)
+        if adj is None:
+            adj = self._adj = [
+                [v for v in range(self.num_chips) if self.connection[u][v]]
+                for u in range(self.num_chips)
+            ]
+        return adj
+
+    def _sssp_hops(self, src: int) -> List[int]:
+        """Single-source BFS distance map (disconnected: num_chips)."""
         from collections import deque
 
-        if src == dst:
-            return 0
-        seen = {src}
-        q = deque([(src, 0)])
+        adj = self._adjacency()
+        dist = [self.num_chips] * self.num_chips
+        dist[src] = 0
+        q = deque([src])
         while q:
-            u, d = q.popleft()
-            for v in range(self.num_chips):
-                if self.connection[u][v] and v not in seen:
-                    if v == dst:
-                        return d + 1
-                    seen.add(v)
-                    q.append((v, d + 1))
-        return self.num_chips  # disconnected: worst case
+            u = q.popleft()
+            for v in adj[u]:
+                if dist[v] > dist[u] + 1:
+                    dist[v] = dist[u] + 1
+                    q.append(v)
+        return dist
+
+    def hop_count(self, src: int, dst: int) -> int:
+        return self._sssp_hops(src)[dst]
+
+    def avg_hops(self) -> float:
+        """Mean shortest-path length over distinct pairs (cached; one BFS
+        per source — the simulator hot path touches this through
+        p2p_time_us). The cost model has no device placement under GSPMD —
+        one program spans the mesh — so multi-hop depth is priced at the
+        topology's average."""
+        if self._avg_hops is None:
+            n = self.num_chips
+            if n <= 1:
+                self._avg_hops = 1.0
+            else:
+                total = sum(sum(self._sssp_hops(i)) for i in range(n))
+                self._avg_hops = max(1.0, total / (n * (n - 1)))
+        return self._avg_hops
+
+    def path_diversity(self) -> float:
+        """Equal-cost directions a transfer can split over: bounded by the
+        sparsest chip's link degree, capped at 4 (the +-x/+-y of a 2D
+        torus); 1 under single-path routing."""
+        if self.routing != "ecmp":
+            return 1.0
+        degree = max(1, int(self.connection.sum(axis=1).min()))
+        return float(min(degree, 4))
 
     def p2p_time_us(self, bytes_: float) -> float:
-        return bytes_ / (self.link_gbps * 1e9) * 1e6 + 1.0
+        bw = self.link_gbps * 1e9 * self.path_diversity()
+        seg = min(self.segment_bytes, max(bytes_, 1.0))
+        h = self.avg_hops()
+        # pipelined store-and-forward: the head segment pays every hop,
+        # the rest stream behind it at line rate
+        return (bytes_ + (h - 1.0) * seg) / bw * 1e6 + 1.0
 
     def link_bw(self, n_participants: int) -> float:
         degree = max(1, int(self.connection.sum(axis=1).min()))

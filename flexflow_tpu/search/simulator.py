@@ -681,7 +681,8 @@ class Simulator:
         self.analytic_fallbacks = 0
         self._fwd_bwd_memo: Dict[Tuple, Tuple[float, float]] = {}
         self._step_memo: Dict[Tuple, float] = {}
-        self._edge_memo: Dict[Tuple, float] = {}
+        # (data-axis reshard us, model-axis boundary us) per edge key
+        self._edge_memo: Dict[Tuple, Tuple[float, float]] = {}
 
     def fwd_bwd_time_us(self, op: Op, s: OpStrategy) -> Tuple[float, float]:
         """(fwd, bwd) from the measured cache when available, analytic
@@ -750,10 +751,18 @@ class Simulator:
         order = graph.topo_order()
         overlap = bool(self.config is None
                        or self.config.search_overlap_backward_update)
+        # per-axis ICI timelines (congestion analog of EnhancedMachineModel's
+        # per-link queues, simulator.h:279-513): collectives on the SAME mesh
+        # axis contend for its torus rings and serialize; collectives on
+        # different axes ride disjoint link sets and overlap. Machine models
+        # without a torus/topology (SimpleMachineModel) keep the single
+        # serializing timeline.
+        per_axis = overlap and self.machine.comm_channels()
         t_compute = 0.0
         t_comm = 0.0
+        t_ch = {"dp": 0.0, "tp": 0.0, "sp": 0.0, "ep": 0.0, "ap": 0.0}
 
-        def run_comm(dur: float, ready: float) -> float:
+        def run_comm(dur: float, ready: float, ch: Optional[str] = None) -> float:
             nonlocal t_comm, t_compute
             if dur <= 0.0:
                 return ready
@@ -761,9 +770,35 @@ class Simulator:
                 start = max(t_compute, ready)
                 t_compute = start + dur
                 return t_compute
-            start = max(t_comm, ready)
-            t_comm = start + dur
-            return t_comm
+            if not per_axis or ch is None:
+                # one ICI timeline; a channel-less transfer under per-axis
+                # mode crosses every axis (full-mesh reshard): barrier
+                start = max(t_comm, ready,
+                            *(t_ch.values() if per_axis else ()))
+                end = start + dur
+                t_comm = end
+                if per_axis:
+                    for k in t_ch:
+                        t_ch[k] = end
+                return end
+            start = max(t_ch[ch], ready)
+            t_ch[ch] = start + dur
+            return t_ch[ch]
+
+        def run_comm_group(dur: float, ready: float,
+                           chans: Tuple[str, ...]) -> float:
+            """A collective over a PRODUCT of mesh axes (e.g. the dp x ap
+            grad allreduce) occupies every involved axis's rings."""
+            nonlocal t_comm
+            if dur <= 0.0:
+                return ready
+            if not overlap or not per_axis:
+                return run_comm(dur, ready)
+            start = max(ready, *(t_ch[c] for c in chans))
+            end = start + dur
+            for c in chans:
+                t_ch[c] = end
+            return end
 
         def run_compute(dur: float, ready: float) -> float:
             nonlocal t_compute
@@ -773,17 +808,26 @@ class Simulator:
 
         edge_memo = self._edge_memo
 
-        def edge_comm_us(t, src_op, src_s, s, backward=False) -> float:
+        def edge_comm_us(t, src_op, src_s, s, backward=False) -> Tuple[float, float]:
+            """(data-axis reshard us, model-axis boundary us) — separate
+            channels: the dp-degree allgather rides the data rings, the TP
+            boundary collective rides the model rings."""
             key = (t.guid, src_op.guid, backward, src_s, s)
             hit = edge_memo.get(key)
             if hit is not None:
                 return hit
             bytes_ = t.num_elements() * t.dtype.np_dtype.itemsize
-            out = (self.cost.xfer_time_us(bytes_, src_s, s)
-                   + self.cost.tp_boundary_time_us(bytes_, src_op, src_s, s,
-                                                   backward=backward))
+            out = (self.cost.xfer_time_us(bytes_, src_s, s),
+                   self.cost.tp_boundary_time_us(bytes_, src_op, src_s, s,
+                                                 backward=backward))
             edge_memo[key] = out
             return out
+
+        def run_edge(t, src_op, src_s, s, ready, backward=False) -> float:
+            xfer, boundary = edge_comm_us(t, src_op, src_s, s,
+                                          backward=backward)
+            fin = run_comm(xfer, ready, "dp")
+            return run_comm(boundary, fin, "tp")
 
         # -- forward -------------------------------------------------------
         fwd_times: Dict[int, Tuple[float, float]] = {}
@@ -798,19 +842,22 @@ class Simulator:
                 if src_op is None or src_op.guid not in graph.ops:
                     continue
                 src_s = strategies.get(src_op.guid, default)
-                e = run_comm(edge_comm_us(t, src_op, src_s, s),
-                             out_ready[src_op.guid])
+                e = run_edge(t, src_op, src_s, s, out_ready[src_op.guid])
                 ready = max(ready, e)
             fin = run_compute(fwd, ready)
             # op-internal fwd collectives gate the op's output: expert
             # all_to_all, conv halos, the ring K/V rotation, and the
-            # row-parallel linear's partial-sum allreduce
-            intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
-                           + self.cost.ap_halo_time_us(op, s)
-                           + self.cost.sp_collective_time_us(op, s))
+            # row-parallel linear's partial-sum allreduce — chained (they
+            # gate each other through the op) but each on its own axis
+            fin = run_comm(0.5 * self.cost.ep_collective_time_us(op, s),
+                           fin, "ep")
+            fin = run_comm(0.5 * self.cost.ap_halo_time_us(op, s), fin, "ap")
+            fin = run_comm(0.5 * self.cost.sp_collective_time_us(op, s),
+                           fin, "sp")
             if s.tp_row:
-                intra += 0.5 * self.cost.tp_collective_time_us(op, s)
-            out_ready[op.guid] = run_comm(intra, fin)
+                fin = run_comm(0.5 * self.cost.tp_collective_time_us(op, s),
+                               fin, "tp")
+            out_ready[op.guid] = fin
 
         # -- backward (reverse topo: bwd(op) after bwd of its consumers) ---
         # consumer edges in graph serialization order (ops dict order, then
@@ -830,23 +877,32 @@ class Simulator:
             for con, t in consumer_edges[op.guid]:
                 con_s = strategies.get(con.guid, default)
                 # mirrored reshard of the input gradient
-                ready = max(ready,
-                            run_comm(edge_comm_us(t, op, s, con_s,
-                                                  backward=True),
-                                     bwd_end[con.guid]))
+                ready = max(ready, run_edge(t, op, s, con_s,
+                                            bwd_end[con.guid],
+                                            backward=True))
             fin = run_compute(bwd, ready)
-            intra = 0.5 * (self.cost.ep_collective_time_us(op, s)
-                           + self.cost.ap_halo_time_us(op, s)
-                           + self.cost.sp_collective_time_us(op, s))
+            fin = run_comm(0.5 * self.cost.ep_collective_time_us(op, s),
+                           fin, "ep")
+            fin = run_comm(0.5 * self.cost.ap_halo_time_us(op, s), fin, "ap")
+            fin = run_comm(0.5 * self.cost.sp_collective_time_us(op, s),
+                           fin, "sp")
             if s.tp_row:  # bwd allreduce at the Megatron pair entry
-                intra += 0.5 * self.cost.tp_collective_time_us(op, s)
-            fin = run_comm(intra, fin)
+                fin = run_comm(0.5 * self.cost.tp_collective_time_us(op, s),
+                               fin, "tp")
             bwd_end[op.guid] = fin
-            # weight-gradient allreduce: async on the ICI stream; the
-            # optimizer update waits for the last one (this is where dp
-            # overlap with the remaining backward is won)
+            # weight-gradient allreduce: async on the data-axis rings (plus
+            # the attr rings when the op's weights replicate across ap
+            # shards — the reduce spans the dp x ap group and contends with
+            # halo exchanges there); the optimizer update waits for the
+            # last one (this is where dp overlap with the remaining
+            # backward is won — and why it must not queue behind model-axis
+            # activation collectives)
             gs = self.cost.grad_sync_time_us(op, s)
-            update_ready = max(update_ready, run_comm(gs, fin))
+            gs_chans = (("dp", "ap") if (s.ap > 1
+                                         and op.op_type in AP_CAPABLE)
+                        else ("dp",))
+            update_ready = max(update_ready,
+                               run_comm_group(gs, fin, gs_chans))
 
         return max(t_compute, update_ready)
 

@@ -32,6 +32,9 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     ss >> m.num_chips >> m.peak_bf16_tflops >> m.peak_f32_tflops >> m.hbm_gb >>
         m.hbm_bw_gbps >> m.ici_gbps >> m.dcn_gbps >> m.link_mult >>
         m.chips_per_pod;
+    // optional trailing flag (older senders omit it)
+    int cc = 0;
+    if (ss >> cc) m.comm_channels = cc;
   } else if (kind == "options") {
     int only_dp, mixed, overlap, memory_search;
     ss >> o.n_devices >> o.batch >> o.budget >> o.alpha >> only_dp >> mixed >>

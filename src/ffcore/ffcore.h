@@ -28,6 +28,9 @@ struct MachineSpec {
   double dcn_gbps = 25.0 / 8.0;
   double link_mult = 1.0;  // 2.0 for a bidirectional torus ring
   int chips_per_pod = 256;
+  // per-mesh-axis ICI timelines in the event sim (congestion analog of the
+  // reference's per-link queues; mirrors MachineModel.comm_channels())
+  int comm_channels = 0;
 
   double link_bw(int n) const;
   double compute_time_us(double flops, double bytes, int dtype_bytes) const;
@@ -210,7 +213,7 @@ class CostModel {
 class Simulator {
  public:
   Simulator(const Graph& g, const MachineSpec& m, const Options& o)
-      : g_(g), cost_(m, o), o_(o) {}
+      : g_(g), cost_(m, o), o_(o), channels_(m.comm_channels != 0) {}
   double simulate(const std::map<int64_t, Strategy>& strategies,
                   const std::vector<int>* subset = nullptr) const;
   double memory(const std::map<int64_t, Strategy>& strategies) const;
@@ -220,6 +223,7 @@ class Simulator {
   const Graph& g_;
   CostModel cost_;
   Options o_;
+  bool channels_ = false;
 };
 
 SearchResult optimize(Graph& g, const MachineSpec& m, const Options& o);

@@ -204,27 +204,56 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
   }
   double t_compute = 0.0, t_comm = 0.0;
   const bool overlap = o_.overlap;
-  auto run_comm = [&](double dur, double ready) {
+  // per-mesh-axis ICI timelines when the machine is torus-aware (mirrors
+  // simulator.py: same-axis collectives contend, orthogonal axes overlap —
+  // the congestion analog of EnhancedMachineModel's per-link queues)
+  enum Chan { DP = 0, TP, SP, EP, AP, NCHAN };
+  const bool per_axis = overlap && channels_;
+  double t_ch[NCHAN] = {0, 0, 0, 0, 0};
+  auto run_comm = [&](double dur, double ready, int ch = -1) {
     if (dur <= 0.0) return ready;
     if (!overlap) {
       double start = std::max(t_compute, ready);
       t_compute = start + dur;
       return t_compute;
     }
-    double start = std::max(t_comm, ready);
-    t_comm = start + dur;
-    return t_comm;
+    if (!per_axis || ch < 0) {
+      double start = std::max(t_comm, ready);
+      if (per_axis)  // channel-less = full-mesh reshard: barrier all axes
+        for (double t : t_ch) start = std::max(start, t);
+      double end = start + dur;
+      t_comm = end;
+      if (per_axis)
+        for (double& t : t_ch) t = end;
+      return end;
+    }
+    double start = std::max(t_ch[ch], ready);
+    t_ch[ch] = start + dur;
+    return t_ch[ch];
+  };
+  // a collective over a PRODUCT of axes (dp x ap grad allreduce) occupies
+  // every involved axis's rings
+  auto run_comm_pair = [&](double dur, double ready, int c1, int c2) {
+    if (dur <= 0.0) return ready;
+    if (!overlap || !per_axis) return run_comm(dur, ready, -1);
+    double start = std::max(ready, std::max(t_ch[c1], t_ch[c2]));
+    double end = start + dur;
+    t_ch[c1] = t_ch[c2] = end;
+    return end;
   };
   auto run_compute = [&](double dur, double ready) {
     double start = std::max(t_compute, ready);
     t_compute = start + dur;
     return t_compute;
   };
-  auto edge_comm = [&](const EdgeDesc& e, const Strategy& ss,
-                       const Strategy& ds, bool backward) {
-    return cost_.xfer_us(e.bytes, ss, ds) +
-           cost_.tp_boundary_us(e.bytes, g_.nodes[g_.index.at(e.src)], ss, ds,
-                                backward);
+  // the dp-degree reshard rides the data rings, the TP boundary collective
+  // the model rings: separate channels, chained through the edge
+  auto run_edge = [&](const EdgeDesc& e, const Strategy& ss,
+                      const Strategy& ds, bool backward, double ready) {
+    double fin = run_comm(cost_.xfer_us(e.bytes, ss, ds), ready, DP);
+    return run_comm(cost_.tp_boundary_us(e.bytes, g_.nodes[g_.index.at(e.src)],
+                                         ss, ds, backward),
+                    fin, TP);
   };
 
   // pre-index edges by endpoint, preserving serialization order (matches
@@ -244,16 +273,15 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
     Strategy s = get(n.guid);
     double ready = 0.0;
     for (const EdgeDesc* e : by_dst[n.guid]) {
-      double fin =
-          run_comm(edge_comm(*e, get(e->src), s, false), out_ready[e->src]);
+      double fin = run_edge(*e, get(e->src), s, false, out_ready[e->src]);
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.forward_us(n, s), ready);
-    double intra =
-        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
-               cost_.ap_halo_us(n, s));
-    if (s.tp_row) intra += 0.5 * cost_.tp_collective_us(n, s);
-    out_ready[n.guid] = run_comm(intra, fin);
+    fin = run_comm(0.5 * cost_.ep_collective_us(n, s), fin, EP);
+    fin = run_comm(0.5 * cost_.ap_halo_us(n, s), fin, AP);
+    fin = run_comm(0.5 * cost_.sp_collective_us(n, s), fin, SP);
+    if (s.tp_row) fin = run_comm(0.5 * cost_.tp_collective_us(n, s), fin, TP);
+    out_ready[n.guid] = fin;
   }
   // backward: bwd(op) after bwd of its consumers + mirrored edge reshard
   std::map<int64_t, double> bwd_end;
@@ -264,19 +292,23 @@ double Simulator::simulate(const std::map<int64_t, Strategy>& strategies,
     Strategy s = get(n.guid);
     double ready = 0.0;
     for (const EdgeDesc* e : by_src[n.guid]) {
-      double fin =
-          run_comm(edge_comm(*e, s, get(e->dst), true), bwd_end[e->dst]);
+      double fin = run_edge(*e, s, get(e->dst), true, bwd_end[e->dst]);
       ready = std::max(ready, fin);
     }
     double fin = run_compute(cost_.backward_us(n, s), ready);
-    double intra =
-        0.5 * (cost_.sp_collective_us(n, s) + cost_.ep_collective_us(n, s) +
-               cost_.ap_halo_us(n, s));
-    if (s.tp_row) intra += 0.5 * cost_.tp_collective_us(n, s);  // pair entry
-    fin = run_comm(intra, fin);
+    fin = run_comm(0.5 * cost_.ep_collective_us(n, s), fin, EP);
+    fin = run_comm(0.5 * cost_.ap_halo_us(n, s), fin, AP);
+    fin = run_comm(0.5 * cost_.sp_collective_us(n, s), fin, SP);
+    if (s.tp_row) fin = run_comm(0.5 * cost_.tp_collective_us(n, s), fin, TP);
     bwd_end[n.guid] = fin;
-    update_ready =
-        std::max(update_ready, run_comm(cost_.grad_sync_us(n, s), fin));
+    // grad allreduce rides the data rings (plus the attr rings when the
+    // reduce spans the dp x ap group); must not queue behind model-axis
+    // activation collectives
+    double gs = cost_.grad_sync_us(n, s);
+    double gend = (s.ap > 1 && n.ap_capable)
+                      ? run_comm_pair(gs, fin, DP, AP)
+                      : run_comm(gs, fin, DP);
+    update_ready = std::max(update_ready, gend);
   }
   return std::max(t_compute, update_ready);
 }

@@ -55,19 +55,77 @@ def test_networked_machine_model_topology():
     m = NetworkedMachineModel(8)
     assert m.hop_count(0, 1) == 1
     assert m.hop_count(0, 4) == 4  # ring distance
-    assert m.p2p_time_us(45e9) == pytest.approx(1e6 + 1, rel=0.01)
+    # ECMP on the bidirectional ring splits over both directions: a 45 GB
+    # transfer at 2 x 45 GB/s streams in ~0.5 s (+ the pipelined head)
+    assert m.p2p_time_us(45e9) == pytest.approx(0.5e6, rel=0.01)
+    # single-path routing pays the full serial time
+    m1 = NetworkedMachineModel(8, routing="single")
+    assert m1.p2p_time_us(45e9) == pytest.approx(1e6, rel=0.01)
+    with pytest.raises(ValueError, match="routing"):
+        NetworkedMachineModel(8, routing="magic")
+
+
+def test_networked_segment_pipelining():
+    """Multi-hop transfers pipeline per segment (reference role:
+    network.cc segment pipelining): on a ring with avg hops > 1, a large
+    message costs ~bytes/bw plus ONE extra segment per extra hop, far less
+    than hops x serial; shrinking the segment shrinks the overhead."""
+    m_small = NetworkedMachineModel(8, segment_mb=0.125, routing="single")
+    m_big = NetworkedMachineModel(8, segment_mb=8.0, routing="single")
+    bytes_ = 64e6
+    serial_one_hop = bytes_ / (m_small.link_gbps * 1e9) * 1e6
+    t_small = m_small.p2p_time_us(bytes_)
+    t_big = m_big.p2p_time_us(bytes_)
+    assert serial_one_hop < t_small < t_big
+    # both are far below paying every hop at line rate
+    assert t_big < m_big.avg_hops() * serial_one_hop * 0.8
+    # tiny message: the segment clamps to the message, cost ~ hops x msg
+    t_tiny = m_small.p2p_time_us(1e3)
+    assert t_tiny < 2.0  # dominated by the +1us latency term
 
 
 def test_machine_model_json_loading(tmp_path):
-    spec = {"num_chips": 4, "links": [[0, 1, 45.0], [1, 2, 45.0], [2, 3, 45.0], [3, 0, 45.0]]}
+    spec = {"num_chips": 4, "segment_mb": 0.5, "routing": "single",
+            "links": [[0, 1, 45.0], [1, 2, 45.0], [2, 3, 45.0], [3, 0, 45.0]]}
     p = tmp_path / "machine.json"
     p.write_text(json.dumps(spec))
     m = NetworkedMachineModel.from_json(str(p))
     assert m.num_chips == 4
     assert m.hop_count(0, 2) == 2
+    assert m.segment_bytes == 0.5e6 and m.routing == "single"
 
 
 # -- simulator ----------------------------------------------------------
+def test_per_axis_comm_channels_overlap():
+    """Congestion analog of EnhancedMachineModel's per-link queues: on a
+    torus-aware machine, dp grad allreduces (data rings) overlap tp
+    boundary collectives (model rings) instead of queuing behind them; a
+    flat machine serializes all comm on one timeline. Same formulas, so
+    the channel-split schedule can only be <= the single-stream one."""
+    model = build_mlp(batch=1024, din=2048, hidden=4096)
+    graph = Graph(model.ops)
+    machine = TpuPodModel(8)
+    strategies = {
+        op.guid: (OpStrategy(dp=4, tp=2) if op.op_type == OpType.LINEAR
+                  else OpStrategy(dp=4))
+        for op in model.ops
+    }
+    sim = Simulator(machine, model.config)
+    t_channels = sim.simulate(graph, strategies)
+
+    class FlatTpuPod(TpuPodModel):
+        def comm_channels(self):
+            return False
+
+    sim_flat = Simulator(FlatTpuPod(8), model.config)
+    t_flat = sim_flat.simulate(graph, strategies)
+    assert t_channels < t_flat  # the dp/tp overlap must buy real time
+    # with only one comm axis in use the two schedules coincide
+    dp_only = {op.guid: OpStrategy(dp=8) for op in model.ops}
+    assert sim.simulate(graph, dp_only) == pytest.approx(
+        sim_flat.simulate(graph, dp_only), rel=1e-9)
+
+
 def test_simulator_dp_speedup():
     # batch large enough that per-step compute dwarfs the gradient allreduce
     model = build_mlp(batch=16384, din=1024, hidden=4096)
