@@ -405,6 +405,8 @@ def main():
                 "unit": "samples/sec/chip",
                 "vs_baseline": round(vs_baseline, 3),
                 "a100_anchor_samples_per_sec": round(a100_est, 1),
+                "anchor_note": "assumed A100@45%MFU analytic anchor "
+                               "(BASELINE.md publishes no reference number)",
                 "mfu_vs_v5e_peak": round(
                     samples_per_sec * train_step_flops() / 197e12, 3),
                 "attention_path": best,

@@ -125,7 +125,8 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
     from ..ffconst import OpType
     from .. import search  # noqa: F401  (ensures simulator constants import)
     from ..search.simulator import (AP_CAPABLE, TP_CAPABLE, ap_halo_elems,
-                                    attn_kv_bytes, sp_capability)
+                                    attn_kv_bytes, attn_q_bytes,
+                                    attn_sp_ulysses, sp_capability)
 
     lines: List[str] = []
     if machine is not None:
@@ -232,7 +233,8 @@ def serialize_graph(graph, machine=None, config=None, batch: int = 1,
             f"{int(sp_capable)} {sp_divisor} {sp_kv_base} "
             f"{int(ep_capable)} {ep_divisor} {ep_disp} {ep_comb} "
             f"{int(ap_capable)} {ap_h} {ap_out_h} {ap_stride} {ap_halo} "
-            f"{int(row_capable)} {row_divisor} {kernel_bytes}"
+            f"{int(row_capable)} {row_divisor} {kernel_bytes} "
+            f"{int(attn_sp_ulysses(op))} {attn_q_bytes(op, el)}"
         )
     for e in graph.edges():
         t = graph.ops[e.src].outputs[e.src_idx]

@@ -165,6 +165,28 @@ def attn_kv_bytes(op: Op, dtype_bytes: int) -> float:
     return 2.0 * k_in.dims[0] * k_in.dims[1] * heads * kdim * dtype_bytes
 
 
+def attn_q_bytes(op: Op, dtype_bytes: int) -> float:
+    """One q (or out) tensor's full bytes under Ulysses SP:
+    B * L_q * heads * kdim * dtype_bytes. L_q != L_kv for cross-attention.
+    Shared with the native core."""
+    if (op.op_type != OpType.MULTIHEAD_ATTENTION or not op.inputs
+            or len(op.inputs[0].dims) < 3):
+        return 0.0
+    q_in = op.inputs[0]
+    heads = op.params.get("num_heads", 1)
+    kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
+    return float(q_in.dims[0] * q_in.dims[1] * heads * kdim * dtype_bytes)
+
+
+def attn_sp_ulysses(op: Op) -> bool:
+    """True when the attention op requests the all_to_all (Ulysses) SP
+    kernel rather than the ring. Shared with the native core's node
+    serialization so the two cost models cannot drift."""
+    return (op.op_type == OpType.MULTIHEAD_ATTENTION
+            and op.params.get("sequence_parallel_mode") in ("ulysses",
+                                                            "all_to_all"))
+
+
 def ap_halo_elems(op: Op) -> float:
     """Full (undivided) ELEMENT count of one spatial-sharding halo
     exchange: b * c * max(0, kernel_h - stride_h) * w over the NCHW input.
@@ -261,16 +283,34 @@ class CostModel:
         return 2.0 * self.machine.p2p_time_us(halo_bytes)
 
     def sp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
-        """Ring-attention K/V rotation cost under sequence parallelism:
-        (sp-1) neighbor ppermutes of the local K and V blocks, forward, and
-        the mirrored rotation of their gradients in backward (the ring scan
-        reverses). Non-attention ops pay nothing — GSPMD keeps their
-        position-sharded activations local."""
+        """Sequence-parallel comm cost, MODE-AWARE:
+
+        - ring (default): (sp-1) neighbor ppermutes of the local K and V
+          blocks, forward, plus the mirrored rotation of their gradients in
+          backward (the ring scan reverses).
+        - ulysses/all_to_all: q/k/v all_to_all from seq- to head-sharding,
+          exact local attention, output all_to_all back — 4 tensor blocks
+          forward, mirrored in backward. Less traffic than the ring from
+          sp>=2 (8/sp tensor-blocks vs 2(sp-1) K+V blocks), which is why
+          the kernel exists; the head-divisibility gate lives in
+          make_sp_feasible.
+
+        Non-attention ops pay nothing — GSPMD keeps their position-sharded
+        activations local."""
         if s.sp <= 1:
             return 0.0
         base = attn_kv_bytes(op, self.op_dtype_bytes(op))
         if base <= 0:
             return 0.0
+        if attn_sp_ulysses(op):
+            # q and out blocks carry L_q, k and v blocks L_kv — distinct
+            # under cross-attention (base counts K+V, so base/2 per tensor)
+            denom = max(1, s.dp) * s.sp
+            q_tok = attn_q_bytes(op, self.op_dtype_bytes(op)) / denom
+            kv_tok = (base / 2.0) / denom
+            return 2.0 * 2.0 * (
+                self.machine.all_to_all_time_us(q_tok, s.sp)
+                + self.machine.all_to_all_time_us(kv_tok, s.sp))
         kv_bytes = base / (max(1, s.dp) * s.sp)
         # fwd rotation + mirrored bwd rotation of dK/dV
         return 2.0 * (s.sp - 1) * self.machine.p2p_time_us(kv_bytes)

@@ -35,7 +35,8 @@ from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
 from .machine_model import MachineModel
-from .simulator import AP_CAPABLE, OpStrategy, Simulator, TP_CAPABLE
+from .simulator import (AP_CAPABLE, OpStrategy, Simulator, TP_CAPABLE,
+                        attn_sp_ulysses)
 
 
 def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
@@ -146,8 +147,7 @@ def make_sp_feasible(graph: Graph, config):
         for t in op.inputs[:3]:
             if len(t.dims) >= 3:
                 attn_seq_lens.add(t.dims[1])
-        if op.params.get("sequence_parallel_mode") in ("ulysses",
-                                                       "all_to_all"):
+        if attn_sp_ulysses(op):  # one mode predicate: cost + feasibility
             sp_head_caps.append(op.params.get("num_heads", 1))
     if (not getattr(config, "enable_sequence_parallel", False)
             or not attn_seq_lens or sp_blocked

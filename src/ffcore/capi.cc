@@ -70,6 +70,8 @@ static void parse_line(const std::string& line, Graph& g, MachineSpec& m,
     int row_capable = 0;
     if (ss >> row_capable >> n.row_divisor >> n.kernel_bytes)
       n.row_capable = row_capable;
+    int sp_uly = 0;
+    if (ss >> sp_uly >> n.sp_q_base) n.sp_ulysses = sp_uly;
     g.nodes.push_back(n);
   } else if (kind == "sps") {
     o.sps.clear();

@@ -58,6 +58,8 @@ struct NodeDesc {
   // (sp_shardable minus divisibility) and the position-dim size; cost
   // formulas mirror simulator.py sp_collective_time_us / forward_time_us
   bool sp_capable = false;   // dim 1 is a position dim (not channels)
+  bool sp_ulysses = false;   // all_to_all SP kernel (vs the ring rotation)
+  double sp_q_base = 0;      // one q/out tensor's full bytes (L_q side)
   int64_t sp_divisor = 0;    // position-dim size; sp must divide; 0 = never
   double sp_kv_base = 0;     // attention: 2*B*L_k*heads*kdim*dtype_bytes
   // expert parallelism (ep): EXPERTS ops only. Python computes the

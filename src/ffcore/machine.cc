@@ -92,9 +92,18 @@ double CostModel::ap_halo_us(const NodeDesc& n, const Strategy& s) const {
 
 double CostModel::sp_collective_us(const NodeDesc& n,
                                    const Strategy& s) const {
-  // ring K/V rotation: (sp-1) neighbor ppermutes of the local K and V
-  // blocks, fwd + mirrored bwd (simulator.py sp_collective_time_us)
+  // mode-aware (mirrors simulator.py sp_collective_time_us): ring = (sp-1)
+  // neighbor ppermutes of the local K+V blocks fwd + mirrored bwd;
+  // ulysses = q/k/v/out all_to_all blocks (4 fwd, mirrored bwd)
   if (s.sp <= 1 || n.sp_kv_base <= 0) return 0.0;
+  if (n.sp_ulysses) {
+    // q/out blocks carry L_q, k/v blocks L_kv (cross-attention differs)
+    double denom = std::max(1, s.dp) * (double)s.sp;
+    double q_tok = n.sp_q_base / denom;
+    double kv_tok = (n.sp_kv_base / 2.0) / denom;
+    return 2.0 * 2.0 *
+           (m_.all_to_all_us(q_tok, s.sp) + m_.all_to_all_us(kv_tok, s.sp));
+  }
   double kv = n.sp_kv_base / (std::max(1, s.dp) * (double)s.sp);
   return 2.0 * (s.sp - 1) * m_.p2p_us(kv);
 }

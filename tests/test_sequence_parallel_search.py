@@ -3,6 +3,7 @@ NEW vs the reference which has no SP at all): the search may shard the
 position dim over a 'seq' mesh axis, priced by the ring-attention K/V
 rotation cost, and the chosen strategy executes on the mesh."""
 import numpy as np
+import pytest
 
 import flexflow_tpu as ff
 from flexflow_tpu.core.graph import Graph
@@ -28,6 +29,83 @@ def build_transformer(batch=2, seq=32, hidden=32, heads=4, sp_flag=True):
     t = model.layer_norm(model.add(t, h), [-1], name="ln2")
     model.softmax(model.dense(t, 4, name="cls"))
     return model, config
+
+
+def test_sp_mode_cost_crossover():
+    """The cost model is SP-MODE-AWARE and prices the real ring/Ulysses
+    crossover: per chip the ring moves 4T(sp-1)/sp bytes (T = one q/k/v
+    tensor) in 2(sp-1) latency-bearing rotations, Ulysses 8T(sp-1)/sp^2
+    bytes in 8 all_to_alls — so Ulysses wins where bytes dominate (traffic
+    ratio 2/sp, times another 1/2 because the all_to_all rides both ring
+    directions while the neighbor ppermute uses one link: net cost ratio
+    1/sp) and the ring wins the latency-dominated regime (tiny blocks,
+    small sp, fewer collectives)."""
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.simulator import CostModel, OpStrategy
+
+    def costs(seq, sp):
+        model, config = build_transformer(seq=seq, hidden=256, heads=8)
+        attn = next(op for op in model.ops
+                    if op.op_type.value == "multihead_attention")
+        cost = CostModel(TpuPodModel(8), config)
+        s = OpStrategy(dp=1, tp=1, sp=sp)
+        ring = cost.sp_collective_time_us(attn, s)
+        attn.params["sequence_parallel_mode"] = "ulysses"
+        uly = cost.sp_collective_time_us(attn, s)
+        return ring, uly
+
+    # bytes-dominated: the 1/sp cost ratio shows through
+    for sp in (4, 8):
+        ring, uly = costs(seq=8192, sp=sp)
+        assert 0.0 < uly < ring, (sp, uly, ring)
+        assert uly / ring == pytest.approx(1.0 / sp, rel=0.25), (sp, uly,
+                                                                 ring)
+    # latency-dominated: 8 all_to_alls cost more than 2 tiny rotations
+    ring, uly = costs(seq=32, sp=2)
+    assert ring < uly, (ring, uly)
+
+    # cross-attention: the q/out blocks carry L_q, not L_kv — a long-query
+    # short-memory op must cost more than its short-query twin (regression:
+    # all four blocks were priced at K/V size)
+    def cross_uly(lq, lkv):
+        config = ff.FFConfig()
+        config.batch_size = 2
+        m = ff.FFModel(config)
+        q = m.create_tensor([2, lq, 256])
+        kv = m.create_tensor([2, lkv, 256])
+        m.multihead_attention(q, kv, kv, 256, 8,
+                              sequence_parallel=True,
+                              sequence_parallel_mode="ulysses", name="x")
+        attn = next(op for op in m.ops
+                    if op.op_type.value == "multihead_attention")
+        cost = CostModel(TpuPodModel(8), config)
+        return cost.sp_collective_time_us(attn, OpStrategy(dp=1, sp=8))
+
+    # 64x the q length must show up as a multiple of the cost (the +1us
+    # per-collective latency floor dilutes the exact ratio)
+    assert cross_uly(4096, 64) > 3 * cross_uly(64, 64)
+
+
+def test_native_ulysses_cost_parity():
+    """The native core prices the Ulysses mode identically (the sp_ulysses
+    node flag flows over the protocol)."""
+    from flexflow_tpu import native
+    from flexflow_tpu.search.machine_model import TpuPodModel
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    model, config = build_transformer()
+    for op in model.ops:
+        if op.op_type.value == "multihead_attention":
+            op.params["sequence_parallel_mode"] = "ulysses"
+    g = Graph(model.ops)
+    machine = TpuPodModel(8)
+    native_res = native.optimize_strategy(g, config, machine, 2, 8)
+    helper = GraphSearchHelper(g, config, machine)
+    py_res = helper.graph_optimize(2, 8)
+    assert native_res.cost_us == pytest.approx(py_res.cost_us, rel=1e-6)
+    assert native_res.mesh_axes == py_res.mesh_axes
 
 
 def test_search_considers_sp_factorizations():

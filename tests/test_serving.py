@@ -28,6 +28,46 @@ def make_model(dim=8, classes=4):
     return model
 
 
+def make_sharded_model(axes, dim=8, classes=4):
+    """Same graph as make_model, compiled over a mesh (reference role:
+    multi-node Triton serving, triton/src/strategy.cc)."""
+    config = ff.FFConfig()
+    config.batch_size = 16
+    config.allow_mixed_precision = False
+    config.seed = 9
+    config.num_devices = int(np.prod(list(axes.values()))) if axes else 1
+    model = ff.FFModel(config)
+    inp = model.create_tensor([16, dim])
+    t = model.dense(inp, 16, ff.ActiMode.AC_MODE_RELU)
+    t = model.dense(t, classes)
+    model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.0),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        **({"parallel_axes": axes} if axes else {}),
+    )
+    return model
+
+
+@pytest.mark.parametrize("axes", [{"data": 2}, {"model": 2},
+                                  {"data": 2, "model": 2}])
+def test_sharded_batched_inference_matches_single_device(axes):
+    """Batched serving over a dp/tp/dp x tp mesh: bucket padding, partial
+    batches, and the batcher all produce the single-device numbers."""
+    ref = InferenceModel(make_sharded_model(None), batch_buckets=(2, 8))
+    im = InferenceModel(make_sharded_model(axes), batch_buckets=(2, 8))
+    name = im.input_names[0]
+    x = np.random.RandomState(3).randn(5, 8).astype(np.float32)
+    out = im.predict({name: x})
+    np.testing.assert_allclose(out, ref.predict({name: x}),
+                               rtol=1e-5, atol=1e-6)
+    with DynamicBatcher(im, max_batch_size=8, max_delay_ms=5.0) as b:
+        futs = [b.submit({name: x[i:i + 1]}) for i in range(5)]
+        outs = np.concatenate([f.result(timeout=30) for f in futs])
+    np.testing.assert_allclose(outs, out, rtol=1e-5, atol=1e-6)
+
+
 def test_inference_model_pads_to_buckets():
     model = make_model()
     im = InferenceModel(model, batch_buckets=(2, 8))
