@@ -198,7 +198,12 @@ class NetworkedMachineModel(MachineModel):
         return 2
 
     def comm_channels(self) -> bool:
-        return True
+        """Per-axis overlap needs disjoint link sets per mesh axis: a chip
+        with 4+ links (a 2D torus's +-x/+-y) can dedicate a ring pair per
+        axis; a 1-D ring (degree 2) has ONE link set every collective
+        shares, so the single serializing timeline is the honest model."""
+        degree = max(1, int(self.connection.sum(axis=1).min()))
+        return degree >= 4
 
     @classmethod
     def from_json(cls, path: str, chip: Optional[ChipSpec] = None):

@@ -93,6 +93,15 @@ def test_machine_model_json_loading(tmp_path):
     assert m.num_chips == 4
     assert m.hop_count(0, 2) == 2
     assert m.segment_bytes == 0.5e6 and m.routing == "single"
+    # a 1-D ring has one shared link set: no per-axis overlap channels
+    assert not m.comm_channels()
+    # a 2D-torus-degree topology (4+ links/chip) has disjoint ring pairs
+    conn = np.zeros((6, 6))
+    for i in range(6):
+        for j in range(6):
+            if i != j:
+                conn[i][j] = 1
+    assert NetworkedMachineModel(6, connection=conn).comm_channels()
 
 
 # -- simulator ----------------------------------------------------------
