@@ -197,13 +197,15 @@ class NetworkedMachineModel(MachineModel):
     def version(self) -> int:
         return 2
 
+    def _min_degree(self) -> int:
+        return max(1, int(self.connection.sum(axis=1).min()))
+
     def comm_channels(self) -> bool:
         """Per-axis overlap needs disjoint link sets per mesh axis: a chip
         with 4+ links (a 2D torus's +-x/+-y) can dedicate a ring pair per
         axis; a 1-D ring (degree 2) has ONE link set every collective
         shares, so the single serializing timeline is the honest model."""
-        degree = max(1, int(self.connection.sum(axis=1).min()))
-        return degree >= 4
+        return self._min_degree() >= 4
 
     @classmethod
     def from_json(cls, path: str, chip: Optional[ChipSpec] = None):
@@ -271,8 +273,7 @@ class NetworkedMachineModel(MachineModel):
         torus); 1 under single-path routing."""
         if self.routing != "ecmp":
             return 1.0
-        degree = max(1, int(self.connection.sum(axis=1).min()))
-        return float(min(degree, 4))
+        return float(min(self._min_degree(), 4))
 
     def p2p_time_us(self, bytes_: float) -> float:
         bw = self.link_gbps * 1e9 * self.path_diversity()
@@ -283,8 +284,7 @@ class NetworkedMachineModel(MachineModel):
         return (bytes_ + (h - 1.0) * seg) / bw * 1e6 + 1.0
 
     def link_bw(self, n_participants: int) -> float:
-        degree = max(1, int(self.connection.sum(axis=1).min()))
-        return min(degree, 2) * self.link_gbps * 1e9
+        return min(self._min_degree(), 2) * self.link_gbps * 1e9
 
 
 def make_machine_model(config, num_chips: int) -> MachineModel:

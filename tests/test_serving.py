@@ -12,25 +12,13 @@ from flexflow_tpu.serving import DynamicBatcher, InferenceModel, InferenceServer
 
 
 def make_model(dim=8, classes=4):
-    config = ff.FFConfig()
-    config.batch_size = 16
-    config.allow_mixed_precision = False
-    model = ff.FFModel(config)
-    inp = model.create_tensor([16, dim])
-    t = model.dense(inp, 16, ff.ActiMode.AC_MODE_RELU)
-    t = model.dense(t, classes)
-    model.softmax(t)
-    model.compile(
-        optimizer=ff.SGDOptimizer(model, lr=0.0),
-        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
-        metrics=[],
-    )
-    return model
+    return make_sharded_model(None, dim=dim, classes=classes)
 
 
 def make_sharded_model(axes, dim=8, classes=4):
-    """Same graph as make_model, compiled over a mesh (reference role:
-    multi-node Triton serving, triton/src/strategy.cc)."""
+    """The serving test model; axes=None compiles single-device, a dict
+    compiles over that mesh (reference role: multi-node Triton serving,
+    triton/src/strategy.cc)."""
     config = ff.FFConfig()
     config.batch_size = 16
     config.allow_mixed_precision = False
@@ -45,7 +33,7 @@ def make_sharded_model(axes, dim=8, classes=4):
         optimizer=ff.SGDOptimizer(model, lr=0.0),
         loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
         metrics=[],
-        **({"parallel_axes": axes} if axes else {}),
+        parallel_axes=axes,
     )
     return model
 
