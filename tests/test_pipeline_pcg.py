@@ -90,6 +90,36 @@ def test_plan_loud_on_unpipelineable_graph():
         find_pipeline_plan(Graph(model.ops), n_stages=2)
 
 
+def test_adopt_params_plain_to_plain():
+    """adopt_params_from between two sequential compilations: predictions
+    become identical; a different-graph source raises loudly."""
+    m_a = _build(None, ndev=1)
+    m_b = _build(None, ndev=1)
+    # different init seeds would be the realistic case; force a difference
+    import jax.numpy as jnp
+
+    first = next(n for n in m_b.params if m_b.params[n])
+    k0 = next(iter(m_b.params[first]))
+    m_b.params[first][k0] = m_b.params[first][k0] + 1.0
+    m_b.adopt_params_from(m_a)
+    x, y = _data()
+    name = m_a.input_ops[0].name
+    np.testing.assert_allclose(
+        np.asarray(m_a.predict(x)), np.asarray(m_b.predict(x)),
+        rtol=1e-6, atol=1e-7)
+
+    config = ff.FFConfig()
+    config.batch_size = 4
+    other = ff.FFModel(config)
+    t = other.create_tensor([4, 8])
+    other.softmax(other.dense(t, 3, name="different_head"))
+    other.compile(optimizer=ff.SGDOptimizer(other, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+    with pytest.raises(KeyError, match="no counterpart"):
+        m_b.adopt_params_from(other)
+
+
 def test_pp_matches_sequential_numerics():
     """One fit epoch through a dp=2 x stage=4 mesh matches the sequential
     model when both start from identical weights: GPipe is the same math."""
