@@ -135,6 +135,40 @@ def test_per_axis_comm_channels_overlap():
         sim_flat.simulate(graph, dp_only), rel=1e-9)
 
 
+def test_channel_schedule_never_loses_randomized():
+    """Invariant over random strategy assignments: the per-axis-channel
+    schedule is always <= the single-timeline schedule (same costs, strictly
+    more permissive ordering), and >= the pure-compute lower bound."""
+    model = build_mlp(batch=512, din=1024, hidden=2048)
+    graph = Graph(model.ops)
+
+    class FlatTpuPod(TpuPodModel):
+        def comm_channels(self):
+            return False
+
+    sim_ch = Simulator(TpuPodModel(8), model.config)
+    sim_flat = Simulator(FlatTpuPod(8), model.config)
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        strategies = {}
+        for op in model.ops:
+            if op.op_type == OpType.LINEAR and rng.rand() < 0.5:
+                strategies[op.guid] = OpStrategy(
+                    dp=int(rng.choice([1, 2, 4])),
+                    tp=int(rng.choice([1, 2])),
+                    tp_row=bool(rng.rand() < 0.3))
+            else:
+                strategies[op.guid] = OpStrategy(
+                    dp=int(rng.choice([1, 2, 4, 8])))
+        t_ch = sim_ch.simulate(graph, strategies)
+        t_flat = sim_flat.simulate(graph, strategies)
+        assert t_ch <= t_flat * (1 + 1e-9), (strategies, t_ch, t_flat)
+        compute_only = sum(
+            sum(sim_ch.fwd_bwd_time_us(op, strategies[op.guid]))
+            for op in model.ops)
+        assert t_ch >= compute_only * (1 - 1e-9)
+
+
 def test_simulator_dp_speedup():
     # batch large enough that per-step compute dwarfs the gradient allreduce
     model = build_mlp(batch=16384, din=1024, hidden=4096)
