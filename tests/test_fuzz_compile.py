@@ -15,6 +15,9 @@ def random_model(rng, n_devices=8):
     config.search_budget = int(rng.choice([0, 4]))
     config.use_native_search = bool(rng.randint(2))
     config.allow_mixed_precision = bool(rng.randint(2))
+    # v1 engages the torus-aware machine model + per-axis comm channels in
+    # whichever search (Python or native) prices the strategies
+    config.machine_model_version = int(rng.randint(2))
     model = ff.FFModel(config)
 
     kind = rng.choice(["mlp", "conv", "attn"])
