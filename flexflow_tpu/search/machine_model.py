@@ -198,7 +198,13 @@ class NetworkedMachineModel(MachineModel):
         return 2
 
     def _min_degree(self) -> int:
-        return max(1, int(self.connection.sum(axis=1).min()))
+        # cached: p2p_time_us sits in the simulator's per-candidate hot
+        # path via path_diversity (the topology is immutable after init)
+        d = getattr(self, "_min_degree_cache", None)
+        if d is None:
+            d = self._min_degree_cache = max(
+                1, int(self.connection.sum(axis=1).min()))
+        return d
 
     def comm_channels(self) -> bool:
         """Per-axis overlap needs disjoint link sets per mesh axis: a chip
@@ -250,7 +256,12 @@ class NetworkedMachineModel(MachineModel):
         return dist
 
     def hop_count(self, src: int, dst: int) -> int:
-        return self._sssp_hops(src)[dst]
+        maps = getattr(self, "_hops_cache", None)
+        if maps is None:
+            maps = self._hops_cache = {}
+        if src not in maps:
+            maps[src] = self._sssp_hops(src)
+        return maps[src][dst]
 
     def avg_hops(self) -> float:
         """Mean shortest-path length over distinct pairs (cached; one BFS
