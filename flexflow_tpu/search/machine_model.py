@@ -193,6 +193,8 @@ class NetworkedMachineModel(MachineModel):
                 "directions) or 'single' (one path)")
         self.routing = routing
         self._avg_hops: Optional[float] = None
+        self._hops_cache: Dict[int, List[int]] = {}
+        self._min_degree_cache: Optional[int] = None
 
     def version(self) -> int:
         return 2
@@ -200,11 +202,10 @@ class NetworkedMachineModel(MachineModel):
     def _min_degree(self) -> int:
         # cached: p2p_time_us sits in the simulator's per-candidate hot
         # path via path_diversity (the topology is immutable after init)
-        d = getattr(self, "_min_degree_cache", None)
-        if d is None:
-            d = self._min_degree_cache = max(
+        if self._min_degree_cache is None:
+            self._min_degree_cache = max(
                 1, int(self.connection.sum(axis=1).min()))
-        return d
+        return self._min_degree_cache
 
     def comm_channels(self) -> bool:
         """Per-axis overlap needs disjoint link sets per mesh axis: a chip
@@ -255,13 +256,14 @@ class NetworkedMachineModel(MachineModel):
                     q.append(v)
         return dist
 
+    def _hops(self, src: int) -> List[int]:
+        """Cached single-source distance map (topology is immutable)."""
+        if src not in self._hops_cache:
+            self._hops_cache[src] = self._sssp_hops(src)
+        return self._hops_cache[src]
+
     def hop_count(self, src: int, dst: int) -> int:
-        maps = getattr(self, "_hops_cache", None)
-        if maps is None:
-            maps = self._hops_cache = {}
-        if src not in maps:
-            maps[src] = self._sssp_hops(src)
-        return maps[src][dst]
+        return self._hops(src)[dst]
 
     def avg_hops(self) -> float:
         """Mean shortest-path length over distinct pairs (cached; one BFS
@@ -274,7 +276,7 @@ class NetworkedMachineModel(MachineModel):
             if n <= 1:
                 self._avg_hops = 1.0
             else:
-                total = sum(sum(self._sssp_hops(i)) for i in range(n))
+                total = sum(sum(self._hops(i)) for i in range(n))
                 self._avg_hops = max(1.0, total / (n * (n - 1)))
         return self._avg_hops
 
