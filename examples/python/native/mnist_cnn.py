@@ -11,7 +11,11 @@ from accuracy import ModelAccuracy
 
 
 def main():
-    config = get_config(batch_size=64, epochs=3)
+    # 8 epochs: the >=90% gate (accuracy.py:19-24 role) must hold on the
+    # no-egress SYNTHETIC fallback dataset too, which converges slower than
+    # real MNIST (measured: 87.1% @3 epochs, 90.6% @8; real MNIST clears
+    # the gate well before this)
+    config = get_config(batch_size=64, epochs=8)
     from flexflow_tpu.keras.datasets import mnist
 
     (x_train, y_train), _ = mnist.load_data()
