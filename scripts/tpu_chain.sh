@@ -9,7 +9,8 @@
 # Completed artifacts are never re-run (resumable across watcher restarts).
 set -u
 cd "$(dirname "$0")/.."
-LOG=${LOG:-/tmp/tpu_chain_r5.log}
+ROUND=${ROUND:-r05}   # artifact suffix; round 6 reuses this script via ROUND=r06
+LOG=${LOG:-/tmp/tpu_chain_${ROUND}.log}
 INTERVAL=${INTERVAL:-1200}
 MAX_TRIES=${MAX_TRIES:-30}
 # stand down before the driver's end-of-round bench (epoch s; 0 disables)
@@ -21,26 +22,26 @@ log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 past() { [ "$1" -gt 0 ] && [ "$(date +%s)" -gt "$1" ]; }
 
 probe_bench() {
-  # bounded bench attempt; success writes BENCH_r05_live.json. When the
+  # bounded bench attempt; success writes BENCH_${ROUND}_live.json. When the
   # bench artifact already exists (resume after a mid-chain wedge), the
   # probe is a cheap liveness check instead — otherwise re-entering the
   # chain against a dead tunnel burns full step timeouts per iteration.
-  if [ -s BENCH_r05_live.json ]; then
+  if [ -s BENCH_${ROUND}_live.json ]; then
     alive_check && return 0 || return 1
   fi
   BENCH_INIT_TIMEOUT_S=240 BENCH_CHILD_TIMEOUT_S=1500 BENCH_MAX_RETRIES=1 \
-    python bench.py > /tmp/bench_r05_live.json 2>> "$LOG"
-  if python - <<'EOF'
+    python bench.py > /tmp/bench_${ROUND}_live.json 2>> "$LOG"
+  if python - "/tmp/bench_${ROUND}_live.json" <<'EOF'
 import json, sys
 try:
-    d = json.load(open("/tmp/bench_r05_live.json"))
+    d = json.load(open(sys.argv[1]))
 except Exception:
     sys.exit(1)
 sys.exit(0 if d.get("value", 0) > 0 else 1)
 EOF
   then
-    cp /tmp/bench_r05_live.json BENCH_r05_live.json
-    log "BENCH ok: $(cat BENCH_r05_live.json)"
+    cp /tmp/bench_${ROUND}_live.json BENCH_${ROUND}_live.json
+    log "BENCH ok: $(cat BENCH_${ROUND}_live.json)"
     return 0
   fi
   return 1
@@ -49,7 +50,7 @@ EOF
 alive_check() {
   # cheap liveness check between chain steps: one tiny device matmul,
   # supervised from outside (a wedged PJRT call holds the GIL)
-  timeout 300 python - <<'EOF' 2>> /tmp/tpu_chain_r5_alive.log
+  timeout 300 python - <<'EOF' 2>> /tmp/tpu_chain_${ROUND}_alive.log
 import numpy as np, jax, jax.numpy as jnp
 float(np.asarray((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0]))
 EOF
@@ -88,11 +89,11 @@ EOF
 chain() {
   # priority order per VERDICT.md "Next round" items 1-3, 8
   local steps=(
-    "SIMVALID_r05.json 3000 python scripts/validate_simulator.py"
-    "BENCH_ALEXNET_r05.json 2400 python scripts/bench_alexnet.py"
-    "LONGCONTEXT_r05.json 2700 python scripts/bench_longcontext.py"
-    "SWEEP_FLASH_r05.json 2700 python scripts/sweep_flash.py"
-    "PROFILE_r05_ablations.json 2700 python scripts/profile_bert.py --variants full,grad,fwd,batch32"
+    "SIMVALID_${ROUND}.json 3000 python scripts/validate_simulator.py"
+    "BENCH_ALEXNET_${ROUND}.json 2400 python scripts/bench_alexnet.py"
+    "LONGCONTEXT_${ROUND}.json 2700 python scripts/bench_longcontext.py"
+    "SWEEP_FLASH_${ROUND}.json 2700 python scripts/sweep_flash.py"
+    "PROFILE_${ROUND}_ablations.json 2700 python scripts/profile_bert.py --variants full,grad,fwd,batch32"
   )
   for s in "${steps[@]}"; do
     set -- $s
