@@ -75,6 +75,12 @@ def main(argv=None):
 
     honor_env_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
+    # elastic drill: scripted kill-and-recover scenario on CPU host-device
+    # emulation (docs/elastic.md)
+    if argv and argv[0] == "elastic-drill":
+        from .elastic.drill import run_drill
+
+        raise SystemExit(run_drill(argv[1:]))
     # script mode: first non-flag arg ending in .py
     script = next((a for a in argv if a.endswith(".py")), None)
     if script is not None:

@@ -64,6 +64,14 @@ class FFConfig:
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
     num_devices: Optional[int] = None
+    # Explicit device subset (indices into jax.devices()): the mesh is
+    # built from exactly these devices. Set by the elastic coordinator to
+    # compile onto the SURVIVORS of a chip loss; wins over num_devices.
+    device_ids: Optional[List[int]] = None
+    # Elastic runtime hook (elastic/detector.py FailureDetector.wrap): the
+    # Executor wraps its jitted train-step dispatch with this, so fault
+    # injection, failure classification, and retry ride every dispatch.
+    elastic_step_wrapper: Optional[object] = None
     num_nodes: int = 1
     # Search knobs
     search_budget: int = 0
@@ -266,6 +274,8 @@ class FFConfig:
 
     @property
     def total_devices(self) -> int:
+        if self.device_ids is not None:
+            return len(self.device_ids)
         if self.num_devices is not None:
             return self.num_devices
         import jax

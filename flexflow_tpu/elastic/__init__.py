@@ -1,0 +1,26 @@
+"""Elastic runtime: fault injection, failure detection, retry, and
+automatic strategy re-planning on mesh shrink (docs/elastic.md).
+
+The headline path: a `FaultPlan` scripts chip-loss/slow-link/transient
+events, the `FailureDetector` guards every Executor train-step dispatch
+(retrying transients via `RetryPolicy`), and the `ElasticCoordinator`
+answers topology loss by rebuilding a shrunken `MachineModel` from the
+survivor spec, re-running the Unity search, restoring the latest
+checkpoint resharded onto the new mesh, and resuming the same fit() call.
+"""
+from .coordinator import (ElasticCoordinator, RecoveryFailed,
+                          reshard_params, ring_topology_spec,
+                          shrink_topology_spec)
+from .detector import FailureDetector
+from .events import ElasticEvent, EventLog
+from .faults import (Fault, FaultInjector, FaultPlan, TopologyLoss,
+                     TransientFault, classify_error)
+from .retry import RetriesExhausted, RetryPolicy, call_with_retry
+
+__all__ = [
+    "ElasticCoordinator", "ElasticEvent", "EventLog", "FailureDetector",
+    "Fault", "FaultInjector", "FaultPlan", "RecoveryFailed",
+    "RetriesExhausted", "RetryPolicy", "TopologyLoss", "TransientFault",
+    "call_with_retry", "classify_error", "reshard_params",
+    "ring_topology_spec", "shrink_topology_spec",
+]

@@ -1,0 +1,348 @@
+"""Elastic coordinator: survive chip loss by re-planning on the survivors.
+
+Recovery state machine (docs/elastic.md has the full diagram):
+
+    TRAIN --transient--> RETRY (in place, bounded backoff) --> TRAIN
+    TRAIN --topology loss--> RECOVER:
+        1. shrink: drop the lost chips from the device list and from the
+           topology spec (renumbered survivor spec ->
+           NetworkedMachineModel.from_json);
+        2. re-plan: rebuild the model on the shrunken machine — compile()
+           re-runs the Unity search (search/unity.py) against the smaller
+           MachineModel, so the parallel strategy is re-derived, not
+           merely truncated (the re-derivation argument of
+           "Synthesizing Optimal Parallelism Placement..." 2110.10548);
+        3. restore: load the latest checkpoint (runtime/checkpoint.py)
+           into the new model and reshard every parameter onto the new
+           mesh;
+        4. resume: continue the SAME fit() call from the checkpointed
+           step.
+
+The training loop here is deliberately the plain single-step path (one
+jitted dispatch per optimizer step) — each dispatch is a clean retry/
+recovery boundary. Fancier dispatch shapes (steps_per_execution chunks)
+still get fault injection via the executor's step_wrapper, but recovery
+granularity is then the chunk.
+
+Everything is exercised on CPU with virtual devices
+(`XLA_FLAGS=--xla_force_host_platform_device_count=N`); see the
+`elastic-drill` CLI (elastic/drill.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.checkpoint import restore_checkpoint, save_checkpoint
+from .detector import FailureDetector
+from .events import (CHECKPOINT, RECOVERY_DONE, RECOVERY_RESTORE,
+                     RECOVERY_SEARCH, RECOVERY_START, EventLog)
+from .faults import FaultInjector, FaultPlan, TopologyLoss
+from .retry import RetryPolicy
+
+
+def ring_topology_spec(num_chips: int, gbps: float = 45.0) -> Dict:
+    """Default ICI topology spec when the config names no machine-model
+    file: a bidirectional 1-D ring (NetworkedMachineModel's own default)."""
+    links = [[i, (i + 1) % num_chips, gbps] for i in range(num_chips)] \
+        if num_chips > 1 else []
+    return {"num_chips": num_chips, "links": links}
+
+
+def shrink_topology_spec(spec: Dict, lost_positions: Sequence[int]) -> Dict:
+    """Survivor spec: drop the lost chips (positions within the spec's
+    0..n-1 numbering), renumber the survivors densely, and keep only links
+    with both endpoints alive. A loss can leave the survivor set with few
+    or NO intact links (e.g. both ring neighbors of a survivor died) —
+    NetworkedMachineModel.from_json handles the empty-links case by
+    falling back to its default ring at the default 45 GB/s."""
+    lost = set(lost_positions)
+    n = spec["num_chips"]
+    survivors = [i for i in range(n) if i not in lost]
+    renum = {old: new for new, old in enumerate(survivors)}
+    links = [[renum[i], renum[j], g]
+             for i, j, g in spec.get("links", [])
+             if i in renum and j in renum]
+    out = {"num_chips": len(survivors), "links": links}
+    for key in ("segment_mb", "routing"):
+        if key in spec:
+            out[key] = spec[key]
+    return out
+
+
+class RecoveryFailed(RuntimeError):
+    """Recovery could not restore a runnable training state."""
+
+
+class ElasticCoordinator:
+    """Owns the model lifecycle across failures.
+
+    model_builder: Callable[[FFConfig], FFModel] — builds AND compiles a
+    fresh model for a given config. It must be deterministic in the model
+    architecture (op names key the checkpoint) while the config's device
+    set and machine model vary between calls. The coordinator clones the
+    base config per build (dataclasses.replace) with:
+      - device_ids = the current survivor list,
+      - machine_model_file = the shrunken survivor topology spec (recovery
+        builds only),
+      - elastic_step_wrapper = the failure detector's dispatch guard.
+    """
+
+    def __init__(self, model_builder: Callable, config,
+                 fault_plan: Optional[FaultPlan] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 5,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 events: Optional[EventLog] = None,
+                 max_recoveries: int = 2):
+        self.model_builder = model_builder
+        self.events = events if events is not None else EventLog()
+        self.checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="ff_elastic_")
+        self.checkpoint_every = max(1, checkpoint_every)
+        self.max_recoveries = max_recoveries
+        injector = (FaultInjector(fault_plan, events=self.events)
+                    if fault_plan is not None else None)
+        self.detector = FailureDetector(events=self.events,
+                                        injector=injector,
+                                        retry_policy=retry_policy)
+        # device positions are GLOBAL indices into jax.devices(); the
+        # topology spec numbers chips 0..n-1 in device_ids order
+        self.device_ids: List[int] = (
+            list(config.device_ids) if config.device_ids is not None
+            else list(range(config.total_devices)))
+        if config.machine_model_file:
+            with open(config.machine_model_file) as f:
+                self._topo_spec = json.load(f)
+            if "num_chips" not in self._topo_spec:
+                # from_json permits specs without num_chips; shrink needs
+                # it, so normalize with the same inference rule
+                links = self._topo_spec.get("links") or []
+                self._topo_spec["num_chips"] = max(
+                    (max(i, j) for i, j, _ in links), default=0) + 1
+        else:
+            self._topo_spec = ring_topology_spec(len(self.device_ids))
+        self._base_config = config
+        self._recoveries = 0
+        self._last_ckpt: Optional[tuple] = None  # (step, path)
+        # the INITIAL build plans against the same explicit topology spec
+        # recovery builds will use — otherwise a config without a
+        # machine_model_file searches on SimpleMachineModel pre-loss but
+        # on the hop-aware NetworkedMachineModel post-loss, and the two
+        # strategies differ for cost-model reasons, not topology ones
+        self.model = self.model_builder(self._config_for(
+            self.device_ids, self._write_spec("topology_0.json")))
+
+    def _write_spec(self, fname: str) -> str:
+        path = os.path.join(self.checkpoint_dir, fname)
+        with open(path, "w") as f:
+            json.dump(self._topo_spec, f)
+        return path
+
+    # -- config/model plumbing --------------------------------------------
+    def _config_for(self, device_ids: List[int],
+                    machine_model_file: Optional[str] = None):
+        cfg = dataclasses.replace(
+            self._base_config,
+            device_ids=list(device_ids),
+            num_devices=None,
+            elastic_step_wrapper=self.detector.wrap)
+        if machine_model_file is not None:
+            cfg.machine_model_file = machine_model_file
+        return cfg
+
+    # -- checkpointing -----------------------------------------------------
+    def _save(self, step: int) -> str:
+        path = os.path.join(self.checkpoint_dir, f"ckpt_{step:06d}")
+        path = save_checkpoint(path, self.model, step=step)
+        self._last_ckpt = (step, path)
+        self.events.record(CHECKPOINT, step=step, path=path)
+        return path
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self, exc: TopologyLoss) -> int:
+        """Shrink, re-search, restore, resume. Returns the step to resume
+        from (the latest checkpoint's step)."""
+        self._recoveries += 1
+        if self._recoveries > self.max_recoveries:
+            raise RecoveryFailed(
+                f"recovery budget ({self.max_recoveries}) exhausted") \
+                from exc
+        lost = set(exc.lost_chips)
+        if not lost:
+            # real runtime errors classify as topology loss by message
+            # pattern but carry no chip ids; "recovering" onto the same
+            # device set would just re-hit the dead chip
+            raise RecoveryFailed(
+                "topology loss did not identify the lost chips; cannot "
+                "shrink the mesh — restart from the latest checkpoint "
+                f"({self._last_ckpt[1] if self._last_ckpt else 'none'}) "
+                "on known-good hardware") from exc
+        self.events.record(RECOVERY_START,
+                           step=self.detector.current_step,
+                           chips=sorted(lost), recovery=self._recoveries)
+        unknown = lost - set(self.device_ids)
+        if unknown:
+            raise RecoveryFailed(
+                f"lost chips {sorted(unknown)} are not in the active "
+                f"device set {self.device_ids}") from exc
+        survivors = [d for d in self.device_ids if d not in lost]
+        if not survivors:
+            raise RecoveryFailed("no surviving devices") from exc
+        # 1. shrink the topology spec (positions follow device_ids order)
+        lost_positions = [i for i, d in enumerate(self.device_ids)
+                          if d in lost]
+        self._topo_spec = shrink_topology_spec(self._topo_spec,
+                                               lost_positions)
+        spec_path = self._write_spec(f"survivors_{self._recoveries}.json")
+        # 2. re-plan: a fresh compile on the shrunken machine re-runs the
+        # Unity search (when search_budget > 0) against the survivor spec
+        model = self.model_builder(self._config_for(survivors, spec_path))
+        sr = model.search_result
+        self.events.record(
+            RECOVERY_SEARCH, step=self.detector.current_step,
+            n_devices=len(survivors), axes=dict(model.parallel_axes),
+            cost_us=(sr.cost_us if sr is not None else None))
+        # 3. restore the latest checkpoint into the new model, resharded
+        if self._last_ckpt is None:
+            raise RecoveryFailed("no checkpoint to restore from") from exc
+        ckpt_step, path = self._last_ckpt
+        expected = {name: set(ws) for name, ws in model.params.items()}
+        restore_checkpoint(path, model)
+        got = {name: set(ws) for name, ws in model.params.items()}
+        if expected != got:
+            missing = set(expected) - set(got)
+            extra = set(got) - set(expected)
+            raise RecoveryFailed(
+                "checkpoint does not match the rebuilt model's parameter "
+                f"tree (missing ops: {sorted(missing)}, unexpected ops: "
+                f"{sorted(extra)}) — the builder must produce the same "
+                "architecture across rebuilds") from exc
+        reshard_params(model)
+        self.events.record(RECOVERY_RESTORE,
+                           step=ckpt_step, path=path)
+        # 4. swap in the recovered model and resume
+        self.model = model
+        self.device_ids = survivors
+        self.detector.reset_latency()  # the rebuild's compile is not a
+        #                                slow link; re-enter EWMA warmup
+        self.events.record(RECOVERY_DONE, step=ckpt_step,
+                           n_devices=len(survivors))
+        return ckpt_step
+
+    # -- training ----------------------------------------------------------
+    def fit(self, x, y, steps: Optional[int] = None, epochs: int = 1,
+            batch_size: Optional[int] = None,
+            verbose: bool = False) -> List[Dict[str, float]]:
+        """Train for `steps` optimizer steps (or epochs * n//bs when steps
+        is None), surviving scripted/real failures. Batches cycle through
+        (x, y). Returns per-step {"step", "loss", ...metric} records for
+        the steps that committed (a step rolled back by a recovery appears
+        once, from its post-recovery execution)."""
+        if isinstance(x, np.ndarray):
+            x = [x]
+        model = self.model
+        bs = batch_size or model.config.batch_size
+        n = x[0].shape[0]
+        spe = n // bs
+        if spe < 1:
+            raise ValueError(f"dataset has {n} samples < batch size {bs}")
+        total = steps if steps is not None else spe * epochs
+        history: List[Dict[str, float]] = []
+        committed: Dict[int, Dict[str, float]] = {}
+        self._save(0)  # recovery needs a restore point before any fault
+        step = 0
+        while step < total:
+            model = self.model
+            self.detector.current_step = step
+            it = step % spe
+            lo, hi = it * bs, (it + 1) * bs
+            inputs, label = model._prep_step_batch(x, y, lo, hi)
+            try:
+                (model.params, model.opt_state, model.state,
+                 mvals) = model._train_step(
+                    model.params, model.opt_state, model.state, inputs,
+                    label, model._next_rng())
+            except TopologyLoss as exc:
+                resume = self._recover(exc)
+                # steps after the checkpoint were rolled back: replay them
+                step = resume
+                continue
+            rec = {k: float(v) for k, v in mvals.items()}
+            rec["step"] = step
+            committed[step] = rec
+            if verbose:
+                print(f"[elastic] step {step}: "
+                      + " ".join(f"{k}={v:.4f}" for k, v in rec.items()
+                                 if k != "step"))
+            step += 1
+            if step % self.checkpoint_every == 0 and step < total:
+                self._save(step)
+        history = [committed[i] for i in sorted(committed) if i < total]
+        return history
+
+
+def reshard_params(model) -> None:
+    """Re-place the restored training state (params, optimizer state, op
+    state) on the model's (new) mesh — the checkpoint restore materializes
+    host arrays on the default device, which after a recovery may not even
+    be part of the mesh. Params get each weight's strategy sharding (ops
+    the current strategy replicates keep replicated placement via their
+    degree-1 parallel shapes); optimizer moment trees mirror the matching
+    weight's sharding; everything else replicates on the mesh."""
+    import jax
+
+    if model.mesh is None:
+        # mesh-less single-survivor model: everything lives on the one
+        # chosen device (jax.devices()[0] may be the lost chip)
+        ids = model.config.device_ids
+        if not ids:
+            return
+        dev = jax.devices()[ids[0]]
+        model.params = jax.device_put(model.params, dev)
+        model.opt_state = jax.device_put(model.opt_state, dev)
+        model.state = jax.device_put(model.state, dev)
+        return
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(model.mesh, PartitionSpec())
+    # per-(op, weight) strategy shardings
+    shardings: Dict[str, Dict[str, object]] = {}
+    for op in model.graph.topo_order():
+        for w in op.weights:
+            if w.parallel_shape is not None:
+                shardings.setdefault(op.name, {})[w._weight_spec.name] = \
+                    w.parallel_shape.sharding(model.mesh)
+
+    def place_params_tree(tree):
+        """Place a params-shaped {op: {weight: array}} tree, each leaf by
+        the matching weight's sharding (replicated when the strategy
+        names none)."""
+        out = {}
+        for op_name, entry in tree.items():
+            if isinstance(entry, dict):
+                out[op_name] = {
+                    wn: jax.device_put(
+                        arr, shardings.get(op_name, {}).get(wn, repl))
+                    for wn, arr in entry.items()
+                }
+            else:
+                out[op_name] = jax.device_put(entry, repl)
+        return out
+
+    model.params = place_params_tree(model.params)
+    # opt_state: scalars (step, lr) replicate; moment trees (m, v) mirror
+    # the params structure and take the matching weight's sharding
+    model.opt_state = {
+        k: place_params_tree(v) if isinstance(v, dict)
+        else jax.device_put(v, repl)
+        for k, v in (model.opt_state or {}).items()
+    }
+    if model.state:
+        model.state = jax.device_put(model.state, repl)

@@ -1,0 +1,165 @@
+"""`python -m flexflow_tpu elastic-drill`: scripted kill-and-recover run.
+
+Runs the whole elastic story end-to-end on CPU host-device emulation:
+train a small MLP on N virtual devices, inject a transient failure (watch
+the retry policy absorb it), kill K chips at a chosen step (watch the
+coordinator re-run the Unity search for N-K devices, restore the latest
+checkpoint, and resume), then compare the final loss against an
+uninterrupted reference run of the same seed and data.
+
+    python -m flexflow_tpu elastic-drill --devices 8 --kill 2 --at-step 5
+
+Exit code 0 iff the recovered run finished, actually recovered, and landed
+within tolerance of the reference. The last stdout line is a JSON summary.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+
+def _take(argv: List[str], flag: str, default, cast=int):
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            raise SystemExit(f"missing value for {flag}")
+        val = cast(argv[i + 1])
+        del argv[i:i + 2]
+        return val
+    return default
+
+
+def run_drill(argv: Optional[List[str]] = None) -> int:
+    argv = list(argv or [])
+    devices = _take(argv, "--devices", 8)
+    kill = _take(argv, "--kill", 2)
+    at_step = _take(argv, "--at-step", 5)
+    steps = _take(argv, "--steps", None)
+    batch = _take(argv, "--batch-size", None)
+    budget = _take(argv, "--budget", 8)
+    seed = _take(argv, "--seed", 0)
+    tolerance = _take(argv, "--tolerance", 0.5, cast=float)
+    if argv:
+        print(f"warning: unrecognized drill flags {argv}", file=sys.stderr)
+    if kill >= devices:
+        raise SystemExit(f"--kill {kill} must leave at least one of "
+                         f"--devices {devices} alive")
+
+    # CPU host-device emulation BEFORE any backend client exists (the drill
+    # is an emulation tool by definition; a real-TPU drill would inject
+    # into live dispatch instead)
+    from ..runtime.platform import force_platform
+
+    force_platform("cpu", n_host_devices=devices)
+
+    import flexflow_tpu as ff
+
+    from .coordinator import ElasticCoordinator
+    from .events import EventLog
+    from .faults import FaultPlan
+    from .retry import RetryPolicy
+
+    survivors = devices - kill
+    if batch is None:
+        # one batch size every candidate dp degree divides, before AND
+        # after the kill
+        batch = int(np.lcm(devices, survivors)) * 2
+    if steps is None:
+        steps = at_step + 6  # enough post-recovery steps to see progress
+
+    rng = np.random.RandomState(seed)
+    n_samples = batch * 4
+    x = rng.randn(n_samples, 64).astype(np.float32)
+    # learnable labels (a fixed random linear map of x): the loss has to
+    # keep DECREASING through the recovery for the drill to prove resume
+    w_true = rng.randn(64, 10).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).reshape(-1, 1).astype(np.int32)
+
+    def make_config():
+        cfg = ff.FFConfig()
+        cfg.batch_size = batch
+        cfg.seed = seed
+        cfg.search_budget = budget  # > 0: compile() runs the Unity search
+        cfg.measure_op_costs = False  # analytic costs on the CPU emulation
+        cfg.device_ids = list(range(devices))
+        return cfg
+
+    def builder(cfg):
+        m = ff.FFModel(cfg)
+        t = m.create_tensor([cfg.batch_size, 64])
+        t = m.dense(t, 128, ff.ActiMode.AC_MODE_RELU)
+        t = m.dense(t, 10)
+        t = m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(m, lr=0.05),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+        return m
+
+    # scripted adversity: one retryable hiccup early, the kill at --at-step
+    plan = (FaultPlan()
+            .add_transient(at_step=max(1, at_step // 2), times=1)
+            .add_chip_loss(at_step=at_step,
+                           chips=list(range(survivors, devices))))
+    events = EventLog()
+    coord = ElasticCoordinator(
+        builder, make_config(), fault_plan=plan,
+        checkpoint_dir=tempfile.mkdtemp(prefix="ff_drill_"),
+        checkpoint_every=2, events=events,
+        retry_policy=RetryPolicy(max_retries=3, base_delay_s=0.01))
+    history = coord.fit(x, y, steps=steps, verbose=True)
+
+    # uninterrupted reference: same data, seed, and step count on the full
+    # mesh — the recovered run must land in its neighborhood
+    ref = ElasticCoordinator(builder, make_config(), fault_plan=None,
+                             checkpoint_dir=tempfile.mkdtemp(
+                                 prefix="ff_drill_ref_"),
+                             checkpoint_every=10 ** 9)
+    ref_history = ref.fit(x, y, steps=steps)
+
+    from ..runtime.profiling import print_event_log
+
+    print_event_log(events)
+
+    final = history[-1]["loss"]
+    ref_final = ref_history[-1]["loss"]
+    counts = events.counts()
+    recovered = counts.get("recovery.done", 0) >= 1
+    retried = counts.get("retry", 0) >= 1
+    within_tol = (np.isfinite(final)
+                  and abs(final - ref_final) <= tolerance
+                  * max(1.0, abs(ref_final)))
+    # loss must keep decreasing THROUGH the recovery: batches cycle, so
+    # compare the last step against the first step that saw the same batch
+    spe = n_samples // batch
+    by_batch = {}
+    for h in history:
+        by_batch.setdefault(h["step"] % spe, []).append(h["loss"])
+    same_batch = by_batch[history[-1]["step"] % spe]
+    if len(same_batch) < 2:
+        # the final batch was only seen once (short --steps): judge by any
+        # batch revisited at least twice; none revisited -> nothing to
+        # compare, the tolerance check alone decides
+        revisited = [v for v in by_batch.values() if len(v) >= 2]
+        same_batch = revisited[-1] if revisited else None
+    improved = same_batch is None or same_batch[-1] < same_batch[0]
+    ok = bool(recovered and retried and within_tol and improved)
+    summary = {
+        "ok": ok,
+        "devices": devices,
+        "killed": kill,
+        "n_devices_final": len(coord.device_ids),
+        "recoveries": counts.get("recovery.done", 0),
+        "retries": counts.get("retry", 0),
+        "steps": steps,
+        "final_loss": round(float(final), 6),
+        "reference_loss": round(float(ref_final), 6),
+        "final_axes": dict(coord.model.parallel_axes),
+        "events": counts,
+    }
+    print(json.dumps(summary))
+    return 0 if ok else 1

@@ -1,0 +1,107 @@
+"""Structured fault/recovery event log for the elastic runtime.
+
+Every fault the injector fires, every detector classification, every retry,
+and every phase of a recovery (search, restore, resume) lands here as one
+timestamped record, so a post-mortem can replay exactly what the runtime saw
+and did. Surfaced two ways: `runtime/profiling.py::print_event_log` renders
+the table next to the iteration timings, and the serving metrics endpoint
+exports per-kind counters (`InferenceServer.attach_elastic_events`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# canonical event kinds (free-form kinds are allowed; these are the ones the
+# runtime itself emits)
+FAULT_TRANSIENT = "fault.transient"
+FAULT_SLOW_LINK = "fault.slow_link"
+FAULT_CHIP_LOSS = "fault.chip_loss"
+DETECT_SLOW = "detect.slow_step"
+DETECT_TOPOLOGY = "detect.topology_loss"
+RETRY = "retry"
+CHECKPOINT = "checkpoint"
+RECOVERY_START = "recovery.start"
+RECOVERY_SEARCH = "recovery.search"
+RECOVERY_RESTORE = "recovery.restore"
+RECOVERY_DONE = "recovery.done"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticEvent:
+    """One fault/recovery record."""
+
+    kind: str
+    step: int
+    time_s: float  # wall-clock (time.time) at record time
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "step": self.step,
+                "time_s": self.time_s, "details": dict(self.details)}
+
+
+class EventLog:
+    """Append-only, thread-safe log of ElasticEvents (the serving endpoint
+    reads it from handler threads while training appends)."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._events: List[ElasticEvent] = []
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, step: int = -1, **details) -> ElasticEvent:
+        ev = ElasticEvent(kind=kind, step=step, time_s=self._clock(),
+                          details=details)
+        with self._lock:
+            self._events.append(ev)
+        return ev
+
+    def events(self, kind: Optional[str] = None) -> List[ElasticEvent]:
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e.kind == kind]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events():
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps([e.to_dict() for e in self.events()])
+
+    @classmethod
+    def from_json(cls, text: str) -> "EventLog":
+        log = cls()
+        for d in json.loads(text):
+            with log._lock:
+                log._events.append(ElasticEvent(
+                    kind=d["kind"], step=d["step"], time_s=d["time_s"],
+                    details=dict(d.get("details", {}))))
+        return log
+
+    def prometheus_text(self, prefix: str = "ff_elastic") -> str:
+        """Per-kind counters in Prometheus exposition format (merged into
+        the serving /metrics endpoint)."""
+        lines = [f"# TYPE {prefix}_events_total counter"]
+        for kind, n in sorted(self.counts().items()):
+            lines.append(f'{prefix}_events_total{{kind="{kind}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    def summary(self) -> str:
+        """One line per kind with counts, for log tails."""
+        c = self.counts()
+        if not c:
+            return "elastic: no events"
+        return "elastic: " + ", ".join(
+            f"{k}={n}" for k, n in sorted(c.items()))

@@ -661,7 +661,16 @@ class FFModel:
         self.parallel_axes = dict(parallel_axes)
         self._assign_strategy(self.parallel_axes)
 
-        self.mesh = make_mesh(self.parallel_axes) if self.parallel_axes else None
+        # explicit device subset (elastic: compile onto the survivors of a
+        # chip loss rather than jax.devices()'s prefix)
+        mesh_devices = None
+        if self.config.device_ids is not None:
+            import jax as _jax
+
+            all_devices = _jax.devices()
+            mesh_devices = [all_devices[i] for i in self.config.device_ids]
+        self.mesh = (make_mesh(self.parallel_axes, mesh_devices)
+                     if self.parallel_axes else None)
 
         self.executor = Executor(self.graph, self.config, self.mesh)
         import jax
@@ -669,6 +678,14 @@ class FFModel:
         self.params, self.state = self.executor.init_params(
             jax.random.PRNGKey(self.config.seed)
         )
+        # mesh-less compile with an explicit device subset (elastic: a
+        # single-survivor recovery): commit params to the chosen device so
+        # jitted steps execute there — jax.devices()[0], the default, may
+        # be the lost chip. opt_state inherits the placement via
+        # init_state(params) below.
+        if self.mesh is None and mesh_devices:
+            self.params = jax.device_put(self.params, mesh_devices[0])
+            self.state = jax.device_put(self.state, mesh_devices[0])
         reg_fn = None
         if self.weight_regularizers:
             regs = list(self.weight_regularizers)
@@ -961,6 +978,26 @@ class FFModel:
             )
         return out
 
+    def _label_dtype(self) -> DataType:
+        """Loss-driven label dtype: sparse-categorical labels are int class
+        ids, everything else trains against float targets."""
+        return (
+            DataType.DT_INT32
+            if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+            else DataType.DT_FLOAT
+        )
+
+    def _prep_step_batch(self, x: Sequence[np.ndarray], y: np.ndarray,
+                         lo: int, hi: int):
+        """Sharded (inputs, label) for one step — the single batch-prep
+        rule shared by fit/eval and the elastic coordinator's loop."""
+        inputs = self._prep_inputs(x, lo, hi)
+        label = self.executor.shard_batch(
+            np.ascontiguousarray(y[lo:hi]).astype(
+                self._label_dtype().np_dtype)
+        )
+        return inputs, label
+
     def _assert_trainable(self) -> None:
         if getattr(self, "_inference_only", None):
             raise RuntimeError(
@@ -1033,11 +1070,7 @@ class FFModel:
             if isinstance(x, np.ndarray):
                 x = [x]
             n = x[0].shape[0]
-        label_dtype = (
-            DataType.DT_INT32
-            if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
-            else DataType.DT_FLOAT
-        )
+        label_dtype = self._label_dtype()
         if n < bs * accum_steps:
             raise ValueError(
                 f"dataset has {n} samples but batch_size*accum_steps is "
@@ -1215,11 +1248,6 @@ class FFModel:
             x = [x]
         bs = batch_size or self.config.batch_size
         n = x[0].shape[0]
-        label_dtype = (
-            DataType.DT_INT32
-            if self.loss.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
-            else DataType.DT_FLOAT
-        )
         pm = PerfMetrics()
 
         def absorb(pending):
@@ -1234,10 +1262,7 @@ class FFModel:
             lo, hi = it * bs, min((it + 1) * bs, n)
             if hi <= lo:
                 break
-            inputs = self._prep_inputs(x, lo, hi)
-            label = self.executor.shard_batch(
-                np.ascontiguousarray(y[lo:hi]).astype(label_dtype.np_dtype)
-            )
+            inputs, label = self._prep_step_batch(x, y, lo, hi)
             mvals, _ = self._eval_step(self.params, self.state, inputs, label)
             if pending is not None:
                 absorb(pending)

@@ -34,6 +34,11 @@ class Executor:
         self._multi_step = None
         self._eval_step = None
         self._forward_jit = None
+        # elastic runtime: wraps jitted TRAIN-step dispatch with fault
+        # injection + failure detection + retry (elastic/detector.py).
+        # Train steps only — eval/forward dispatches are side-effect-free
+        # and re-runnable by their callers, so they stay unguarded.
+        self.step_wrapper = getattr(config, "elastic_step_wrapper", None)
         # pipeline parallelism: a 'stage' mesh axis routes the repeated-block
         # region of the PCG through the GPipe kernel (beyond-reference:
         # upstream's OP_PIPELINE ffconst.h:159 is an unused enum)
@@ -294,7 +299,15 @@ class Executor:
             new_params, new_opt_state = optimizer.update(params, grads, opt_state)
             return new_params, new_opt_state, new_state, mvals
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # elastic retry re-dispatches the SAME arguments after a transient
+        # error that surfaced mid-execution; donation would have deleted
+        # them, turning every real-error retry into 'Array has been
+        # deleted'. Keeping the buffers is the price of retryability.
+        donate = () if self.step_wrapper is not None else (0, 1, 2)
+        fn = jax.jit(train_step, donate_argnums=donate)
+        if self.step_wrapper is not None:
+            fn = self.step_wrapper(fn)
+        self._train_step = fn
         return self._train_step
 
     def build_multi_step(self, optimizer, loss_fn, metrics: Metrics,
@@ -325,7 +338,13 @@ class Executor:
                 one, (params, opt_state, state), (inputs_k, label_k, rng_k))
             return params, opt_state, state, mvals
 
-        self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2))
+        # no donation under the elastic wrapper: retry needs the original
+        # buffers alive (see build_train_step)
+        donate = () if self.step_wrapper is not None else (0, 1, 2)
+        fn = jax.jit(multi_step, donate_argnums=donate)
+        if self.step_wrapper is not None:
+            fn = self.step_wrapper(fn)
+        self._multi_step = fn
         return self._multi_step
 
     def build_eval_step(self, loss_fn, metrics: Metrics, final_tensor):

@@ -80,6 +80,24 @@ def print_profile(rows: List[Dict], top: Optional[int] = 20) -> None:
                   f"{r['eff_tflops']:>12.2f}")
 
 
+def print_event_log(events, sink=print, tail: Optional[int] = None) -> None:
+    """Render an elastic EventLog (elastic/events.py) next to the timing
+    output: one line per fault/retry/recovery record, then the per-kind
+    counts. tail=N limits to the last N events."""
+    evs = events.events()
+    if tail is not None:
+        evs = evs[-tail:]
+    if not evs:
+        sink("elastic: no events")
+        return
+    t0 = evs[0].time_s
+    for e in evs:
+        details = " ".join(f"{k}={v}" for k, v in sorted(e.details.items()))
+        sink(f"+{e.time_s - t0:8.3f}s step {e.step:>5} "
+             f"{e.kind:<22} {details}")
+    sink(events.summary())
+
+
 class IterationTimer:
     """Rolling per-iteration wall timing (reference: per-`--print-freq`
     samples/s prints in the examples)."""

@@ -104,6 +104,13 @@ class MachineModel:
     def p2p_time_us(self, bytes_: float) -> float:
         return bytes_ / (self.chip.ici_link_gbps * 1e9) * 1e6 + 1.0
 
+    def p2p_single_path_time_us(self, bytes_: float) -> float:
+        """p2p over ONE path/direction — for patterns where every chip
+        pushes the same way simultaneously (the ring-SP neighbor ppermute),
+        so ECMP direction-splitting cannot apply. The base-model p2p is
+        already single-link; NetworkedMachineModel overrides both."""
+        return self.p2p_time_us(bytes_)
+
     def comm_channels(self) -> bool:
         """True when the model can price independent mesh axes as disjoint
         link sets (dp grad allreduce rides the 'data' rings while a tp
@@ -215,18 +222,30 @@ class NetworkedMachineModel(MachineModel):
         return self._min_degree() >= 4
 
     @classmethod
-    def from_json(cls, path: str, chip: Optional[ChipSpec] = None):
-        """Load topology from a JSON file: {"num_chips": N, "links":
-        [[i, j, gbps], ...], "segment_mb": 1.0, "routing": "ecmp"} (role of
-        --machine-model-file + the reference's routing/segment knobs)."""
-        with open(path) as f:
-            spec = json.load(f)
-        n = spec["num_chips"]
-        conn = np.zeros((n, n))
+    def from_json(cls, spec_or_path, chip: Optional[ChipSpec] = None):
+        """Load topology from a JSON file — or an already-parsed spec dict
+        (the elastic coordinator builds shrunken survivor specs in memory):
+        {"num_chips": N, "links": [[i, j, gbps], ...], "segment_mb": 1.0,
+        "routing": "ecmp"} (role of --machine-model-file + the reference's
+        routing/segment knobs). A spec with no/empty "links" keeps the
+        default 45 GB/s and falls back to the default 1-D ring topology;
+        "num_chips" defaults to 1 + the highest chip id named in "links"."""
+        if isinstance(spec_or_path, str):
+            with open(spec_or_path) as f:
+                spec = json.load(f)
+        else:
+            spec = dict(spec_or_path)
+        links = spec.get("links") or []
+        n = spec.get("num_chips")
+        if n is None:
+            n = max((max(i, j) for i, j, _ in links), default=0) + 1
         gbps = 45.0
-        for i, j, g in spec.get("links", []):
-            conn[i][j] = conn[j][i] = 1
-            gbps = g
+        conn = None  # no links: the default ring of the constructor
+        if links:
+            conn = np.zeros((n, n))
+            for i, j, g in links:
+                conn[i][j] = conn[j][i] = 1
+                gbps = g
         return cls(n, chip, conn, gbps,
                    segment_mb=float(spec.get("segment_mb", 1.0)),
                    routing=spec.get("routing", "ecmp"))
@@ -288,13 +307,22 @@ class NetworkedMachineModel(MachineModel):
             return 1.0
         return float(min(self._min_degree(), 4))
 
-    def p2p_time_us(self, bytes_: float) -> float:
-        bw = self.link_gbps * 1e9 * self.path_diversity()
+    def _p2p_time(self, bytes_: float, diversity: float) -> float:
+        bw = self.link_gbps * 1e9 * diversity
         seg = min(self.segment_bytes, max(bytes_, 1.0))
         h = self.avg_hops()
         # pipelined store-and-forward: the head segment pays every hop,
         # the rest stream behind it at line rate
         return (bytes_ + (h - 1.0) * seg) / bw * 1e6 + 1.0
+
+    def p2p_time_us(self, bytes_: float) -> float:
+        return self._p2p_time(bytes_, self.path_diversity())
+
+    def p2p_single_path_time_us(self, bytes_: float) -> float:
+        """One-directional transfer: every chip sends the same way at once
+        (ring-SP neighbor ppermute), so the transfer cannot split over the
+        equal-cost directions ECMP would otherwise use."""
+        return self._p2p_time(bytes_, 1.0)
 
     def link_bw(self, n_participants: int) -> float:
         return min(self._min_degree(), 2) * self.link_gbps * 1e9

@@ -312,8 +312,10 @@ class CostModel:
                 self.machine.all_to_all_time_us(q_tok, s.sp)
                 + self.machine.all_to_all_time_us(kv_tok, s.sp))
         kv_bytes = base / (max(1, s.dp) * s.sp)
-        # fwd rotation + mirrored bwd rotation of dK/dV
-        return 2.0 * (s.sp - 1) * self.machine.p2p_time_us(kv_bytes)
+        # fwd rotation + mirrored bwd rotation of dK/dV; single-path: all
+        # chips rotate the SAME direction, so ECMP cannot split the hop
+        return 2.0 * (s.sp - 1) * self.machine.p2p_single_path_time_us(
+            kv_bytes)
 
     def ep_collective_time_us(self, op: Op, s: OpStrategy) -> float:
         """Token routing cost of expert parallelism: all_to_all of the

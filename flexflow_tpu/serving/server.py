@@ -60,6 +60,14 @@ class InferenceServer:
         # session); the policy dict holds the registration-time decode
         # knobs (tokens_per_dispatch/temperature/top_k)
         self._generative: Dict[str, tuple] = {}
+        # elastic runtime event log (elastic/events.py), exported on
+        # /metrics when attached
+        self._elastic_events = None
+
+    def attach_elastic_events(self, events) -> None:
+        """Surface an elastic EventLog's per-kind counters on the metrics
+        endpoint (ff_elastic_events_total{kind=...}) and in stats()."""
+        self._elastic_events = events
 
     def register(self, name: str, model, max_batch_size: int = 64,
                  max_delay_ms: float = 2.0,
@@ -151,7 +159,10 @@ class InferenceServer:
     def stats(self, name: Optional[str] = None):
         if name is not None:
             return self._metrics[name].stats()
-        return {n: m.stats() for n, m in sorted(self._metrics.items())}
+        out = {n: m.stats() for n, m in sorted(self._metrics.items())}
+        if self._elastic_events is not None:
+            out["_elastic"] = self._elastic_events.counts()
+        return out
 
     def prometheus_text(self) -> str:
         """Prometheus exposition-format metrics (the Triton /metrics role)."""
@@ -169,7 +180,10 @@ class InferenceServer:
             lines.append(f'ff_inference_requests_total{{model="{n}"}} {s["requests"]}')
             lines.append(f'ff_inference_failures_total{{model="{n}"}} {s["failures"]}')
             lines.append(f'ff_inference_avg_latency_ms{{model="{n}"}} {s["avg_latency_ms"]}')
-        return "\n".join(lines) + "\n"
+        out = "\n".join(lines) + "\n"
+        if self._elastic_events is not None:
+            out += self._elastic_events.prometheus_text()
+        return out
 
     def shutdown(self):
         for name in list(self._models) + list(self._generative):

@@ -61,11 +61,11 @@ run_step() {  # run_step <artifact> <timeout_s> <cmd...>
   [ -s "$art" ] && return 0
   past "$CHAIN_DEADLINE" && { log "chain deadline; skip $art"; return 3; }
   log "step start: $art"
-  if timeout "$tmo" "$@" > "/tmp/r5_step.json" 2>> "$LOG"; then
+  if timeout "$tmo" "$@" > "/tmp/${ROUND}_step.json" 2>> "$LOG"; then
     # keep only if the output parses as JSON somewhere in the last line
-    if python - "$art" <<'EOF'
+    if python - "/tmp/${ROUND}_step.json" <<'EOF'
 import json, sys
-lines = [l for l in open("/tmp/r5_step.json").read().splitlines() if l.strip()]
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
 ok = False
 for l in reversed(lines):
     try:
@@ -75,7 +75,7 @@ for l in reversed(lines):
 sys.exit(0 if ok else 1)
 EOF
     then
-      cp /tmp/r5_step.json "$art"
+      cp /tmp/${ROUND}_step.json "$art"
       log "step done: $art"
       return 0
     fi

@@ -683,3 +683,57 @@ def test_mcmc_vs_unity_comparable():
                            simulator=sim)
     mcmc = mcmc_search(graph, model.config, machine, 64, 8, simulator=sim)
     assert unity.cost_us <= mcmc.cost_us * 1.05
+
+
+# -- elastic-PR regressions (machine model fixes) -----------------------
+def test_from_json_empty_links_uses_defaults(tmp_path):
+    """A spec with no/empty 'links' must not NameError: it keeps the
+    default 45 GB/s and falls back to the default ring topology (the
+    elastic coordinator feeds shrunken survivor specs through here, and a
+    loss can sever every link of the survivor set)."""
+    m = NetworkedMachineModel.from_json({"links": []})
+    assert m.num_chips == 1 and m.link_gbps == 45.0
+
+    m4 = NetworkedMachineModel.from_json({"num_chips": 4, "links": []})
+    assert m4.num_chips == 4 and m4.link_gbps == 45.0
+    assert m4.hop_count(0, 2) == 2  # default-ring fallback is connected
+
+    p = tmp_path / "empty_links.json"
+    p.write_text(json.dumps({"num_chips": 3}))
+    mf = NetworkedMachineModel.from_json(str(p))
+    assert mf.num_chips == 3 and mf.link_gbps == 45.0
+
+    # num_chips may be inferred from the links when omitted
+    mi = NetworkedMachineModel.from_json(
+        {"links": [[0, 1, 90.0], [1, 2, 90.0]]})
+    assert mi.num_chips == 3 and mi.link_gbps == 90.0
+
+
+def test_sp_ring_ppermute_is_single_path():
+    """The ring-SP neighbor ppermute sends one direction on every chip at
+    once: ECMP cannot split it over both ring directions, so its cost must
+    NOT see the 2x path_diversity multiplier (while plain p2p still
+    does)."""
+    from flexflow_tpu.search.simulator import CostModel
+
+    ecmp = NetworkedMachineModel(8)
+    single = NetworkedMachineModel(8, routing="single")
+    b = 45e9
+    # plain p2p keeps the ECMP split; the single-path variant does not
+    assert ecmp.p2p_time_us(b) == pytest.approx(0.5e6, rel=0.01)
+    assert ecmp.p2p_single_path_time_us(b) == pytest.approx(1e6, rel=0.01)
+    assert ecmp.p2p_single_path_time_us(b) \
+        == pytest.approx(single.p2p_time_us(b))
+
+    config = ff.FFConfig()
+    config.batch_size = 8
+    model = ff.FFModel(config)
+    q = model.create_tensor([8, 128, 64])
+    model.multihead_attention(q, q, q, 64, 4)
+    attn = next(op for op in model.ops
+                if op.op_type == OpType.MULTIHEAD_ATTENTION)
+    s = OpStrategy(dp=1, tp=1, sp=4)
+    ring_ecmp = CostModel(ecmp, config).sp_collective_time_us(attn, s)
+    ring_single = CostModel(single, config).sp_collective_time_us(attn, s)
+    assert ring_ecmp > 0
+    assert ring_ecmp == pytest.approx(ring_single)
