@@ -81,6 +81,12 @@ def main(argv=None):
         from .elastic.drill import run_drill
 
         raise SystemExit(run_drill(argv[1:]))
+    # plan sanitizer: static diagnostic report over a zoo model's PCG plus
+    # an exported strategy JSON (docs/analysis.md)
+    if argv and argv[0] == "analyze":
+        from .analysis.cli import run_analyze
+
+        raise SystemExit(run_analyze(argv[1:]))
     # script mode: first non-flag arg ending in .py
     script = next((a for a in argv if a.endswith(".py")), None)
     if script is not None:

@@ -1,0 +1,40 @@
+"""Plan sanitizer: static analysis over the PCG + strategy that rejects
+illegal plans before XLA ever sees them (ISSUE 2).
+
+Public surface:
+ - Diagnostic / DiagnosticReport / PlanAnalysisError / Severity — typed
+   findings with stable FFTA0xx codes (docs/analysis.md catalogues them);
+ - analyze_plan / check_plan — the pass pipeline over
+   (Graph, strategies, MachineModel, config);
+ - factorization_diagnostics — the cheap per-candidate check the Unity
+   search prunes with;
+ - diagnostic_counters — process-wide per-code counters, exported on the
+   serving /metrics endpoint.
+"""
+from .diagnostics import (CODE_CATALOG, Diagnostic, DiagnosticReport,
+                          PlanAnalysisError, Severity, diagnostic_counters,
+                          make_diag, record_report, reset_counters)
+from .passes import (AnalysisContext, default_strategies_for,
+                     factorization_diagnostics)
+from .pipeline import (ALL_PASSES, CHEAP_PASSES, PASS_REGISTRY,
+                       analyze_plan, check_plan)
+
+__all__ = [
+    "ALL_PASSES",
+    "AnalysisContext",
+    "CHEAP_PASSES",
+    "CODE_CATALOG",
+    "Diagnostic",
+    "DiagnosticReport",
+    "PASS_REGISTRY",
+    "PlanAnalysisError",
+    "Severity",
+    "analyze_plan",
+    "check_plan",
+    "default_strategies_for",
+    "diagnostic_counters",
+    "factorization_diagnostics",
+    "make_diag",
+    "record_report",
+    "reset_counters",
+]

@@ -1,0 +1,90 @@
+"""`python -m flexflow_tpu analyze` — static plan analysis from the shell.
+
+Loads a zoo model's PCG plus (optionally) an exported strategy JSON
+(search/unity.py export_strategy) and prints the plan sanitizer's
+diagnostic report. Exit status 0 when the plan is legal (warnings
+allowed), 1 when any error-severity diagnostic fires — so CI can gate
+checked-in strategies (.github/workflows/tests.yml `analyze` job).
+
+    python -m flexflow_tpu analyze --model bert --chips 8 \
+        --strategy examples/strategies/bert_8dev.json
+
+Flags: --model NAME (zoo model, default mnist_mlp), --strategy FILE,
+--json (machine-readable report), plus every standard FFConfig flag
+(--chips N sizes the analyzed device pool/machine model).
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from .diagnostics import PlanAnalysisError, record_report
+
+
+def run_analyze(argv: Optional[List[str]] = None) -> int:
+    import flexflow_tpu as ff
+
+    from ..__main__ import _synthetic
+    from ..core.graph import Graph
+    from ..search.machine_model import make_machine_model
+    from .pipeline import analyze_plan
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    model_name = "mnist_mlp"
+    strategy_path = None
+    as_json = False
+    if "--model" in argv:
+        i = argv.index("--model")
+        if i + 1 >= len(argv):
+            print("analyze: --model needs a value", file=sys.stderr)
+            return 2
+        model_name = argv[i + 1]
+        del argv[i:i + 2]
+    if "--strategy" in argv:
+        i = argv.index("--strategy")
+        if i + 1 >= len(argv):
+            print("analyze: --strategy needs a value", file=sys.stderr)
+            return 2
+        strategy_path = argv[i + 1]
+        del argv[i:i + 2]
+    if "--json" in argv:
+        as_json = True
+        argv.remove("--json")
+
+    config = ff.FFConfig()
+    rest = config.parse_args(argv)
+    if rest:
+        print(f"warning: unrecognized flags {rest}", file=sys.stderr)
+    n_dev = config.total_devices
+
+    model, _, _ = _synthetic(model_name, config)
+    graph = Graph(model.ops)
+
+    strategies = None
+    if strategy_path is not None:
+        # the one shared preamble compile()'s --import path uses, so the
+        # CLI's verdict matches what compile() will actually do
+        from ..search.unity import rewrite_and_import_strategy
+
+        try:
+            strategies, axes = rewrite_and_import_strategy(
+                graph, config, strategy_path)
+        except PlanAnalysisError as exc:
+            print(exc.report.to_json() if as_json else exc.report.format())
+            return 1
+    else:
+        axes = {"data": n_dev} if n_dev > 1 else {}
+
+    final = graph.topo_order()[-1] if graph.ops else None
+    report = analyze_plan(
+        graph, strategies=strategies,
+        machine=make_machine_model(config, n_dev), config=config,
+        batch_size=config.batch_size, n_devices=n_dev, mesh_axes=axes,
+        final_guid=final.guid if final is not None else None)
+    record_report(report)
+    print(report.to_json() if as_json else report.format())
+    if report.ok:
+        print(f"plan OK: {model_name} on {n_dev} device(s)"
+              + (f" under {strategy_path}" if strategy_path else ""))
+        return 0
+    return 1

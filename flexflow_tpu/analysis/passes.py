@@ -1,0 +1,450 @@
+"""Static analysis passes over (PCG, strategies, machine).
+
+Each pass is a pure function `AnalysisContext -> List[Diagnostic]` covering
+one family of plan-legality properties:
+
+ 1. divisibility/degree   — every partition degree divides the dimension it
+    shards and can actually be realized by the strategy assignment;
+ 2. memory fit            — per-chip bytes (params + optimizer state +
+    saved activations, via CostModel.op_memory_bytes) vs HBM capacity;
+ 3. collective legality   — one degree per mesh axis, legal reduction
+    (row-parallel) pairings, no reshard ping-pong, mesh fits the devices;
+ 4. aliasing/donation     — donation hazards under the elastic retry
+    wrapper (the class PR 1 dodged by disabling train-step donation);
+ 5. graph hygiene         — dangling producers, stale tensor_aliases
+    chains, unreachable ops, mixed-dtype elementwise boundaries.
+
+The passes never mutate the graph and never import jax. The Unity search
+prunes with the still-cheaper `factorization_diagnostics` below, which
+checks a mesh tuple without needing per-op strategies.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from ..core.graph import Graph
+from ..ffconst import OpType
+from .diagnostics import Diagnostic, make_diag
+
+# strategy field -> the mesh axis it shards over (one convention with
+# unity.mesh_axes_for and FFModel._assign_strategy)
+AXIS_OF_FIELD = {"dp": "data", "tp": "model", "ep": "expert",
+                 "ap": "attr", "sp": "seq"}
+
+_EW_BINARY = {OpType.EW_ADD, OpType.EW_SUB, OpType.EW_MUL, OpType.EW_DIV,
+              OpType.EW_MAX, OpType.EW_MIN}
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Inputs of one pipeline run. `strategies` maps op guid -> OpStrategy
+    (None entries fall back to the replicated default); `machine` may be
+    None, in which case the memory-fit pass is skipped."""
+
+    graph: Graph
+    strategies: Optional[Dict[int, object]] = None
+    mesh_axes: Optional[Dict[str, int]] = None
+    machine: Optional[object] = None
+    config: Optional[object] = None
+    batch_size: Optional[int] = None
+    n_devices: Optional[int] = None
+    final_guid: Optional[int] = None
+
+    def strategy_of(self, op):
+        if not self.strategies:
+            return None
+        return self.strategies.get(op.guid)
+
+
+def default_strategies_for(graph: Graph, mesh_axes: Dict[str, int],
+                           batch_size: Optional[int]) -> Dict[int, object]:
+    """Per-op strategies a mesh-wide default assignment realizes — mirrors
+    FFModel._assign_strategy's guards, so analyzing a no-search compile
+    sees the degrees that will actually apply."""
+    from ..search.simulator import (AP_CAPABLE, OpStrategy, TP_CAPABLE,
+                                    sp_shardable)
+    from ..search.unity import _ap_divides, _tp_divides
+
+    dp = mesh_axes.get("data", 1)
+    tp = mesh_axes.get("model", 1)
+    ap = mesh_axes.get("attr", 1)
+    sp = mesh_axes.get("seq", 1)
+    ep = mesh_axes.get("expert", 1)
+    out: Dict[int, object] = {}
+    for op in graph.ops.values():
+        t = op.outputs[0] if op.outputs else None
+        op_dp = dp if (dp > 1 and t is not None and t.dims
+                       and t.dims[0] == batch_size
+                       and t.dims[0] % dp == 0) else 1
+        op_tp = tp if (tp > 1 and op.op_type in TP_CAPABLE
+                       and _tp_divides(op, tp)) else 1
+        op_ap = ap if (ap > 1 and op.op_type in AP_CAPABLE
+                       and _ap_divides(op, ap)) else 1
+        # mirror _assign_strategy's attention-dropout exception: the SP
+        # kernels have no attention-prob dropout, so that op stays
+        # unsharded — without this the memory pass would size its
+        # activations divided by sp and miss a real per-chip overflow
+        op_sp = sp if (sp_shardable(op, sp)
+                       and not (op.op_type == OpType.MULTIHEAD_ATTENTION
+                                and op.params.get("dropout", 0.0) > 0)) \
+            else 1
+        op_ep = ep if (ep > 1 and op.op_type == OpType.EXPERTS
+                       and op.params["n"] % ep == 0) else 1
+        out[op.guid] = OpStrategy(dp=op_dp, tp=op_tp, ep=op_ep, ap=op_ap,
+                                  sp=op_sp)
+    return out
+
+
+# ---------------------------------------------------------------------
+# pass 1: divisibility / degree
+# ---------------------------------------------------------------------
+def pass_divisibility(ctx: AnalysisContext) -> List[Diagnostic]:
+    from ..search.simulator import AP_CAPABLE, TP_CAPABLE, sp_capability
+    from ..search.unity import _ap_divides, _tp_divides
+
+    diags: List[Diagnostic] = []
+    if not ctx.strategies:
+        return diags
+    batch = ctx.batch_size
+    for op in ctx.graph.ops.values():
+        s = ctx.strategy_of(op)
+        if s is None:
+            continue
+        if ctx.n_devices and s.degree > ctx.n_devices:
+            diags.append(make_diag(
+                "FFTA003",
+                f"strategy degree {s.degree} (dp={s.dp} tp={s.tp} ep={s.ep}"
+                f" ap={s.ap} sp={s.sp}) exceeds the {ctx.n_devices}-device"
+                " machine", op,
+                hint="shrink the strategy or grow the device pool"))
+        if s.dp > 1:
+            t = op.outputs[0] if op.outputs else None
+            if t is None or not t.dims:
+                diags.append(make_diag(
+                    "FFTA002", f"dp={s.dp} on an op with no batched output",
+                    op))
+            elif batch is not None and t.dims[0] != batch:
+                diags.append(make_diag(
+                    "FFTA002",
+                    f"dp={s.dp} requested but the leading dim is"
+                    f" {t.dims[0]}, not the batch ({batch}); the op runs"
+                    " replicated", op,
+                    hint="the cost model over-promises here; prefer dp=1"))
+            elif t.dims[0] % s.dp:
+                diags.append(make_diag(
+                    "FFTA001",
+                    f"dp={s.dp} does not divide the batch dim {t.dims[0]}",
+                    op, hint=f"choose a divisor of {t.dims[0]}"))
+        if s.tp > 1:
+            if op.op_type not in TP_CAPABLE:
+                diags.append(make_diag(
+                    "FFTA002",
+                    f"tp={s.tp} on a non-tensor-parallel op"
+                    f" ({op.op_type.value})", op))
+            elif not _tp_divides(op, s.tp):
+                diags.append(make_diag(
+                    "FFTA001",
+                    f"tp={s.tp} does not divide the op's sharded channel"
+                    " dim (out_dim/heads)", op,
+                    hint="choose a divisor of the channel dimension"))
+        if s.ep > 1:
+            if op.op_type != OpType.EXPERTS:
+                diags.append(make_diag(
+                    "FFTA002", f"ep={s.ep} on a non-EXPERTS op", op))
+            elif op.params["n"] % s.ep:
+                diags.append(make_diag(
+                    "FFTA001",
+                    f"ep={s.ep} does not divide the expert count"
+                    f" {op.params['n']}", op))
+        if s.ap > 1:
+            if op.op_type not in AP_CAPABLE:
+                diags.append(make_diag(
+                    "FFTA002", f"ap={s.ap} on a non-spatial op", op))
+            elif not _ap_divides(op, s.ap):
+                diags.append(make_diag(
+                    "FFTA001",
+                    f"ap={s.ap} does not divide the spatial (H) dims or"
+                    " breaks stride alignment", op))
+        if s.sp > 1:
+            if not sp_capability(op):
+                diags.append(make_diag(
+                    "FFTA002",
+                    f"sp={s.sp} on an op with no position dim", op))
+            elif op.outputs[0].dims[1] % s.sp:
+                diags.append(make_diag(
+                    "FFTA001",
+                    f"sp={s.sp} does not divide the sequence dim"
+                    f" {op.outputs[0].dims[1]}", op))
+    return diags
+
+
+_UNSET = object()
+
+
+def factorization_diagnostics(graph: Graph, config, batch_size: int,
+                              factorization, sp_pred=_UNSET,
+                              expert_counts=None,
+                              has_spatial=None) -> List[Diagnostic]:
+    """Cheap legality of one (dp, tp, ep, ap, sp) mesh factorization —
+    exactly the feasibility conditions GraphSearchHelper._parallelize
+    enforces, expressed as diagnostics so the search can prune (and count)
+    infeasible candidates before the cost simulator sees them. sp_pred /
+    expert_counts / has_spatial: precomputed make_sp_feasible result and
+    graph-scan facts, so a caller sweeping many tuples does not rebuild
+    them per tuple."""
+    from ..search.simulator import AP_CAPABLE
+    from ..search.unity import make_sp_feasible
+
+    dp, tp, ep, ap, sp = factorization
+    diags: List[Diagnostic] = []
+    if batch_size % dp:
+        diags.append(make_diag(
+            "FFTA001", f"dp={dp} does not divide the batch {batch_size}"))
+    if ep > 1:
+        if expert_counts is None:
+            expert_counts = {op.params["n"] for op in graph.ops.values()
+                             if op.op_type == OpType.EXPERTS}
+        if not expert_counts:
+            diags.append(make_diag(
+                "FFTA004", f"ep={ep}: the graph has no EXPERTS ops"))
+        elif any(n % ep for n in expert_counts):
+            diags.append(make_diag(
+                "FFTA001",
+                f"ep={ep} does not divide every expert count"
+                f" ({sorted(expert_counts)})"))
+    if ap > 1:
+        if has_spatial is None:
+            has_spatial = any(op.op_type in AP_CAPABLE
+                              for op in graph.ops.values())
+        if not (config.enable_attribute_parallel and has_spatial):
+            diags.append(make_diag(
+                "FFTA004",
+                f"ap={ap}: attribute parallelism disabled or no spatial"
+                " ops"))
+    if sp > 1:
+        pred = make_sp_feasible(graph, config) if sp_pred is _UNSET else sp_pred
+        if pred is None or not pred(sp):
+            diags.append(make_diag(
+                "FFTA004",
+                f"sp={sp}: sequence parallelism infeasible (disabled, no"
+                " attention, dropout-carrying attention, or lengths/heads"
+                " do not divide)"))
+    return diags
+
+
+# ---------------------------------------------------------------------
+# pass 2: memory fit
+# ---------------------------------------------------------------------
+def pass_memory_fit(ctx: AnalysisContext) -> List[Diagnostic]:
+    if ctx.machine is None:
+        return []
+    from ..search.simulator import CostModel, OpStrategy
+    from .diagnostics import Severity
+
+    cost = CostModel(ctx.machine, ctx.config)
+    default = OpStrategy()
+    total = 0.0
+    worst_op, worst_bytes = None, -1.0
+    for op in ctx.graph.ops.values():
+        s = ctx.strategy_of(op) or default
+        try:
+            b = cost.op_memory_bytes(op, s)
+        except Exception:
+            continue  # exotic op the cost model can't size: not a verdict
+        total += b
+        if b > worst_bytes:
+            worst_op, worst_bytes = op, b
+    cap = ctx.machine.memory_budget_bytes()
+    # an explicitly set --memory-budget is authoritative, the way the
+    # memory-aware Unity/MCMC searches treat it — the gate and the search
+    # must agree on what fits (a host-RAM run can legitimately exceed the
+    # nominal chip spec). The untouched class default defers to the
+    # machine spec, so a shrunken/small machine still gates correctly.
+    if ctx.config is not None:
+        budget_mb = getattr(ctx.config, "memory_budget_mb", None)
+        default_mb = getattr(type(ctx.config), "memory_budget_mb", None)
+        if budget_mb is not None and budget_mb != default_mb:
+            cap = budget_mb * 1e6
+    if cap <= 0:
+        return []
+    # pipeline ('stage') sharding lives outside OpStrategy — the GPipe
+    # region shards weights/opt-state S-ways, which this per-op sum cannot
+    # see. A memory-motivated pipeline plan would be wrongly rejected, so
+    # overflow degrades to a warning under a stage axis.
+    stages = (ctx.mesh_axes or {}).get("stage", 1)
+    if total > cap:
+        return [make_diag(
+            "FFTA010",
+            f"plan needs {total / 1e9:.2f} GB/chip but HBM is"
+            f" {cap / 1e9:.2f} GB (largest op:"
+            f" {worst_op.name if worst_op else '?'} at"
+            f" {worst_bytes / 1e9:.2f} GB)"
+            + (f"; estimate ignores {stages}-way stage sharding"
+               if stages > 1 else ""),
+            hint="shard weights (tp/ep), raise --memory-budget, or relax"
+                 " the gate with --plan-analysis warn",
+            severity=Severity.WARNING if stages > 1 else None)]
+    if total > 0.85 * cap:
+        return [make_diag(
+            "FFTA011",
+            f"plan needs {total / 1e9:.2f} GB/chip, above 85% of the"
+            f" {cap / 1e9:.2f} GB HBM — fragmentation/workspace may OOM")]
+    return []
+
+
+# ---------------------------------------------------------------------
+# pass 3: collective legality
+# ---------------------------------------------------------------------
+def pass_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    axes = ctx.mesh_axes or {}
+    if axes and ctx.n_devices:
+        need = 1
+        for v in axes.values():
+            need *= v
+        if need > ctx.n_devices:
+            diags.append(make_diag(
+                "FFTA023",
+                f"mesh axes {axes} need {need} devices, have"
+                f" {ctx.n_devices}"))
+    if not ctx.strategies:
+        return diags
+    for op in ctx.graph.ops.values():
+        s = ctx.strategy_of(op)
+        if s is None:
+            continue
+        for field, axis in AXIS_OF_FIELD.items():
+            deg = getattr(s, field)
+            if deg <= 1:
+                continue
+            have = axes.get(axis)
+            if have is None:
+                if axes:  # no declared axes at all -> nothing to conflict
+                    diags.append(make_diag(
+                        "FFTA002",
+                        f"{field}={deg} but the mesh has no {axis!r} axis;"
+                        " the degree degrades to replicated", op))
+            elif have != deg:
+                diags.append(make_diag(
+                    "FFTA021",
+                    f"{field}={deg} conflicts with mesh axis"
+                    f" {axis!r}={have}: one axis cannot carry two degrees",
+                    op,
+                    hint="all ops sharding an axis must use its full size"))
+        if s.tp_row:
+            if op.op_type != OpType.LINEAR:
+                diags.append(make_diag(
+                    "FFTA020",
+                    "row-parallel (reduction) strategy on a non-LINEAR op",
+                    op))
+            elif s.tp > 1 and op.inputs and op.inputs[0].dims \
+                    and op.inputs[0].dims[-1] % s.tp:
+                diags.append(make_diag(
+                    "FFTA020",
+                    f"row-parallel tp={s.tp} does not divide the input"
+                    f" feature dim {op.inputs[0].dims[-1]}", op))
+    # reshard ping-pong: producer gathered to a coarser degree only for a
+    # consumer to re-partition back (legal, but two collectives that a
+    # degree-consistent chain avoids)
+    for op in ctx.graph.topo_order():
+        s = ctx.strategy_of(op)
+        if s is None:
+            continue
+        finer_producer = any(
+            (ctx.strategy_of(t.owner_op) is not None
+             and ctx.strategy_of(t.owner_op).dp > s.dp)
+            for t in op.inputs
+            if t.owner_op is not None and t.owner_op.guid in ctx.graph.ops)
+        if not finer_producer:
+            continue
+        for con in ctx.graph.successors(op):
+            cs = ctx.strategy_of(con)
+            if cs is not None and cs.dp > s.dp:
+                diags.append(make_diag(
+                    "FFTA022",
+                    f"dp degree dips to {s.dp} here between finer-sharded"
+                    f" producer and consumer (dp={cs.dp}): gather followed"
+                    " by re-partition", op,
+                    hint="keep the chain at one dp degree"))
+                break
+    return diags
+
+
+# ---------------------------------------------------------------------
+# pass 4: aliasing / donation safety
+# ---------------------------------------------------------------------
+def pass_donation(ctx: AnalysisContext) -> List[Diagnostic]:
+    cfg = ctx.config
+    if cfg is None or getattr(cfg, "elastic_step_wrapper", None) is None:
+        return []
+    # the executor already strips donate_argnums from the train/multi steps
+    # when a step wrapper is installed (the PR-1 dodge); what remains unsafe
+    # to retry is the gradient-accumulation path, whose add/update closures
+    # donate their operands unconditionally
+    return [make_diag(
+        "FFTA030",
+        "elastic retry wrapper active: fit(accum_steps>1) donates the"
+        " accumulator and consumed params/opt_state, so a retried dispatch"
+        " would re-read donated buffers",
+        hint="keep accum_steps=1 under the elastic runtime, or checkpoint"
+             " before accumulation windows")]
+
+
+# ---------------------------------------------------------------------
+# pass 5: graph hygiene
+# ---------------------------------------------------------------------
+def pass_hygiene(ctx: AnalysisContext) -> List[Diagnostic]:
+    graph = ctx.graph
+    diags: List[Diagnostic] = []
+    for op in graph.ops.values():
+        for t in op.inputs:
+            if t.owner_op is not None and t.owner_op.guid not in graph.ops:
+                diags.append(make_diag(
+                    "FFTA040",
+                    f"input tensor {t.name!r} is produced by"
+                    f" {t.owner_op.name!r}, which is not in the graph", op,
+                    hint="a rewrite removed the producer without rewiring"
+                         " its consumers"))
+    for old_guid, repl in graph.tensor_aliases.items():
+        final = graph.resolve_tensor(repl)
+        if final.owner_op is not None and final.owner_op.guid not in graph.ops:
+            diags.append(make_diag(
+                "FFTA041",
+                f"tensor_aliases[{old_guid}] resolves to {final.name!r}"
+                f" whose producer {final.owner_op.name!r} left the graph",
+                hint="Graph.remove_op drops dangling alias targets; this"
+                     " chain predates the removal"))
+    if ctx.final_guid is not None and ctx.final_guid in graph.ops:
+        live = _ancestors(graph, ctx.final_guid)
+        for guid, op in graph.ops.items():
+            if guid not in live:
+                diags.append(make_diag(
+                    "FFTA042",
+                    "op does not feed the final output (dead subgraph)",
+                    op, hint="remove it or export its output explicitly"))
+    for op in graph.ops.values():
+        if op.op_type in _EW_BINARY and len(op.inputs) >= 2:
+            dtypes = {t.dtype for t in op.inputs}
+            if len(dtypes) > 1:
+                diags.append(make_diag(
+                    "FFTA043",
+                    "elementwise op mixes input dtypes"
+                    f" ({', '.join(sorted(d.value for d in dtypes))}):"
+                    " implicit upcast at the boundary", op,
+                    hint="insert an explicit cast() to pin the compute"
+                         " dtype"))
+    return diags
+
+
+def _ancestors(graph: Graph, guid: int) -> Set[int]:
+    seen = {guid}
+    stack = [guid]
+    while stack:
+        op = graph.ops[stack.pop()]
+        for t in op.inputs:
+            o = t.owner_op
+            if o is not None and o.guid in graph.ops and o.guid not in seen:
+                seen.add(o.guid)
+                stack.append(o.guid)
+    return seen

@@ -1,0 +1,79 @@
+"""Pass pipeline: run the plan sanitizer over (Graph, strategies, machine).
+
+Three call sites (ISSUE 2's three wiring layers):
+ - the Unity search prunes mesh factorizations that fail the per-candidate
+   check (search/unity.py via `factorization_diagnostics` — cheaper still
+   than a CHEAP_PASSES pipeline run, since a factorization is checkable
+   without per-op strategies);
+ - FFModel.compile()/fit() and the elastic re-plan path run ALL_PASSES as a
+   pre-flight gate — errors raise PlanAnalysisError with the diagnostic
+   list, warnings go to the log and the process-wide counters the serving
+   /metrics endpoint exports;
+ - `python -m flexflow_tpu analyze` (analysis/cli.py) loads an exported
+   strategy JSON and prints the report.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence
+
+from ..core.graph import Graph
+from .diagnostics import DiagnosticReport, PlanAnalysisError, record_report
+from .passes import (AnalysisContext, default_strategies_for,
+                     pass_collectives, pass_divisibility, pass_donation,
+                     pass_hygiene, pass_memory_fit)
+
+_log = logging.getLogger("flexflow_tpu.analysis")
+
+PASS_REGISTRY = {
+    "divisibility": pass_divisibility,
+    "memory": pass_memory_fit,
+    "collectives": pass_collectives,
+    "donation": pass_donation,
+    "hygiene": pass_hygiene,
+}
+
+# the machine-model-free subset: a preset for analyze_plan(passes=...)
+# callers that want a quick structural check without a MachineModel
+CHEAP_PASSES = ("divisibility", "collectives", "hygiene")
+ALL_PASSES = tuple(PASS_REGISTRY)
+
+
+def analyze_plan(graph: Graph,
+                 strategies: Optional[Dict[int, object]] = None,
+                 machine=None, config=None,
+                 batch_size: Optional[int] = None,
+                 n_devices: Optional[int] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 final_guid: Optional[int] = None,
+                 passes: Optional[Sequence[str]] = None) -> DiagnosticReport:
+    """Run the pass pipeline; returns the DiagnosticReport (never raises).
+
+    strategies=None with mesh_axes given analyzes the degrees a mesh-wide
+    default assignment would realize (mirroring FFModel._assign_strategy),
+    so a no-search compile is analyzable too."""
+    if strategies is None and mesh_axes:
+        strategies = default_strategies_for(graph, mesh_axes, batch_size)
+    ctx = AnalysisContext(graph=graph, strategies=strategies,
+                          mesh_axes=mesh_axes, machine=machine,
+                          config=config, batch_size=batch_size,
+                          n_devices=n_devices, final_guid=final_guid)
+    names = list(passes) if passes is not None else list(ALL_PASSES)
+    report = DiagnosticReport(passes_run=names)
+    for name in names:
+        report.extend(PASS_REGISTRY[name](ctx))
+    return report
+
+
+def check_plan(graph: Graph, record: bool = True,
+               **kwargs) -> DiagnosticReport:
+    """analyze_plan + the gate semantics: warnings are logged, counters
+    updated, and errors raise PlanAnalysisError carrying the report."""
+    report = analyze_plan(graph, **kwargs)
+    if record:
+        record_report(report)
+    for d in report.warnings():
+        _log.warning("%s", d.format())
+    if report.errors():
+        raise PlanAnalysisError(report)
+    return report

@@ -115,6 +115,15 @@ class FFConfig:
     # (XLA's latency-hiding scheduler does this on TPU); False = collectives
     # serialize onto the compute stream
     search_overlap_backward_update: bool = True
+    # Plan sanitizer (analysis/): the Unity search prunes mesh
+    # factorizations the cheap static passes reject before the cost
+    # simulator prices them; False simulates every divisor tuple (the
+    # unpruned comparison baseline — same chosen strategy, more work)
+    analysis_prune: bool = True
+    # Pre-flight plan analysis at compile()/re-plan time: "error" rejects
+    # plans with error-severity diagnostics (PlanAnalysisError), "warn"
+    # only logs, "off" skips the pipeline
+    plan_analysis: str = "error"
     memory_search: bool = False
     memory_budget_mb: float = 16 * 1024.0  # per-chip HBM budget for memory-aware search
     # per-param optimizer-state factor for the search's memory model
@@ -222,6 +231,14 @@ class FFConfig:
                 self.pipeline_microbatches = int(take())
             elif a == "--search-overlap-backward-update":
                 self.search_overlap_backward_update = True
+            elif a == "--no-analysis-prune":
+                self.analysis_prune = False
+            elif a == "--plan-analysis":
+                v = take()
+                if v not in ("error", "warn", "off"):
+                    raise ValueError(
+                        f"--plan-analysis must be error, warn or off, got {v!r}")
+                self.plan_analysis = v
             elif a == "--memory-search":
                 self.memory_search = True
             elif a == "--measure-op-costs":

@@ -52,6 +52,19 @@ class Graph:
     def remove_op(self, op: Op) -> None:
         del self.ops[op.guid]
         self._topo_cache = None
+        # drop alias chains that now dead-end at this op's outputs: a
+        # substitution that replaces the replacement records a further
+        # alias BEFORE removing the producer, so anything still resolving
+        # to a tensor of the removed op is dangling and resolve_tensor
+        # must not hand it back
+        if self.tensor_aliases:
+            stale = [
+                guid for guid, repl in self.tensor_aliases.items()
+                if (final := self.resolve_tensor(repl)).owner_op is not None
+                and final.owner_op.guid == op.guid
+            ]
+            for guid in stale:
+                del self.tensor_aliases[guid]
 
     def invalidate_topo(self) -> None:
         """Call after rewiring op inputs in place (edge changes the

@@ -40,8 +40,9 @@ import numpy as np
 
 from ..runtime.checkpoint import restore_checkpoint, save_checkpoint
 from .detector import FailureDetector
-from .events import (CHECKPOINT, RECOVERY_DONE, RECOVERY_RESTORE,
-                     RECOVERY_SEARCH, RECOVERY_START, EventLog)
+from .events import (CHECKPOINT, PLAN_ANALYSIS, RECOVERY_DONE,
+                     RECOVERY_RESTORE, RECOVERY_SEARCH, RECOVERY_START,
+                     EventLog)
 from .faults import FaultInjector, FaultPlan, TopologyLoss
 from .retry import RetryPolicy
 
@@ -208,6 +209,16 @@ class ElasticCoordinator:
             RECOVERY_SEARCH, step=self.detector.current_step,
             n_devices=len(survivors), axes=dict(model.parallel_axes),
             cost_us=(sr.cost_us if sr is not None else None))
+        # plan-sanitizer verdict on the re-planned model for the RECOVERY
+        # event stream: reuse compile()'s gate run when it happened, run
+        # the pipeline fresh only when the gate was off
+        report = getattr(model, "_analysis_report", None)
+        if report is None:
+            report = model.analyze_plan()
+        self.events.record(
+            PLAN_ANALYSIS, step=self.detector.current_step,
+            errors=len(report.errors()), warnings=len(report.warnings()),
+            counts=report.counts())
         # 3. restore the latest checkpoint into the new model, resharded
         if self._last_ckpt is None:
             raise RecoveryFailed("no checkpoint to restore from") from exc

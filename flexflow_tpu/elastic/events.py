@@ -28,6 +28,8 @@ RECOVERY_START = "recovery.start"
 RECOVERY_SEARCH = "recovery.search"
 RECOVERY_RESTORE = "recovery.restore"
 RECOVERY_DONE = "recovery.done"
+# plan-sanitizer verdict on a re-planned model (analysis/pipeline.py)
+PLAN_ANALYSIS = "analysis.plan"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -8,6 +8,7 @@ whole training iteration with XLA; `fit()` mirrors flexflow_cffi.py:2062.
 """
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -33,6 +34,8 @@ from .runtime.executor import Executor
 from .runtime.losses import Loss
 from .runtime.metrics import Metrics, PerfMetrics
 from .runtime.optimizers import Optimizer, SGDOptimizer
+
+_log = logging.getLogger("flexflow_tpu.model")
 
 
 class FFModel:
@@ -594,29 +597,11 @@ class FFModel:
         self._op_strategies = None
         if parallel_axes is None:
             if self.config.import_strategy_file:
-                from .search.substitution import (
-                    apply_substitutions,
-                    load_rule_spec,
-                    rule_set_from_spec,
-                    search_rules_from_spec,
-                )
-                from .search.unity import import_strategy
+                from .search.unity import rewrite_and_import_strategy
 
-                # the exporting search ran the greedy rewrite pass before
-                # choosing strategies, so op names in the file refer to the
-                # REWRITTEN graph (e.g. fuse_parallel_ops' merged names) —
-                # re-run the same deterministic pass before matching names.
-                # Trade-off (search-rule) rewrites the exporting search
-                # materialized are recorded in the file and replayed by
-                # import_strategy via the rules registry.
-                spec, is_taso = load_rule_spec(
-                    self.config.substitution_json_path)
-                apply_substitutions(self.graph,
-                                    rule_set_from_spec(spec, is_taso))
-                strategies, axes = import_strategy(
-                    self.graph, self.config.import_strategy_file,
-                    rules=search_rules_from_spec(spec, is_taso),
-                )
+                strategies, axes = rewrite_and_import_strategy(
+                    self.graph, self.config,
+                    self.config.import_strategy_file)
                 self._op_strategies = strategies
                 parallel_axes = axes
             elif (
@@ -660,6 +645,12 @@ class FFModel:
         self.ops = list(self.graph.topo_order())
         self.parallel_axes = dict(parallel_axes)
         self._assign_strategy(self.parallel_axes)
+
+        # pre-flight plan sanitizer (analysis/): statically prove the chosen
+        # plan legal before any XLA trace sees it — errors reject the plan,
+        # warnings go to the analysis event log (profiling.print_event_log)
+        # and the process-wide counters the serving /metrics endpoint exports
+        self._run_plan_analysis()
 
         # explicit device subset (elastic: compile onto the survivors of a
         # chip loss rather than jax.devices()'s prefix)
@@ -776,6 +767,61 @@ class FFModel:
         # per-seq_length jits were lowered from the old graph
         if getattr(self, "_manual", None):
             self._manual.pop("seq_fns", None)
+
+    def analyze_plan(self, passes=None):
+        """Run the plan sanitizer (analysis/) over this model's PCG + chosen
+        strategies + machine spec; returns the DiagnosticReport (never
+        raises). Usable mid-compile and after compile()."""
+        from .analysis import analyze_plan as _analyze
+        from .search.machine_model import make_machine_model
+
+        n_dev = self.config.total_devices
+        final = (self.graph.resolve_tensor(self.final_tensor)
+                 if self.final_tensor is not None else None)
+        final_guid = (final.owner_op.guid
+                      if final is not None and final.owner_op is not None
+                      and final.owner_op.guid in self.graph.ops else None)
+        return _analyze(
+            self.graph,
+            strategies=self._op_strategies,
+            machine=make_machine_model(self.config, n_dev),
+            config=self.config,
+            batch_size=self.config.batch_size,
+            n_devices=n_dev,
+            mesh_axes=getattr(self, "parallel_axes", None),
+            final_guid=final_guid,
+            passes=passes,
+        )
+
+    def _run_plan_analysis(self) -> None:
+        """The compile()/re-plan pre-flight gate: plan_analysis="error"
+        raises PlanAnalysisError on error diagnostics, "warn" only records,
+        "off" skips. Every diagnostic lands in self.analysis_events (an
+        elastic-style EventLog profiling.print_event_log renders) and the
+        process-wide per-code counters."""
+        mode = getattr(self.config, "plan_analysis", "error")
+        if mode == "off":
+            return
+        from .analysis import PlanAnalysisError, record_report
+        from .elastic.events import EventLog
+
+        report = self.analyze_plan()
+        # stashed so post-compile consumers (the elastic coordinator's
+        # recovery event) reuse this run instead of re-running the pipeline
+        self._analysis_report = report
+        record_report(report)
+        if not hasattr(self, "analysis_events"):
+            self.analysis_events = EventLog()
+        for d in report.diagnostics:
+            self.analysis_events.record(
+                f"analysis.{d.severity.value}", code=d.code,
+                op=d.op_name, message=d.message)
+        for d in report.warnings():
+            _log.warning("plan analysis: %s", d.format())
+        for d in report.errors():
+            _log.error("plan analysis: %s", d.format())
+        if report.errors() and mode == "error":
+            raise PlanAnalysisError(report)
 
     def _export_task_graph(self, path: str) -> None:
         """Cost-annotated task-graph dot (reference: --export-strategy-

@@ -28,12 +28,12 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-_log = logging.getLogger("flexflow_tpu.search")
-
 from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
 from .machine_model import MachineModel
+
+_log = logging.getLogger("flexflow_tpu.search")
 
 
 @dataclasses.dataclass(frozen=True)

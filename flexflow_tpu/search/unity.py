@@ -29,14 +29,14 @@ import json
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
-_log = logging.getLogger("flexflow_tpu.search")
-
 from ..core.graph import Graph
 from ..core.op import Op
 from ..ffconst import OpType
 from .machine_model import MachineModel
 from .simulator import (AP_CAPABLE, OpStrategy, Simulator, TP_CAPABLE,
                         attn_sp_ulysses)
+
+_log = logging.getLogger("flexflow_tpu.search")
 
 
 def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
@@ -155,7 +155,7 @@ def make_sp_feasible(graph: Graph, config):
         return None
 
     def sp_feasible(sp: int) -> bool:
-        return (all(l % sp == 0 for l in attn_seq_lens)
+        return (all(seq_len % sp == 0 for seq_len in attn_seq_lens)
                 and all(h % sp == 0 for h in sp_head_caps))
 
     return sp_feasible
@@ -215,6 +215,11 @@ class SearchResult:
     applied_rewrites: List[Tuple[str, str]] = dataclasses.field(
         default_factory=list)
     greedy_search_rules: bool = False
+    # plan-sanitizer pruning accounting (analysis/passes.py): mesh
+    # factorizations the cost simulator priced vs ones the cheap static
+    # passes rejected first
+    candidates_simulated: int = 0
+    candidates_pruned: int = 0
 
 
 class GraphSearchHelper:
@@ -231,6 +236,9 @@ class GraphSearchHelper:
         # per-op-type TP degrees a loaded TASO rule file proposes
         # (None = no file: every type may TP at any mesh degree)
         self._tp_menu = None
+        # plan-sanitizer pruning accounting (totals across probes/segments)
+        self.candidates_simulated = 0
+        self.candidates_pruned = 0
 
     def _load_tp_candidates(self, spec, parsed=None) -> None:
         """Distill a parsed TASO RuleCollection (--substitution-json) into
@@ -412,6 +420,11 @@ class GraphSearchHelper:
         best.log = self.log
         if getattr(self, "_greedy_search_rules_ran", False):
             best.greedy_search_rules = True
+        best.candidates_simulated = self.candidates_simulated
+        best.candidates_pruned = self.candidates_pruned
+        self.log.append(
+            f"plan sanitizer: {self.candidates_simulated} factorization(s) "
+            f"simulated, {self.candidates_pruned} pruned before costing")
         return best
 
     def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
@@ -421,31 +434,48 @@ class GraphSearchHelper:
         each (reference: Graph::optimal_cost via the DP in graph.cc:1586;
         lam is the lambda of the memory-aware search, graph.cc:2075)."""
         candidates: List[SearchResult] = []
-        # extra axes only enumerated when usable: 'expert' when the graph has
-        # EXPERTS ops (ep must divide every expert count), 'attr' when
-        # --enable-attribute-parallel and the graph has spatial ops
+        # plan-sanitizer pruning (analysis/passes.py): the cheap
+        # factorization pass rejects infeasible mesh tuples — non-dividing
+        # degrees, unusable axes — before the cost simulator prices them.
+        # analysis_prune=False simulates every divisor tuple instead (the
+        # unpruned baseline tests compare against): dp/tp/ep/ap degrade to
+        # replicated per op inside valid_strategies, and sp — the one axis
+        # whose graph-level blockers (SP disabled, dropout-carrying
+        # attention, ulysses heads) sp_shardable cannot see — is clamped to
+        # 1 here, so both modes can only realize legal degrees. Pruning is
+        # accounted in the SearchResult counters, not the process-wide
+        # diagnostic counters — those mean "a plan was rejected", and
+        # skipping a candidate the search never chose is not a rejection.
+        from ..analysis import factorization_diagnostics
+
+        sp_feasible = make_sp_feasible(graph, self.config)
+        prune = getattr(self.config, "analysis_prune", True)
         expert_counts = {op.params["n"] for op in graph.ops.values()
                          if op.op_type == OpType.EXPERTS}
-        has_spatial = (self.config.enable_attribute_parallel
-                       and any(op.op_type in AP_CAPABLE
-                               for op in graph.ops.values()))
-        sp_feasible = make_sp_feasible(graph, self.config)
-        sp_enabled = sp_feasible is not None
-        tuples = []
-        for dp, rest in _divisor_pairs(n_devices):
-            for tp, rest2 in _divisor_pairs(rest):
-                for ep, rest3 in _divisor_pairs(rest2):
-                    for ap, sp in _divisor_pairs(rest3):
-                        if ep > 1 and not (expert_counts and all(
-                                n % ep == 0 for n in expert_counts)):
-                            continue
-                        if ap > 1 and not has_spatial:
-                            continue
-                        if sp > 1 and not (sp_enabled and sp_feasible(sp)):
-                            continue
-                        tuples.append((dp, tp, ep, ap, sp))
+        has_spatial = any(op.op_type in AP_CAPABLE
+                          for op in graph.ops.values())
+        tuples = [
+            (dp, tp, ep, ap, sp)
+            for dp, rest in _divisor_pairs(n_devices)
+            for tp, rest2 in _divisor_pairs(rest)
+            for ep, rest3 in _divisor_pairs(rest2)
+            for ap, sp in _divisor_pairs(rest3)
+        ]
         if self.config.only_data_parallel:
             tuples = [(n_devices, 1, 1, 1, 1)]
+        feasible = []
+        for fact in tuples:
+            if prune:
+                if factorization_diagnostics(graph, self.config, batch_size,
+                                             fact, sp_pred=sp_feasible,
+                                             expert_counts=expert_counts,
+                                             has_spatial=has_spatial):
+                    self.candidates_pruned += 1
+                    continue
+            elif fact[4] > 1 and (sp_feasible is None
+                                  or not sp_feasible(fact[4])):
+                fact = fact[:4] + (1,)
+            feasible.append(fact)
         # Stage 1 (cheap): per-segment DP + one full-graph simulate per mesh
         # factorization. Stage 2 (expensive): the cross-segment best-first
         # refinement — O(budget x boundary-ops x menu x simulate) — runs
@@ -455,9 +485,8 @@ class GraphSearchHelper:
         # (reference analog: graph.cc's memoized DP exists precisely to
         # keep the 100+-op x many-machine-view regime tractable).
         seeded = []
-        for dp, tp, ep, ap, sp in tuples:
-            if batch_size % dp != 0:
-                continue
+        for dp, tp, ep, ap, sp in feasible:
+            self.candidates_simulated += 1
             strategies: Dict[int, OpStrategy] = {}
             for seg in self._segments(graph):
                 strategies.update(
@@ -891,6 +920,25 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                                  rule_spec=(spec, is_taso, taso_rules))
 
 
+def rewrite_and_import_strategy(graph: Graph, config, path: str):
+    """compile()'s --import preamble, shared with the analyze CLI so the
+    two paths cannot drift: the exporting search ran the greedy rewrite
+    pass before choosing strategies, so op names in the file refer to the
+    REWRITTEN graph (e.g. fuse_parallel_ops' merged names) — re-run the
+    same deterministic pass before matching names. Trade-off (search-rule)
+    rewrites the exporting search materialized are recorded in the file
+    and replayed by import_strategy via the rules registry. Returns
+    (strategies, mesh_axes); raises PlanAnalysisError on a malformed
+    file."""
+    from .substitution import (apply_substitutions, load_rule_spec,
+                               rule_set_from_spec, search_rules_from_spec)
+
+    spec, is_taso = load_rule_spec(config.substitution_json_path)
+    apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
+    return import_strategy(graph, path,
+                           rules=search_rules_from_spec(spec, is_taso))
+
+
 def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
     """Serialize the chosen strategy (reference: --export, model.cc:3609)."""
     data = {
@@ -948,19 +996,53 @@ def import_strategy(graph: Graph, path: str,
                     "applying the first; the exported strategy may refer "
                     "to a different one", rule_name, desc, len(hits))
             hits[0].apply()
+    # validate with the plan sanitizer's diagnostics instead of failing
+    # deep inside with a KeyError on a malformed/mismatched entry
+    from ..analysis.diagnostics import (DiagnosticReport, PlanAnalysisError,
+                                        make_diag, record_report)
+
+    diags = []
+    ops_entry = data.get("ops")
+    if not isinstance(ops_entry, dict):
+        diags.append(make_diag(
+            "FFTA050", f"strategy file {path!r} has no 'ops' mapping",
+            hint="re-export with export_strategy"))
+        ops_entry = {}
+    axes = data.get("mesh_axes", {})
+    if not (isinstance(axes, dict)
+            and all(isinstance(v, int) and v >= 1 for v in axes.values())):
+        diags.append(make_diag(
+            "FFTA050", f"mesh_axes {axes!r} is not a name->degree mapping"))
+        axes = {}
     by_name = {op.name: op for op in graph.ops.values()}
     strategies = {}
-    unmatched = []
-    for name, s in data["ops"].items():
-        if name in by_name:
-            strategies[by_name[name].guid] = OpStrategy(
-                dp=s["dp"], tp=s["tp"], ep=s.get("ep", 1), ap=s.get("ap", 1),
-                sp=s.get("sp", 1), tp_row=s.get("tp_row", False))
-        else:
-            unmatched.append(name)
-    if unmatched:
-        _log.warning(
-            "import_strategy: %d op entries have no matching op in the "
-            "graph (they fall back to the default strategy): %s",
-            len(unmatched), unmatched[:8])
-    return strategies, data.get("mesh_axes", {})
+    for name, s in ops_entry.items():
+        if not isinstance(s, dict):
+            diags.append(make_diag(
+                "FFTA050", f"op entry {name!r} is not a strategy object"))
+            continue
+        degrees = {f: s.get(f, 1) for f in ("dp", "tp", "ep", "ap", "sp")}
+        bad = {f: v for f, v in degrees.items()
+               if not isinstance(v, int) or v < 1}
+        if bad:
+            diags.append(make_diag(
+                "FFTA050",
+                f"op entry {name!r} has non-positive-integer degree(s)"
+                f" {bad}", hint="degrees are ints >= 1"))
+            continue
+        if name not in by_name:
+            diags.append(make_diag(
+                "FFTA051",
+                f"strategy entry {name!r} matches no op in the graph; it"
+                " falls back to the default strategy",
+                hint="the exporting graph was rewritten differently"))
+            continue
+        strategies[by_name[name].guid] = OpStrategy(
+            tp_row=bool(s.get("tp_row", False)), **degrees)
+    report = DiagnosticReport(diags, passes_run=("strategy-file",))
+    record_report(report)
+    for d in report.warnings():
+        _log.warning("%s", d.format())
+    if report.errors():
+        raise PlanAnalysisError(report)
+    return strategies, axes

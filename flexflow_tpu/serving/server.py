@@ -162,7 +162,19 @@ class InferenceServer:
         out = {n: m.stats() for n, m in sorted(self._metrics.items())}
         if self._elastic_events is not None:
             out["_elastic"] = self._elastic_events.counts()
+        analysis = self._analysis_counters()
+        if analysis:
+            out["_analysis"] = analysis
         return out
+
+    @staticmethod
+    def _analysis_counters():
+        """Plan-sanitizer per-code counters (analysis/diagnostics.py):
+        process-wide, so every compile/search/import in this process
+        shows."""
+        from ..analysis import diagnostic_counters
+
+        return diagnostic_counters()
 
     def prometheus_text(self) -> str:
         """Prometheus exposition-format metrics (the Triton /metrics role)."""
@@ -183,6 +195,12 @@ class InferenceServer:
         out = "\n".join(lines) + "\n"
         if self._elastic_events is not None:
             out += self._elastic_events.prometheus_text()
+        analysis = self._analysis_counters()
+        if analysis:
+            out += "# TYPE ff_plan_diagnostics_total counter\n"
+            for code, n in sorted(analysis.items()):
+                out += (f'ff_plan_diagnostics_total{{code="{esc(code)}"}}'
+                        f" {n}\n")
         return out
 
     def shutdown(self):

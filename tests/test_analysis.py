@@ -1,0 +1,530 @@
+"""Plan sanitizer tests (ISSUE 2): a table of known-bad PCGs each
+asserting its exact FFTA0xx diagnostic code, one case per pass family;
+every example/zoo model's searched plan passing the analyzer clean; the
+compile()-time pre-flight gate; import_strategy validation; and the
+serving /metrics analyzer counters."""
+import json
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.analysis import (
+    PlanAnalysisError,
+    Severity,
+    analyze_plan,
+    check_plan,
+    diagnostic_counters,
+    factorization_diagnostics,
+    reset_counters,
+)
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.search.machine_model import (
+    ChipSpec,
+    SimpleMachineModel,
+    make_machine_model,
+)
+from flexflow_tpu.search.simulator import CostModel, OpStrategy
+from flexflow_tpu.search.unity import import_strategy, unity_optimize
+
+
+def build_mlp(batch=64, din=512, hidden=2048, classes=10):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    m = ff.FFModel(config)
+    inp = m.create_tensor([batch, din])
+    t = m.dense(inp, hidden, ff.ActiMode.AC_MODE_RELU)
+    t = m.dense(t, classes)
+    m.softmax(t)
+    return m, Graph(m.ops), config
+
+
+def op_named(graph, fragment):
+    return next(op for op in graph.ops.values() if fragment in op.name)
+
+
+# ---------------------------------------------------------------------
+# pass family 1: divisibility / degree
+# ---------------------------------------------------------------------
+def test_non_dividing_dp_degree_ffta001():
+    _, g, config = build_mlp(batch=64)
+    dense = op_named(g, "linear")
+    report = analyze_plan(
+        g, strategies={dense.guid: OpStrategy(dp=7)},
+        mesh_axes={"data": 7}, batch_size=64, n_devices=8, config=config)
+    assert [d.code for d in report.errors()] == ["FFTA001"]
+    assert report.by_code("FFTA001")[0].op_name == dense.name
+
+
+def test_non_dividing_tp_degree_ffta001():
+    _, g, config = build_mlp(hidden=2048)
+    dense = op_named(g, "linear")
+    report = analyze_plan(
+        g, strategies={dense.guid: OpStrategy(tp=3)},
+        mesh_axes={"model": 3}, batch_size=64, n_devices=8, config=config)
+    assert "FFTA001" in report.counts()
+
+
+def test_degree_exceeds_devices_ffta003():
+    _, g, config = build_mlp()
+    dense = op_named(g, "linear")
+    report = analyze_plan(
+        g, strategies={dense.guid: OpStrategy(dp=8, tp=2)},
+        mesh_axes={"data": 8, "model": 2}, batch_size=64, n_devices=8,
+        config=config)
+    assert "FFTA003" in report.counts()
+    assert not report.ok
+
+
+def test_degraded_degree_is_warning_ffta002():
+    # tp on a non-tensor-parallel op degrades to replicated: suspicious
+    # (the cost model over-promised) but legal
+    _, g, config = build_mlp()
+    sm = op_named(g, "softmax")
+    report = analyze_plan(
+        g, strategies={sm.guid: OpStrategy(tp=2)}, mesh_axes={"model": 2},
+        batch_size=64, n_devices=8, config=config)
+    assert [d.code for d in report.warnings()] == ["FFTA002"]
+    assert report.ok
+
+
+def test_factorization_diagnostics_ffta001_ffta004():
+    _, g, config = build_mlp(batch=64)
+    assert [d.code for d in
+            factorization_diagnostics(g, config, 64, (3, 1, 1, 1, 1))] \
+        == ["FFTA001"]
+    # no EXPERTS ops: the expert axis is unusable, not a divisibility issue
+    assert [d.code for d in
+            factorization_diagnostics(g, config, 64, (1, 1, 2, 1, 1))] \
+        == ["FFTA004"]
+    # EXPERTS present but ep does not divide the expert count
+    m = ff.FFModel(config)
+    x = m.create_tensor([64, 32])
+    gate = m.softmax(m.dense(x, 3))
+    m.experts(x, gate, gate, num_exp=3, out_dim=32)
+    ge = Graph(m.ops)
+    assert [d.code for d in
+            factorization_diagnostics(ge, config, 64, (1, 1, 2, 1, 1))] \
+        == ["FFTA001"]
+    # attribute/sequence parallelism unusable by this graph/config
+    assert [d.code for d in
+            factorization_diagnostics(g, config, 64, (1, 1, 1, 2, 1))] \
+        == ["FFTA004"]
+    assert [d.code for d in
+            factorization_diagnostics(g, config, 64, (1, 1, 1, 1, 2))] \
+        == ["FFTA004"]
+    assert factorization_diagnostics(g, config, 64, (2, 2, 1, 1, 1)) == []
+
+
+# ---------------------------------------------------------------------
+# pass family 2: memory fit
+# ---------------------------------------------------------------------
+def test_hbm_overflow_ffta010():
+    _, g, config = build_mlp(hidden=4096)
+    machine = SimpleMachineModel(4, ChipSpec(hbm_gb=1e-4))  # 100 KB "HBM"
+    report = analyze_plan(g, strategies={}, machine=machine,
+                          batch_size=64, n_devices=4, config=config)
+    assert [d.code for d in report.errors()] == ["FFTA010"]
+
+
+def test_explicit_memory_budget_overrides_chip_spec():
+    # an explicitly set --memory-budget is authoritative (the gate must
+    # agree with the memory-aware search): raising it past the estimate
+    # clears the overflow even on a tiny chip spec
+    _, g, config = build_mlp(hidden=4096)
+    config.memory_budget_mb = 64 * 1024.0
+    machine = SimpleMachineModel(4, ChipSpec(hbm_gb=1e-4))
+    report = analyze_plan(g, strategies={}, machine=machine,
+                          batch_size=64, n_devices=4, config=config)
+    assert not report.by_code("FFTA010")
+
+
+def test_hbm_overflow_under_stage_sharding_warns():
+    # the per-op sum cannot see GPipe 'stage' weight sharding: overflow on
+    # a pipeline plan degrades to a warning instead of rejecting a plan the
+    # memory-aware search chose precisely to fit
+    _, g, config = build_mlp(hidden=4096)
+    machine = SimpleMachineModel(4, ChipSpec(hbm_gb=1e-4))
+    report = analyze_plan(g, strategies={}, machine=machine,
+                          mesh_axes={"data": 2, "stage": 2},
+                          batch_size=64, n_devices=4, config=config)
+    diags = report.by_code("FFTA010")
+    assert len(diags) == 1 and diags[0].severity is Severity.WARNING
+    assert report.ok
+
+
+def test_hbm_near_capacity_warns_ffta011():
+    _, g, config = build_mlp()
+    probe = CostModel(SimpleMachineModel(4, ChipSpec()), config)
+    total = sum(probe.op_memory_bytes(op, OpStrategy())
+                for op in g.ops.values())
+    machine = SimpleMachineModel(4, ChipSpec(hbm_gb=total / 0.9 / 1e9))
+    report = analyze_plan(g, strategies={}, machine=machine,
+                          batch_size=64, n_devices=4, config=config)
+    assert [d.code for d in report.warnings()] == ["FFTA011"]
+    assert report.ok
+
+
+# ---------------------------------------------------------------------
+# pass family 3: collective legality
+# ---------------------------------------------------------------------
+def test_mismatched_reduction_edge_ffta020():
+    # row-parallel (reduction) strategy on a non-LINEAR op: the partial-sum
+    # all-reduce pairing has no meaning there
+    _, g, config = build_mlp()
+    sm = op_named(g, "softmax")
+    report = analyze_plan(
+        g, strategies={sm.guid: OpStrategy(tp=2, tp_row=True)},
+        mesh_axes={"model": 2}, batch_size=64, n_devices=8, config=config)
+    assert "FFTA020" in [d.code for d in report.errors()]
+
+
+def test_row_parallel_non_dividing_ffta020():
+    _, g, config = build_mlp(din=510)  # 510 % 4 != 0
+    dense = op_named(g, "linear")
+    report = analyze_plan(
+        g, strategies={dense.guid: OpStrategy(tp=4, tp_row=True)},
+        mesh_axes={"model": 4}, batch_size=64, n_devices=8, config=config)
+    assert "FFTA020" in [d.code for d in report.errors()]
+
+
+def test_mesh_axis_conflict_ffta021():
+    _, g, config = build_mlp()
+    d1, d2 = [op for op in g.topo_order() if "linear" in op.name]
+    report = analyze_plan(
+        g, strategies={d1.guid: OpStrategy(dp=2), d2.guid: OpStrategy(dp=4)},
+        mesh_axes={"data": 4}, batch_size=64, n_devices=8, config=config)
+    assert "FFTA021" in [d.code for d in report.errors()]
+
+
+def test_mesh_needs_more_devices_ffta023():
+    _, g, config = build_mlp()
+    report = analyze_plan(g, strategies={}, mesh_axes={"data": 4, "model": 4},
+                          batch_size=64, n_devices=8, config=config)
+    assert [d.code for d in report.errors()] == ["FFTA023"]
+
+
+def test_reshard_ping_pong_warns_ffta022():
+    # dp dips to 1 between a finer-sharded producer and consumer: gather
+    # followed by re-partition on the same chain
+    _, g, config = build_mlp()
+    ops = g.topo_order()
+    inp, d1, d2, sm = ops
+    report = analyze_plan(
+        g, strategies={inp.guid: OpStrategy(dp=4), d1.guid: OpStrategy(dp=4),
+                       d2.guid: OpStrategy(dp=1), sm.guid: OpStrategy(dp=4)},
+        mesh_axes={"data": 4}, batch_size=64, n_devices=8, config=config)
+    assert "FFTA022" in [d.code for d in report.warnings()]
+
+
+# ---------------------------------------------------------------------
+# pass family 4: aliasing / donation under the elastic runtime
+# ---------------------------------------------------------------------
+def test_donation_under_elastic_warns_ffta030():
+    _, g, config = build_mlp()
+    config.elastic_step_wrapper = lambda fn: fn
+    report = analyze_plan(g, strategies={}, batch_size=64, n_devices=8,
+                          config=config)
+    diags = report.by_code("FFTA030")
+    assert len(diags) == 1 and diags[0].severity is Severity.WARNING
+    assert report.ok  # warning, not rejection
+
+
+def test_default_strategies_mirror_attention_dropout_sp_guard():
+    # _assign_strategy leaves a dropout-carrying attention op unsharded
+    # under a 'seq' axis (the SP kernels have no attention-prob dropout);
+    # default_strategies_for must model the same plan, or the memory pass
+    # sizes that op's activations divided by sp and misses real overflow
+    from flexflow_tpu.analysis import default_strategies_for
+
+    config = ff.FFConfig()
+    config.batch_size = 2
+    m = ff.FFModel(config)
+    t = m.create_tensor([2, 32, 64])
+    attn = m.multihead_attention(t, t, t, 64, 4, dropout=0.1)
+    m.softmax(m.dense(attn, 4))
+    g = Graph(m.ops)
+    strategies = default_strategies_for(g, {"seq": 2}, batch_size=2)
+    attn_op = op_named(g, "multihead_attention")
+    dense_op = op_named(g, "linear")
+    assert strategies[attn_op.guid].sp == 1  # dropout: stays unsharded
+    assert strategies[dense_op.guid].sp == 2  # plain position dim shards
+
+
+def test_no_donation_warning_without_elastic():
+    _, g, config = build_mlp()
+    report = analyze_plan(g, strategies={}, batch_size=64, n_devices=8,
+                          config=config)
+    assert not report.by_code("FFTA030")
+
+
+# ---------------------------------------------------------------------
+# pass family 5: graph hygiene
+# ---------------------------------------------------------------------
+def test_dangling_producer_ffta040():
+    _, g, config = build_mlp()
+    d1 = op_named(g, "linear")
+    del g.ops[d1.guid]  # bypass remove_op: simulate a buggy rewrite
+    report = analyze_plan(g, strategies={}, config=config)
+    assert "FFTA040" in [d.code for d in report.errors()]
+
+
+def test_stale_alias_chain_ffta041():
+    _, g, config = build_mlp()
+    sm = op_named(g, "softmax")
+    del g.ops[sm.guid]  # bypass remove_op's alias cleanup
+    g.tensor_aliases[999999] = sm.outputs[0]
+    report = analyze_plan(g, strategies={}, config=config)
+    assert "FFTA041" in [d.code for d in report.warnings()]
+
+
+def test_unreachable_op_ffta042():
+    _, g, config = build_mlp()
+    ops = g.topo_order()
+    d2 = ops[-2]  # treat the second dense as the final output
+    report = analyze_plan(g, strategies={}, config=config,
+                          final_guid=d2.guid)
+    assert [d.op_name for d in report.by_code("FFTA042")] == [ops[-1].name]
+
+
+def test_mixed_dtype_elementwise_ffta043():
+    config = ff.FFConfig()
+    config.batch_size = 8
+    m = ff.FFModel(config)
+    x = m.create_tensor([8, 16])
+    y = m.create_tensor([8, 16])
+    m.add(x, y)
+    g = Graph(m.ops)
+    y.dtype = DataType.DT_HALF  # boundary dtype mismatch
+    report = analyze_plan(g, strategies={}, config=config)
+    assert "FFTA043" in [d.code for d in report.warnings()]
+
+
+def test_remove_op_drops_dangling_aliases():
+    # satellite: Graph.remove_op must drop alias chains that dead-end at
+    # the removed op's outputs, so resolve_tensor can't hand them back
+    _, g, _ = build_mlp()
+    inp, d1, d2, sm = g.topo_order()
+    g.tensor_aliases[d1.outputs[0].guid] = d2.outputs[0]
+    g.remove_op(sm)  # d2's alias target survives: d2 still in graph
+    assert d1.outputs[0].guid in g.tensor_aliases
+    g.remove_op(d2)  # now the chain dead-ends: both entries must go
+    assert d1.outputs[0].guid not in g.tensor_aliases
+    t = g.resolve_tensor(d1.outputs[0])
+    assert t is d1.outputs[0]
+
+
+def test_remove_op_keeps_repaired_chains():
+    _, g, _ = build_mlp()
+    inp, d1, d2, sm = g.topo_order()
+    # chain d1.out -> d2.out -> sm.out: removing d2 keeps both entries
+    # because they resolve through to sm, which is still in the graph
+    g.tensor_aliases[d1.outputs[0].guid] = d2.outputs[0]
+    g.tensor_aliases[d2.outputs[0].guid] = sm.outputs[0]
+    g.remove_op(d2)
+    assert g.resolve_tensor(d1.outputs[0]) is sm.outputs[0]
+
+
+# ---------------------------------------------------------------------
+# strategy-file validation (import_strategy)
+# ---------------------------------------------------------------------
+def test_import_strategy_malformed_entry_ffta050(tmp_path):
+    _, g, _ = build_mlp()
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({
+        "mesh_axes": {"data": 2},
+        "ops": {op_named(g, "linear").name: {"dp": 0, "tp": "x"}},
+    }))
+    with pytest.raises(PlanAnalysisError) as exc:
+        import_strategy(g, str(path))
+    assert [d.code for d in exc.value.report.errors()] == ["FFTA050"]
+
+
+def test_import_strategy_no_ops_mapping_ffta050(tmp_path):
+    _, g, _ = build_mlp()
+    path = tmp_path / "empty.json"
+    path.write_text(json.dumps({"mesh_axes": {}}))
+    with pytest.raises(PlanAnalysisError) as exc:
+        import_strategy(g, str(path))
+    assert "FFTA050" in [d.code for d in exc.value.report.errors()]
+
+
+def test_import_strategy_unmatched_name_ffta051(tmp_path):
+    reset_counters()
+    _, g, _ = build_mlp()
+    path = tmp_path / "unmatched.json"
+    path.write_text(json.dumps({
+        "mesh_axes": {"data": 2},
+        "ops": {"no_such_op": {"dp": 2, "tp": 1}},
+    }))
+    strategies, axes = import_strategy(g, str(path))  # warns, no raise
+    assert strategies == {} and axes == {"data": 2}
+    assert diagnostic_counters().get("FFTA051") == 1
+
+
+# ---------------------------------------------------------------------
+# compile()-time pre-flight gate
+# ---------------------------------------------------------------------
+def _tiny_model(plan_analysis="error", **cfg_overrides):
+    config = ff.FFConfig()
+    config.batch_size = 8
+    config.num_devices = 1
+    config.plan_analysis = plan_analysis
+    for k, v in cfg_overrides.items():
+        setattr(config, k, v)
+    m = ff.FFModel(config)
+    inp = m.create_tensor([8, 16])
+    t = m.dense(inp, 8)
+    m.softmax(t)
+    return m
+
+
+def test_compile_gate_passes_clean_model():
+    m = _tiny_model()
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01))
+    assert m.analyze_plan().ok
+
+
+def test_compile_gate_rejects_oversized_mesh():
+    m = _tiny_model()
+    with pytest.raises(PlanAnalysisError) as exc:
+        m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+                  parallel_axes={"data": 128})
+    assert "FFTA023" in [d.code for d in exc.value.report.errors()]
+
+
+def test_compile_gate_warn_mode_does_not_raise():
+    m = _tiny_model(plan_analysis="warn",
+                    elastic_step_wrapper=lambda fn: fn)
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01))
+    kinds = [e.kind for e in m.analysis_events.events()]
+    assert "analysis.warning" in kinds  # FFTA030 landed in the event log
+
+
+def test_compile_gate_warn_mode_logs_errors(caplog):
+    # warn mode must not swallow error-severity diagnostics: they skip the
+    # raise but still reach the log (and the event log/counters)
+    import logging
+
+    m = _tiny_model(plan_analysis="warn")
+    with caplog.at_level(logging.ERROR, logger="flexflow_tpu.model"):
+        # the gate lets the plan through (warn), so compile still dies
+        # later in mesh construction — but only after logging the errors
+        with pytest.raises(ValueError, match="mesh needs"):
+            m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+                      parallel_axes={"data": 128})
+    assert any("FFTA023" in r.getMessage() for r in caplog.records)
+
+
+def test_compile_gate_off_mode_skips():
+    # gate off: the illegal mesh sails past the sanitizer and dies later,
+    # deep in mesh construction — exactly the late opaque error the
+    # pre-flight gate exists to replace
+    m = _tiny_model(plan_analysis="off")
+    with pytest.raises(ValueError, match="mesh needs"):
+        m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+                  parallel_axes={"data": 128})
+    assert not hasattr(m, "analysis_events")
+
+
+# ---------------------------------------------------------------------
+# serving /metrics analyzer counters
+# ---------------------------------------------------------------------
+def test_metrics_export_analyzer_counters():
+    from flexflow_tpu.serving.server import InferenceServer
+
+    reset_counters()
+    _, g, config = build_mlp()
+    with pytest.raises(PlanAnalysisError):
+        check_plan(g, mesh_axes={"data": 4, "model": 4}, batch_size=64,
+                   n_devices=8, config=config, strategies={})
+    server = InferenceServer()
+    text = server.prometheus_text()
+    assert 'ff_plan_diagnostics_total{code="FFTA023"} 1' in text
+    assert server.stats()["_analysis"]["FFTA023"] == 1
+    reset_counters()
+    assert "ff_plan_diagnostics_total" not in server.prometheus_text()
+
+
+# ---------------------------------------------------------------------
+# the analyze CLI
+# ---------------------------------------------------------------------
+def test_analyze_cli_clean(capsys):
+    from flexflow_tpu.analysis.cli import run_analyze
+
+    assert run_analyze(["--model", "mnist_mlp", "--chips", "4"]) == 0
+    assert "plan OK" in capsys.readouterr().out
+
+
+def test_analyze_cli_missing_flag_value_is_usage_error(capsys):
+    from flexflow_tpu.analysis.cli import run_analyze
+
+    assert run_analyze(["--model"]) == 2
+    assert "needs a value" in capsys.readouterr().err
+
+
+def test_analyze_cli_rejects_bad_strategy(tmp_path, capsys):
+    from flexflow_tpu.analysis.cli import run_analyze
+
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({
+        "mesh_axes": {"data": 16},  # 16 > 4 devices
+        "ops": {"linear_0": {"dp": 16, "tp": 1}},
+    }))
+    assert run_analyze(["--model", "mnist_mlp", "--chips", "4",
+                        "--strategy", str(path)]) == 1
+    assert "FFTA023" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------
+# every example/zoo model's searched plan passes the analyzer clean
+# ---------------------------------------------------------------------
+def _zoo_graph(name, batch):
+    from flexflow_tpu import models as zoo
+
+    config = ff.FFConfig()
+    config.batch_size = batch
+    m = ff.FFModel(config)
+    if name == "mnist_mlp":
+        zoo.build_mnist_mlp(m, m.create_tensor([batch, 784]))
+    elif name == "mnist_cnn":
+        zoo.build_mnist_cnn(m, m.create_tensor([batch, 1, 28, 28]))
+    elif name == "cifar10_cnn":
+        zoo.build_cifar10_cnn(m, m.create_tensor([batch, 3, 32, 32]))
+    elif name == "alexnet":
+        zoo.build_alexnet(m, m.create_tensor([batch, 3, 229, 229]))
+    elif name == "mlp_unify":
+        zoo.build_mlp_unify(m, m.create_tensor([batch, 4096]),
+                            m.create_tensor([batch, 4096]))
+    elif name == "bert_small":
+        cfg = zoo.TransformerConfig(hidden_size=64, embedding_size=64,
+                                    num_heads=4, num_layers=2,
+                                    sequence_length=32, vocab_size=128)
+        zoo.build_bert_encoder(
+            m, m.create_tensor([batch, 32], ff.DataType.DT_INT32), cfg)
+    elif name == "moe_small":
+        cfg = zoo.MoeConfig(hidden_size=32, num_attention_heads=4,
+                            num_encoder_layers=1, num_exp=4, num_select=2)
+        zoo.build_moe_encoder(m, m.create_tensor([batch, 16, 32]), cfg)
+    else:
+        raise AssertionError(name)
+    return m, Graph(m.ops), config
+
+
+@pytest.mark.parametrize("name", ["mnist_mlp", "mnist_cnn", "cifar10_cnn",
+                                  "alexnet", "mlp_unify", "bert_small",
+                                  "moe_small"])
+def test_searched_plans_pass_analyzer(name):
+    batch = 16
+    _, g, config = _zoo_graph(name, batch)
+    config.search_budget = 2
+    config.use_native_search = False
+    n_dev = 4
+    machine = make_machine_model(config, n_dev)
+    result = unity_optimize(g, config, machine, batch, n_dev)
+    report = analyze_plan(
+        g, strategies=result.strategies, machine=machine, config=config,
+        batch_size=batch, n_devices=n_dev, mesh_axes=result.mesh_axes,
+        final_guid=g.topo_order()[-1].guid)
+    assert report.ok, report.format()
