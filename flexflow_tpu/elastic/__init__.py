@@ -1,12 +1,17 @@
-"""Elastic runtime: fault injection, failure detection, retry, and
-automatic strategy re-planning on mesh shrink (docs/elastic.md).
+"""Elastic runtime: fault injection, failure detection, retry, automatic
+strategy re-planning on mesh shrink, and durability — a training watchdog
+plus verified-fallback checkpoints (docs/elastic.md, docs/durability.md).
 
-The headline path: a `FaultPlan` scripts chip-loss/slow-link/transient
-events, the `FailureDetector` guards every Executor train-step dispatch
-(retrying transients via `RetryPolicy`), and the `ElasticCoordinator`
-answers topology loss by rebuilding a shrunken `MachineModel` from the
-survivor spec, re-running the Unity search, restoring the latest
-checkpoint resharded onto the new mesh, and resuming the same fit() call.
+The headline path: a `FaultPlan` scripts chip-loss/slow-link/transient/
+nan-step/corrupt-checkpoint events, the `FailureDetector` guards every
+Executor train-step dispatch (retrying transients via `RetryPolicy`), the
+`TrainingWatchdog` health-checks every committed loss (skipping bad
+batches and rolling back to the last-good checkpoint on sustained
+blow-ups), and the `ElasticCoordinator` answers topology loss by
+rebuilding a shrunken `MachineModel` from the survivor spec, re-running
+the Unity search, restoring the newest VERIFIED checkpoint
+(runtime/durability.py) resharded onto the new mesh, and resuming the
+same fit() call.
 """
 from .coordinator import (ElasticCoordinator, RecoveryFailed,
                           reshard_params, ring_topology_spec,
@@ -16,11 +21,14 @@ from .events import ElasticEvent, EventLog
 from .faults import (Fault, FaultInjector, FaultPlan, TopologyLoss,
                      TransientFault, classify_error)
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
+from .watchdog import (NumericBlowup, TrainingWatchdog, WatchdogPolicy,
+                       watchdog_counters)
 
 __all__ = [
     "ElasticCoordinator", "ElasticEvent", "EventLog", "FailureDetector",
-    "Fault", "FaultInjector", "FaultPlan", "RecoveryFailed",
-    "RetriesExhausted", "RetryPolicy", "TopologyLoss", "TransientFault",
+    "Fault", "FaultInjector", "FaultPlan", "NumericBlowup",
+    "RecoveryFailed", "RetriesExhausted", "RetryPolicy", "TopologyLoss",
+    "TrainingWatchdog", "TransientFault", "WatchdogPolicy",
     "call_with_retry", "classify_error", "reshard_params",
-    "ring_topology_spec", "shrink_topology_spec",
+    "ring_topology_spec", "shrink_topology_spec", "watchdog_counters",
 ]

@@ -3,6 +3,12 @@
 Recovery state machine (docs/elastic.md has the full diagram):
 
     TRAIN --transient--> RETRY (in place, bounded backoff) --> TRAIN
+    TRAIN --bad numerics--> WATCHDOG (elastic/watchdog.py):
+        a NaN/Inf or spiking loss first SKIPs the offending batch (the
+        update is computed into temporaries and never commits); after
+        max_consecutive_bad bad steps in a row, ROLLBACK to the newest
+        VERIFIED checkpoint (runtime/durability.py) and replay from its
+        step (docs/durability.md has the full state machine);
     TRAIN --topology loss--> RECOVER:
         1. shrink: drop the lost chips from the device list and from the
            topology spec (renumbered survivor spec ->
@@ -33,18 +39,21 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import tempfile
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..runtime.checkpoint import restore_checkpoint, save_checkpoint
+from ..runtime.checkpoint import CheckpointError
+from ..runtime.durability import DurableCheckpointer
 from .detector import FailureDetector
 from .events import (CHECKPOINT, PLAN_ANALYSIS, RECOVERY_DONE,
                      RECOVERY_RESTORE, RECOVERY_SEARCH, RECOVERY_START,
                      EventLog)
 from .faults import FaultInjector, FaultPlan, TopologyLoss
 from .retry import RetryPolicy
+from .watchdog import OK, ROLLBACK, SKIP, TrainingWatchdog
 
 
 def ring_topology_spec(num_chips: int, gbps: float = 45.0) -> Dict:
@@ -100,18 +109,39 @@ class ElasticCoordinator:
                  checkpoint_every: int = 5,
                  retry_policy: Optional[RetryPolicy] = None,
                  events: Optional[EventLog] = None,
-                 max_recoveries: int = 2):
+                 max_recoveries: int = 2,
+                 keep_checkpoints: int = 3,
+                 watchdog="auto",
+                 max_rollbacks: int = 4):
         self.model_builder = model_builder
         self.events = events if events is not None else EventLog()
         self.checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
             prefix="ff_elastic_")
         self.checkpoint_every = max(1, checkpoint_every)
         self.max_recoveries = max_recoveries
+        self.max_rollbacks = max_rollbacks
+        # durable checkpoints: atomic writes, MANIFEST.json with last-K
+        # retention, checksum-verified restore with fallback
+        self._ckpt = DurableCheckpointer(self.checkpoint_dir,
+                                         keep_last=keep_checkpoints,
+                                         events=self.events)
+        # watchdog="auto" builds one on the shared event log; pass None to
+        # disable, or a TrainingWatchdog (ideally constructed with this
+        # coordinator's EventLog) for custom thresholds
+        self.watchdog: Optional[TrainingWatchdog] = (
+            TrainingWatchdog(events=self.events) if watchdog == "auto"
+            else watchdog)
         injector = (FaultInjector(fault_plan, events=self.events)
                     if fault_plan is not None else None)
-        self.detector = FailureDetector(events=self.events,
-                                        injector=injector,
-                                        retry_policy=retry_policy)
+        if injector is not None:
+            # corrupt_checkpoint faults tear the newest file in OUR dir
+            injector.checkpoint_dir = self.checkpoint_dir
+        # retry jitter draws from a per-run seeded stream, not the global
+        # random module — drill timelines replay exactly
+        self.detector = FailureDetector(
+            events=self.events, injector=injector,
+            retry_policy=retry_policy,
+            rng=random.Random(getattr(config, "seed", 0)))
         # device positions are GLOBAL indices into jax.devices(); the
         # topology spec numbers chips 0..n-1 in device_ids order
         self.device_ids: List[int] = (
@@ -130,6 +160,7 @@ class ElasticCoordinator:
             self._topo_spec = ring_topology_spec(len(self.device_ids))
         self._base_config = config
         self._recoveries = 0
+        self._rollbacks = 0
         self._last_ckpt: Optional[tuple] = None  # (step, path)
         # the INITIAL build plans against the same explicit topology spec
         # recovery builds will use — otherwise a config without a
@@ -159,11 +190,51 @@ class ElasticCoordinator:
 
     # -- checkpointing -----------------------------------------------------
     def _save(self, step: int) -> str:
-        path = os.path.join(self.checkpoint_dir, f"ckpt_{step:06d}")
-        path = save_checkpoint(path, self.model, step=step)
+        path = self._ckpt.save(self.model, step=step)
         self._last_ckpt = (step, path)
+        # a fresh checkpoint means training made sustained good progress
+        # since the last restore point: refill the rollback budget, so the
+        # budget bounds rollbacks PER incident (restores without progress
+        # in between), not per training run
+        self._rollbacks = 0
         self.events.record(CHECKPOINT, step=step, path=path)
         return path
+
+    def _restore_latest_verified(self, model, cause: Exception) -> tuple:
+        """Restore the newest VERIFIED checkpoint into `model`, falling
+        back through torn/corrupt ones (durability layer). Returns
+        (step, path); wraps total loss as RecoveryFailed. The caller
+        reshards and records RECOVERY_RESTORE once its own validation of
+        the restored state has passed."""
+        try:
+            return self._ckpt.restore_latest(model)
+        except CheckpointError as ce:
+            raise RecoveryFailed(
+                f"no restorable checkpoint in {self.checkpoint_dir!r}: "
+                f"{ce}") from cause
+
+    def _rollback(self) -> int:
+        """Watchdog-triggered rollback: reload the last-good (verified)
+        checkpoint into the CURRENT model and resume from its step — the
+        mesh is intact, only the numerics went bad."""
+        self._rollbacks += 1
+        if self._rollbacks > self.max_rollbacks:
+            raise RecoveryFailed(
+                f"rollback budget ({self.max_rollbacks}) exhausted "
+                "without an intervening checkpoint of good progress — "
+                "the blow-up recurs after every restore, so it is "
+                "deterministic (bad hyperparameters or data), and "
+                "replaying the same steps cannot heal it")
+        err = RuntimeError("watchdog rollback")
+        ckpt_step, path = self._restore_latest_verified(self.model, err)
+        reshard_params(self.model)
+        self.events.record(RECOVERY_RESTORE, step=ckpt_step, path=path)
+        # the rollback EVENT is recorded here, where the restore actually
+        # happened — a mere ROLLBACK verdict (e.g. FFModel.fit's guard,
+        # which cannot roll back) must not report a recovery
+        if self.watchdog is not None:
+            self.watchdog.note_rollback(ckpt_step)
+        return ckpt_step
 
     # -- recovery ----------------------------------------------------------
     def _recover(self, exc: TopologyLoss) -> int:
@@ -219,12 +290,13 @@ class ElasticCoordinator:
             PLAN_ANALYSIS, step=self.detector.current_step,
             errors=len(report.errors()), warnings=len(report.warnings()),
             counts=report.counts())
-        # 3. restore the latest checkpoint into the new model, resharded
+        # 3. restore the newest VERIFIED checkpoint into the new model,
+        # resharded — a torn/corrupt latest file falls back to an older
+        # verified one instead of killing the recovery
         if self._last_ckpt is None:
             raise RecoveryFailed("no checkpoint to restore from") from exc
-        ckpt_step, path = self._last_ckpt
         expected = {name: set(ws) for name, ws in model.params.items()}
-        restore_checkpoint(path, model)
+        ckpt_step, path = self._restore_latest_verified(model, exc)
         got = {name: set(ws) for name, ws in model.params.items()}
         if expected != got:
             missing = set(expected) - set(got)
@@ -234,9 +306,10 @@ class ElasticCoordinator:
                 f"tree (missing ops: {sorted(missing)}, unexpected ops: "
                 f"{sorted(extra)}) — the builder must produce the same "
                 "architecture across rebuilds") from exc
+        # only a VALIDATED restore reshards and reports success — a
+        # mismatched tree must not leave a recovery.restore event behind
         reshard_params(model)
-        self.events.record(RECOVERY_RESTORE,
-                           step=ckpt_step, path=path)
+        self.events.record(RECOVERY_RESTORE, step=ckpt_step, path=path)
         # 4. swap in the recovered model and resume
         self.model = model
         self.device_ids = survivors
@@ -254,7 +327,8 @@ class ElasticCoordinator:
         is None), surviving scripted/real failures. Batches cycle through
         (x, y). Returns per-step {"step", "loss", ...metric} records for
         the steps that committed (a step rolled back by a recovery appears
-        once, from its post-recovery execution)."""
+        once, from its post-recovery execution; a step the watchdog
+        skipped for bad numerics never commits and is absent)."""
         if isinstance(x, np.ndarray):
             x = [x]
         model = self.model
@@ -275,7 +349,10 @@ class ElasticCoordinator:
             lo, hi = it * bs, (it + 1) * bs
             inputs, label = model._prep_step_batch(x, y, lo, hi)
             try:
-                (model.params, model.opt_state, model.state,
+                # results land in temporaries: the elastic step wrapper
+                # disables buffer donation, so the pre-step state survives
+                # and a watchdog SKIP can simply decline to commit
+                (new_params, new_opt_state, new_state,
                  mvals) = model._train_step(
                     model.params, model.opt_state, model.state, inputs,
                     label, model._next_rng())
@@ -285,6 +362,27 @@ class ElasticCoordinator:
                 step = resume
                 continue
             rec = {k: float(v) for k, v in mvals.items()}
+            injector = self.detector.injector
+            if injector is not None and injector.take_nan_step(step):
+                # a blown-up gradient surfaces in the step's outputs, not
+                # at dispatch: poison the observed loss the same way
+                rec["loss"] = float("nan")
+            if self.watchdog is not None and "loss" in rec:
+                verdict = self.watchdog.check(step, rec["loss"])
+            else:
+                verdict = OK
+            if verdict == ROLLBACK:
+                # skipping is not healing it: reload the last-good
+                # verified checkpoint and replay from its step
+                step = self._rollback()
+                continue
+            if verdict == SKIP:
+                # discard the bad update, move past the offending batch;
+                # the skipped step never commits to history
+                step += 1
+                continue
+            (model.params, model.opt_state,
+             model.state) = new_params, new_opt_state, new_state
             rec["step"] = step
             committed[step] = rec
             if verbose:

@@ -17,6 +17,7 @@ dispatch:
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Optional
 
@@ -38,10 +39,14 @@ class FailureDetector:
                  injector: Optional[FaultInjector] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  slow_factor: float = 3.0, ewma_alpha: float = 0.3,
-                 warmup_steps: int = 2, clock=time.perf_counter):
+                 warmup_steps: int = 2, clock=time.perf_counter,
+                 rng: Optional[random.Random] = None):
         self.events = events if events is not None else EventLog()
         self.injector = injector
         self.retry_policy = retry_policy or RetryPolicy()
+        # seeded by the coordinator (config.seed) so retry jitter — and
+        # with it drill timelines — is deterministic per run
+        self.rng = rng
         self.slow_factor = slow_factor
         self.ewma_alpha = ewma_alpha
         self.warmup_steps = warmup_steps  # first dispatches include jit
@@ -75,7 +80,8 @@ class FailureDetector:
 
         try:
             return call_with_retry(attempt, self.retry_policy,
-                                   events=self.events, step=step)
+                                   events=self.events, step=step,
+                                   rng=self.rng)
         except Exception as exc:
             if classify_error(exc) == CLASS_TOPOLOGY:
                 lost = getattr(exc, "lost_chips", ())

@@ -1,16 +1,29 @@
-"""`python -m flexflow_tpu elastic-drill`: scripted kill-and-recover run.
+"""`python -m flexflow_tpu elastic-drill`: scripted fail-and-recover runs.
 
-Runs the whole elastic story end-to-end on CPU host-device emulation:
-train a small MLP on N virtual devices, inject a transient failure (watch
-the retry policy absorb it), kill K chips at a chosen step (watch the
-coordinator re-run the Unity search for N-K devices, restore the latest
-checkpoint, and resume), then compare the final loss against an
-uninterrupted reference run of the same seed and data.
+Runs the elastic + durability story end-to-end on CPU host-device
+emulation: train a small MLP on N virtual devices under a scripted
+adversity scenario, then compare the final loss against an uninterrupted
+reference run of the same seed and data.
 
     python -m flexflow_tpu elastic-drill --devices 8 --kill 2 --at-step 5
+    python -m flexflow_tpu elastic-drill --scenario nan-step
+    python -m flexflow_tpu elastic-drill --scenario corrupt-checkpoint
 
-Exit code 0 iff the recovered run finished, actually recovered, and landed
-within tolerance of the reference. The last stdout line is a JSON summary.
+Scenarios (--scenario, docs/durability.md):
+  default            a transient hiccup (retry absorbs it) + a K-chip kill
+                     (re-plan on the survivors, restore, resume)
+  nan-step           consecutive blown-up steps: the watchdog skips the
+                     first bad batches, then rolls back to the last-good
+                     verified checkpoint and replays
+  corrupt-checkpoint the newest checkpoint file is torn on disk, THEN
+                     chips die: the recovery restore must fall back to the
+                     previous verified checkpoint instead of crashing
+
+Exit code 0 iff the run finished, the scenario's recovery machinery
+actually engaged, and the final loss landed within tolerance of the
+reference. The last stdout line is a JSON summary (including the
+`ff_watchdog_*` / `ff_checkpoint_*` lines the serving /metrics endpoint
+would export for the run).
 """
 from __future__ import annotations
 
@@ -34,8 +47,12 @@ def _take(argv: List[str], flag: str, default, cast=int):
     return default
 
 
+SCENARIOS = ("default", "nan-step", "corrupt-checkpoint")
+
+
 def run_drill(argv: Optional[List[str]] = None) -> int:
     argv = list(argv or [])
+    scenario = _take(argv, "--scenario", "default", cast=str)
     devices = _take(argv, "--devices", 8)
     kill = _take(argv, "--kill", 2)
     at_step = _take(argv, "--at-step", 5)
@@ -46,6 +63,11 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
     tolerance = _take(argv, "--tolerance", 0.5, cast=float)
     if argv:
         print(f"warning: unrecognized drill flags {argv}", file=sys.stderr)
+    if scenario not in SCENARIOS:
+        raise SystemExit(f"--scenario {scenario!r}: choices are "
+                         f"{', '.join(SCENARIOS)}")
+    if scenario == "nan-step":
+        kill = 0  # numerics drill: the mesh stays intact
     if kill >= devices:
         raise SystemExit(f"--kill {kill} must leave at least one of "
                          f"--devices {devices} alive")
@@ -64,13 +86,21 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
     from .faults import FaultPlan
     from .retry import RetryPolicy
 
+    from .watchdog import WatchdogPolicy
+
+    # nan-step scripts this many consecutive blown-up steps: enough to
+    # exhaust the skip budget (forcing a rollback) plus one more that the
+    # replay meets as a plain skip
+    bad_run = WatchdogPolicy().max_consecutive_bad + 1
+
     survivors = devices - kill
     if batch is None:
         # one batch size every candidate dp degree divides, before AND
         # after the kill
-        batch = int(np.lcm(devices, survivors)) * 2
+        batch = int(np.lcm(devices, max(1, survivors))) * 2
     if steps is None:
-        steps = at_step + 6  # enough post-recovery steps to see progress
+        # enough post-fault steps to see progress
+        steps = at_step + (bad_run + 6 if scenario == "nan-step" else 6)
 
     rng = np.random.RandomState(seed)
     n_samples = batch * 4
@@ -100,11 +130,24 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
                   metrics=[ff.MetricsType.METRICS_ACCURACY])
         return m
 
-    # scripted adversity: one retryable hiccup early, the kill at --at-step
-    plan = (FaultPlan()
-            .add_transient(at_step=max(1, at_step // 2), times=1)
-            .add_chip_loss(at_step=at_step,
-                           chips=list(range(survivors, devices))))
+    # scripted adversity per scenario
+    if scenario == "nan-step":
+        plan = FaultPlan()
+        for s in range(at_step, at_step + bad_run):
+            plan.add_nan_step(s)
+    elif scenario == "corrupt-checkpoint":
+        # tear the newest on-disk checkpoint, then kill chips in the SAME
+        # dispatch: the recovery restore finds the latest file corrupt and
+        # must fall back to the previous verified checkpoint
+        plan = (FaultPlan()
+                .add_corrupt_checkpoint(at_step)
+                .add_chip_loss(at_step,
+                               chips=list(range(survivors, devices))))
+    else:  # default: one retryable hiccup early, the kill at --at-step
+        plan = (FaultPlan()
+                .add_transient(at_step=max(1, at_step // 2), times=1)
+                .add_chip_loss(at_step=at_step,
+                               chips=list(range(survivors, devices))))
     events = EventLog()
     coord = ElasticCoordinator(
         builder, make_config(), fault_plan=plan,
@@ -128,8 +171,16 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
     final = history[-1]["loss"]
     ref_final = ref_history[-1]["loss"]
     counts = events.counts()
-    recovered = counts.get("recovery.done", 0) >= 1
-    retried = counts.get("retry", 0) >= 1
+    # did the scenario's recovery machinery actually engage?
+    if scenario == "nan-step":
+        engaged = (counts.get("watchdog.rollback", 0) >= 1
+                   and counts.get("watchdog.skip", 0) >= 1)
+    elif scenario == "corrupt-checkpoint":
+        engaged = (counts.get("recovery.done", 0) >= 1
+                   and counts.get("checkpoint.fallback", 0) >= 1)
+    else:
+        engaged = (counts.get("recovery.done", 0) >= 1
+                   and counts.get("retry", 0) >= 1)
     within_tol = (np.isfinite(final)
                   and abs(final - ref_final) <= tolerance
                   * max(1.0, abs(ref_final)))
@@ -147,19 +198,34 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
         revisited = [v for v in by_batch.values() if len(v) >= 2]
         same_batch = revisited[-1] if revisited else None
     improved = same_batch is None or same_batch[-1] < same_batch[0]
-    ok = bool(recovered and retried and within_tol and improved)
+    ok = bool(engaged and within_tol and improved)
+    # the ff_watchdog_* / ff_checkpoint_* counters exactly as the serving
+    # /metrics endpoint exports them for this process
+    from ..serving.server import InferenceServer
+
+    srv = InferenceServer()
+    srv.attach_elastic_events(events)
+    metrics_lines = [
+        ln for ln in srv.prometheus_text().splitlines()
+        if ("watchdog" in ln or "checkpoint" in ln) and not
+        ln.startswith("#")]
     summary = {
         "ok": ok,
+        "scenario": scenario,
         "devices": devices,
         "killed": kill,
         "n_devices_final": len(coord.device_ids),
         "recoveries": counts.get("recovery.done", 0),
         "retries": counts.get("retry", 0),
+        "watchdog_skips": counts.get("watchdog.skip", 0),
+        "watchdog_rollbacks": counts.get("watchdog.rollback", 0),
+        "checkpoint_fallbacks": counts.get("checkpoint.fallback", 0),
         "steps": steps,
         "final_loss": round(float(final), 6),
         "reference_loss": round(float(ref_final), 6),
         "final_axes": dict(coord.model.parallel_axes),
         "events": counts,
+        "metrics": metrics_lines,
     }
     print(json.dumps(summary))
     return 0 if ok else 1

@@ -30,6 +30,18 @@ RECOVERY_RESTORE = "recovery.restore"
 RECOVERY_DONE = "recovery.done"
 # plan-sanitizer verdict on a re-planned model (analysis/pipeline.py)
 PLAN_ANALYSIS = "analysis.plan"
+# durability layer (runtime/durability.py): checksum failures, fallback to
+# an older verified checkpoint, retention GC
+CHECKPOINT_CORRUPT = "checkpoint.corrupt"
+CHECKPOINT_FALLBACK = "checkpoint.fallback"
+CHECKPOINT_GC = "checkpoint.gc"
+# training watchdog (elastic/watchdog.py)
+WATCHDOG_BAD_STEP = "watchdog.bad_step"
+WATCHDOG_SKIP = "watchdog.skip"
+WATCHDOG_ROLLBACK = "watchdog.rollback"
+# injected durability faults (elastic/faults.py)
+FAULT_NAN_STEP = "fault.nan_step"
+FAULT_CORRUPT_CKPT = "fault.corrupt_checkpoint"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -8,13 +8,19 @@ pre-dispatch keeps donated buffers intact so a retry can re-dispatch the
 same arguments. Everything is testable on CPU under
 `XLA_FLAGS=--xla_force_host_platform_device_count=N` (tests/conftest.py).
 
-Three fault classes, mirroring what a TPU runbook distinguishes:
+Five fault classes, mirroring what a TPU runbook distinguishes:
 - transient (compile hiccup, queue timeout): retryable in place →
   `TransientFault`, handled by elastic/retry.py.
 - slow link (a degraded ICI hop): no error at all, just latency — injected
   as a dispatch-time stall; elastic/detector.py's EWMA flags it.
 - chip loss (preemption, ICI cut): topology changed, retrying is useless →
   `TopologyLoss`, escalated to the elastic coordinator for re-planning.
+- nan step (blown-up gradient): no error either — the step "succeeds" with
+  a non-finite loss; consumed post-dispatch (`take_nan_step`) and caught by
+  the training watchdog (elastic/watchdog.py).
+- corrupt checkpoint (torn write): silent on-disk rot of the newest
+  checkpoint file; discovered only when a restore verifies checksums
+  (runtime/durability.py falls back to an older verified checkpoint).
 
 `classify_error` maps REAL runtime exceptions onto the same taxonomy, so
 the detector treats an injected fault and a live XlaRuntimeError uniformly.
@@ -22,16 +28,23 @@ the detector treats an injected fault and a live XlaRuntimeError uniformly.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from .events import (FAULT_CHIP_LOSS, FAULT_SLOW_LINK, FAULT_TRANSIENT,
-                     EventLog)
+from .events import (FAULT_CHIP_LOSS, FAULT_CORRUPT_CKPT, FAULT_NAN_STEP,
+                     FAULT_SLOW_LINK, FAULT_TRANSIENT, EventLog)
 
 # fault kinds (FaultPlan entries)
 TRANSIENT = "transient"
 SLOW_LINK = "slow_link"
 CHIP_LOSS = "chip_loss"
+# durability faults (ISSUE 3): nan_step poisons the observed loss of an
+# optimizer step (a blown-up gradient), exercising the training watchdog's
+# skip/rollback path; corrupt_checkpoint truncates the newest on-disk
+# checkpoint (a torn write), exercising the verified-fallback restore.
+NAN_STEP = "nan_step"
+CORRUPT_CKPT = "corrupt_checkpoint"
 
 # error classes (classify_error results)
 CLASS_TRANSIENT = "transient"
@@ -68,7 +81,8 @@ class Fault:
     times: int = 1
 
     def __post_init__(self):
-        if self.kind not in (TRANSIENT, SLOW_LINK, CHIP_LOSS):
+        if self.kind not in (TRANSIENT, SLOW_LINK, CHIP_LOSS, NAN_STEP,
+                             CORRUPT_CKPT):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == CHIP_LOSS and not self.chips:
             raise ValueError("chip_loss fault needs a non-empty chips list")
@@ -102,6 +116,14 @@ class FaultPlan:
         self.faults.append(Fault(CHIP_LOSS, at_step, chips=tuple(chips)))
         return self
 
+    def add_nan_step(self, at_step: int, times: int = 1) -> "FaultPlan":
+        self.faults.append(Fault(NAN_STEP, at_step, times=times))
+        return self
+
+    def add_corrupt_checkpoint(self, at_step: int) -> "FaultPlan":
+        self.faults.append(Fault(CORRUPT_CKPT, at_step))
+        return self
+
     def take(self, step: int) -> List[Fault]:
         """The next armed fault for `step`, charged one firing, as a 0/1-
         element list. One at a time: a fault that raises must leave later
@@ -126,6 +148,40 @@ class FaultInjector:
         self.plan = plan
         self.events = events if events is not None else EventLog()
         self._sleep = sleep
+        # set by the ElasticCoordinator so corrupt_checkpoint faults know
+        # which directory's newest checkpoint to tear
+        self.checkpoint_dir: Optional[str] = None
+
+    def take_nan_step(self, step: int) -> bool:
+        """Consume an armed nan_step fault for `step`, if any. Called by
+        the training loop AFTER the dispatch (a blown-up gradient surfaces
+        in the step's outputs, not at dispatch time like the other fault
+        classes) — the loop poisons the observed loss so the watchdog sees
+        exactly what a real NaN step produces."""
+        for f in self.plan.faults:
+            if f.kind == NAN_STEP and f.at_step == step and f.times > 0:
+                f.times -= 1
+                self.events.record(FAULT_NAN_STEP, step=step)
+                return True
+        return False
+
+    def _corrupt_newest_checkpoint(self, step: int) -> None:
+        """Truncate the newest ckpt_*.npz in checkpoint_dir to half its
+        size — exactly the torn file a crash mid-write (pre-durability)
+        would have left."""
+        d = self.checkpoint_dir
+        names = ([] if d is None else
+                 sorted(n for n in os.listdir(d)
+                        if n.startswith("ckpt_") and n.endswith(".npz")))
+        if not names:
+            self.events.record(FAULT_CORRUPT_CKPT, step=step, path=None)
+            return
+        path = os.path.join(d, names[-1])
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        self.events.record(FAULT_CORRUPT_CKPT, step=step, path=path,
+                           truncated_to=size // 2)
 
     def check(self, step: int) -> None:
         # each armed fault fires AT MOST ONCE per dispatch attempt (times
@@ -136,8 +192,14 @@ class FaultInjector:
         for f in list(self.plan.faults):
             if f.at_step != step or f.times <= 0:
                 continue
+            if f.kind == NAN_STEP:
+                continue  # consumed post-dispatch via take_nan_step
             f.times -= 1
-            if f.kind == SLOW_LINK:
+            if f.kind == CORRUPT_CKPT:
+                # non-raising side effect: the dispatch proceeds, the rot
+                # is only discovered when a restore verifies checksums
+                self._corrupt_newest_checkpoint(step)
+            elif f.kind == SLOW_LINK:
                 self.events.record(FAULT_SLOW_LINK, step=step,
                                    stall_s=f.stall_s)
                 self._sleep(f.stall_s)

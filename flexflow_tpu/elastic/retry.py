@@ -47,9 +47,13 @@ class RetryPolicy:
 
 def call_with_retry(fn: Callable, policy: RetryPolicy,
                     events: Optional[EventLog] = None, step: int = -1,
-                    classify=classify_error, sleep=time.sleep):
+                    classify=classify_error, sleep=time.sleep,
+                    rng: Optional[random.Random] = None):
     """Run fn(); retry in place on transient errors per `policy`. Anything
-    non-transient propagates untouched on the first occurrence."""
+    non-transient propagates untouched on the first occurrence. `rng`
+    (a seeded random.Random) makes the jitter — and with it a drill's
+    whole retry timeline — reproducible; without one the policy falls back
+    to the global random stream."""
     attempt = 0
     while True:
         try:
@@ -61,7 +65,7 @@ def call_with_retry(fn: Callable, policy: RetryPolicy,
                 raise RetriesExhausted(
                     f"step {step}: transient failure persisted through "
                     f"{policy.max_retries} retries: {exc}") from exc
-            delay = policy.delay_s(attempt)
+            delay = policy.delay_s(attempt, rng)
             if events is not None:
                 events.record(RETRY, step=step, attempt=attempt + 1,
                               delay_s=delay, error=f"{type(exc).__name__}: "

@@ -1060,6 +1060,7 @@ class FFModel:
         accum_steps: int = 1,
         steps_per_execution: int = 1,
         verbose: bool = False,
+        watchdog=None,
     ) -> List[Dict[str, float]]:
         """accum_steps > 1: gradient accumulation — each optimizer update
         averages the gradients of `accum_steps` consecutive microbatches of
@@ -1076,7 +1077,15 @@ class FFModel:
         are split(key, K) per chunk rather than drawn per step), and any
         trailing n mod (bs*K) samples run through the single-step path to
         keep updates-per-epoch identical. Mutually exclusive with
-        accum_steps > 1."""
+        accum_steps > 1.
+
+        watchdog: an optional elastic.TrainingWatchdog. Every committed
+        loss is health-checked (NaN/Inf, EMA spike); bad steps are flagged
+        in the watchdog's event log, and after max_consecutive_bad of them
+        in a row fit raises the typed NumericBlowup. This plain loop
+        CANNOT skip or roll back a bad update — its jitted step donates
+        the previous params, and there are no checkpoints here; train
+        under an ElasticCoordinator for skip-and-rollback recovery."""
         import jax
 
         assert self._compiled, "call compile() first"
@@ -1128,6 +1137,12 @@ class FFModel:
                 f"is {bs * steps_per_execution}; fit needs at least one full "
                 "dispatch"
             )
+        def _wd_guard(mv: Dict[str, float]) -> None:
+            # watchdog health check on the committed loss; raises
+            # NumericBlowup after max_consecutive_bad bad steps
+            if watchdog is not None and "loss" in mv:
+                watchdog.guard(self._step_count, mv["loss"])
+
         history = []
         timer = None
         if self.config.profiling:
@@ -1183,6 +1198,7 @@ class FFModel:
                     mv = {k2: float(np.asarray(v).mean())
                           for k2, v in mvals_k.items()}
                     self.perf_metrics.update(K * bs, mv)
+                    _wd_guard(mv)  # per-chunk: the K-step mean loss
                     return mv
 
                 for chunk_i in range(chunks):
@@ -1226,6 +1242,7 @@ class FFModel:
                         label, self._next_rng())
                     mvals = {k2: float(v) for k2, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
+                    _wd_guard(mvals)
                 dt = time.time() - t0
                 summ = self.perf_metrics.summary()
                 summ["epoch"] = epoch
@@ -1269,6 +1286,7 @@ class FFModel:
                     mvals = {k2: float(v) / accum_steps
                              for k2, v in mvals.items()}
                     self.perf_metrics.update(accum_steps * bs, mvals)
+                    _wd_guard(mvals)
                 else:
                     self.params, self.opt_state, self.state, mvals = self._train_step(
                         self.params, self.opt_state, self.state, inputs, label,
@@ -1276,6 +1294,7 @@ class FFModel:
                     )
                     mvals = {k: float(v) for k, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
+                    _wd_guard(mvals)
             dt = time.time() - t0
             summ = self.perf_metrics.summary()
             summ["epoch"] = epoch

@@ -1,17 +1,33 @@
 """Checkpoint/resume for model params, optimizer state, and op state.
 
 The reference has NO training-path checkpointing (SURVEY.md §5: only
-set_tensor/get_tensor numpy I/O). This is the modern replacement: orbax-style
-checkpointing of the full training state. Uses orbax when available, else a
-portable npz format (flattened pytree with '/'-joined keys).
+set_tensor/get_tensor numpy I/O). This is the modern replacement: a
+portable, self-verifying npz format — a flattened pytree with '/'-joined
+keys, a `__meta__` JSON record (step, step_count, true dtypes of widened
+bfloat16 arrays), and a per-array CRC32 table. Writes are atomic (temp
+file + fsync + rename) so a crash mid-save can never leave a torn file
+under the final name, and `restore_checkpoint` verifies every checksum
+before touching the model, raising a typed `CheckpointError` on a
+missing/torn/corrupt/foreign file. Retention, manifests, and automatic
+fallback to the newest *verified* checkpoint live one level up in
+runtime/durability.py.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import zlib
+from typing import Any, Dict
 
 import numpy as np
+
+FORMAT_NAME = "flexflow_tpu_checkpoint"
+FORMAT_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file is missing, torn, corrupt, or not a checkpoint at
+    all. The message always names the offending path."""
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -35,8 +51,27 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
     return tree
 
 
+def _crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so the rename itself is durable
+    (POSIX: a rename is not guaranteed on disk until the dir entry is)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:  # platforms/filesystems without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path: str, model, step: int = 0) -> str:
-    """Write params + opt_state + op state + metadata. Returns the path."""
+    """Atomically write params + opt_state + op state + metadata (with
+    per-array CRC32s). Returns the final path."""
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     flat: Dict[str, np.ndarray] = {}
     flat.update(_flatten(model.params or {}, "params/"))
@@ -49,36 +84,123 @@ def save_checkpoint(path: str, model, step: int = 0) -> str:
         if v.dtype.kind == "V" or str(v.dtype) == "bfloat16":
             dtypes[k] = "bfloat16"
             flat[k] = v.astype(np.float32)
+    # checksums cover the bytes as STORED (post-widening), so verification
+    # compares like against like without reconstructing dtypes
     meta = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
         "step": int(step),
         "step_count": int(model._step_count),
         "dtypes": dtypes,
+        "crc32": {k: _crc32(v) for k, v in flat.items()},
     }
     if not path.endswith(".npz"):
         path = path + ".npz"
-    np.savez(path, __meta__=json.dumps(meta), **flat)
+    # atomic: savez into a temp file in the same dir, fsync it, rename over
+    # the final name — a crash at any point leaves either the old file or
+    # nothing under `path`, never a torn write
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta), **flat)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    _fsync_dir(path)
     return path
 
 
-def restore_checkpoint(path: str, model) -> int:
-    """Load a checkpoint into the model in place. Returns the saved step."""
-    import jax.numpy as jnp
-
+def _open_checkpoint(path: str):
+    """np.load with the torn/missing/foreign failure modes mapped to
+    CheckpointError. Returns (npz, meta)."""
     if not path.endswith(".npz") and not os.path.exists(path):
         path = path + ".npz"
-    data = np.load(path, allow_pickle=False)
-    meta = json.loads(str(data["__meta__"]))
+    if not os.path.exists(path):
+        raise CheckpointError(f"checkpoint {path!r} does not exist")
+    try:
+        data = np.load(path, allow_pickle=False)
+    except Exception as exc:  # BadZipFile / OSError / ValueError...
+        raise CheckpointError(
+            f"checkpoint {path!r} is unreadable (torn write or not an "
+            f"npz): {type(exc).__name__}: {exc}") from exc
+    if "__meta__" not in data.files:
+        raise CheckpointError(
+            f"{path!r} is a valid npz but not a flexflow_tpu checkpoint "
+            "(no __meta__ record) — e.g. a raw weights.npz; checkpoints "
+            "are written by save_checkpoint")
+    try:
+        meta = json.loads(str(data["__meta__"]))
+    except Exception as exc:
+        raise CheckpointError(
+            f"checkpoint {path!r} has an unparseable __meta__ record: "
+            f"{exc}") from exc
+    return data, meta
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Fully read a checkpoint and verify every array against the recorded
+    CRC32 table. Returns the metadata dict on success; raises
+    CheckpointError naming the path (and the first bad array) otherwise.
+    Pre-CRC checkpoints (no 'crc32' in meta) verify by readability alone."""
+    data, meta = _open_checkpoint(path)
+    crcs = meta.get("crc32", {})
+    for key in data.files:
+        if key == "__meta__":
+            continue
+        try:
+            val = data[key]
+        except Exception as exc:  # truncated member / zlib error
+            raise CheckpointError(
+                f"checkpoint {path!r}: array {key!r} is unreadable "
+                f"(torn write): {type(exc).__name__}: {exc}") from exc
+        want = crcs.get(key)
+        if want is not None and _crc32(val) != want:
+            raise CheckpointError(
+                f"checkpoint {path!r}: array {key!r} fails its CRC32 "
+                "check (corrupt on disk)")
+    return meta
+
+
+def restore_checkpoint(path: str, model, verify: bool = True) -> int:
+    """Load a checkpoint into the model in place. Returns the saved step.
+
+    With verify=True (default) every array is read and CRC32-checked in
+    the SAME pass that collects it — all arrays are verified and in
+    memory BEFORE any model state is mutated, so a corrupt file raises
+    CheckpointError without leaving the model half-restored, and the file
+    is read only once."""
+    import jax.numpy as jnp
+
+    data, meta = _open_checkpoint(path)
     dtypes = meta.get("dtypes", {})
+    crcs = meta.get("crc32", {}) if verify else {}
     groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "opt_state": {}, "state": {}}
     for key in data.files:
         if key == "__meta__":
             continue
-        val = data[key]
+        try:
+            val = data[key]
+        except Exception as exc:  # truncated member / zlib error
+            raise CheckpointError(
+                f"checkpoint {path!r}: array {key!r} is unreadable "
+                f"(torn write): {type(exc).__name__}: {exc}") from exc
+        # checksum the bytes as STORED, before any dtype narrowing
+        want = crcs.get(key)
+        if want is not None and _crc32(val) != want:
+            raise CheckpointError(
+                f"checkpoint {path!r}: array {key!r} fails its CRC32 "
+                "check (corrupt on disk)")
         if dtypes.get(key) == "bfloat16":
             import ml_dtypes
 
             val = val.astype(ml_dtypes.bfloat16)
         head, rest = key.split("/", 1)
+        if head not in groups:
+            raise CheckpointError(
+                f"checkpoint {path!r}: unexpected top-level key {key!r}")
         groups[head][rest] = val
 
     def to_jnp(tree):

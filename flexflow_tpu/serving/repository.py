@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import List, Optional
 
 
@@ -112,32 +113,56 @@ class ModelRepository:
             restore_checkpoint(os.path.join(model_dir, ckpt), model)
         return model
 
-    def load(self, server, names: Optional[List[str]] = None) -> List[str]:
+    def load(self, server, names: Optional[List[str]] = None,
+             strict: bool = False) -> List[str]:
         """Build and register models (all by default) on an InferenceServer.
-        Returns the loaded names."""
+        Returns the loaded names.
+
+        One model's bad entry (missing artifact, torn/corrupt/foreign
+        checkpoint, unknown format...) must not abort loading every OTHER
+        model: failures are caught per model, logged to stderr, recorded
+        on the server (surfaced in stats() under "_load_failures" and on
+        /metrics as ff_model_load_failures_total), and the scan continues.
+        strict=True restores raise-on-first-failure for callers that want
+        a repository to be all-or-nothing."""
         loaded = []
         for name in names if names is not None else self.model_names():
-            cfg = self.config(name)
-            model = self.build(name, cfg)
-            # batching defaults derive from the batch the model was BUILT
-            # for — padding a request to a bucket larger than the declared
-            # batch would run the executor at a shape the graph never had
-            built_batch = int(model.config.batch_size)
-            # an explicit max_batch_size is clamped too: the executor runs
-            # the graph at the shapes it was built for
-            max_bs = min(int(cfg.get("max_batch_size", built_batch)),
-                         built_batch)
-            buckets = cfg.get("batch_buckets")
-            if buckets is None:
-                buckets = [b for b in (1, 4, 16, 64) if b < max_bs] + [max_bs]
-            buckets = [min(int(b), max_bs) for b in buckets]
-            server.register(
-                name,
-                model,
-                max_batch_size=max_bs,
-                max_delay_ms=float(cfg.get("max_delay_ms", 2.0)),
-                batch_buckets=tuple(buckets),
-            )
+            # the WHOLE per-model pipeline is isolated — a malformed
+            # batching field (e.g. a non-numeric max_batch_size) must not
+            # abort the scan any more than a corrupt checkpoint does
+            try:
+                cfg = self.config(name)
+                model = self.build(name, cfg)
+                # batching defaults derive from the batch the model was
+                # BUILT for — padding a request to a bucket larger than
+                # the declared batch would run the executor at a shape the
+                # graph never had
+                built_batch = int(model.config.batch_size)
+                # an explicit max_batch_size is clamped too: the executor
+                # runs the graph at the shapes it was built for
+                max_bs = min(int(cfg.get("max_batch_size", built_batch)),
+                             built_batch)
+                buckets = cfg.get("batch_buckets")
+                if buckets is None:
+                    buckets = [b for b in (1, 4, 16, 64)
+                               if b < max_bs] + [max_bs]
+                buckets = [min(int(b), max_bs) for b in buckets]
+                server.register(
+                    name,
+                    model,
+                    max_batch_size=max_bs,
+                    max_delay_ms=float(cfg.get("max_delay_ms", 2.0)),
+                    batch_buckets=tuple(buckets),
+                )
+            except Exception as exc:
+                if strict:
+                    raise
+                print(f"[repository] failed to load model {name!r}: "
+                      f"{type(exc).__name__}: {exc}", file=sys.stderr)
+                record = getattr(server, "record_load_failure", None)
+                if record is not None:
+                    record(name, exc)
+                continue
             loaded.append(name)
         return loaded
 

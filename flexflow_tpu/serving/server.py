@@ -63,6 +63,20 @@ class InferenceServer:
         # elastic runtime event log (elastic/events.py), exported on
         # /metrics when attached
         self._elastic_events = None
+        # models a repository scan failed to load: name -> latest error
+        # string, plus a cumulative per-model failure count (serving keeps
+        # running on the models that DID load)
+        self._load_failures: Dict[str, str] = {}
+        self._load_failure_counts: Dict[str, int] = {}
+
+    def record_load_failure(self, name: str, error: BaseException) -> None:
+        """Note a model the repository could not load; surfaced in stats()
+        under "_load_failures" and on /metrics. Counts accumulate across
+        repeated scans so rate()-style alerting keeps firing while the
+        entry stays broken."""
+        self._load_failures[name] = f"{type(error).__name__}: {error}"
+        self._load_failure_counts[name] = \
+            self._load_failure_counts.get(name, 0) + 1
 
     def attach_elastic_events(self, events) -> None:
         """Surface an elastic EventLog's per-kind counters on the metrics
@@ -165,6 +179,14 @@ class InferenceServer:
         analysis = self._analysis_counters()
         if analysis:
             out["_analysis"] = analysis
+        if self._load_failures:
+            out["_load_failures"] = dict(self._load_failures)
+        durability = self._durability_counters()
+        if durability:
+            out["_checkpoint"] = durability
+        watchdog = self._watchdog_counters()
+        if watchdog:
+            out["_watchdog"] = watchdog
         return out
 
     @staticmethod
@@ -175,6 +197,22 @@ class InferenceServer:
         from ..analysis import diagnostic_counters
 
         return diagnostic_counters()
+
+    @staticmethod
+    def _durability_counters():
+        """Durable-checkpoint counters (runtime/durability.py):
+        process-wide saves/restores/corruptions/fallbacks/GC."""
+        from ..runtime.durability import checkpoint_counters
+
+        return checkpoint_counters()
+
+    @staticmethod
+    def _watchdog_counters():
+        """Training-watchdog counters (elastic/watchdog.py): process-wide
+        bad steps / skips / rollbacks."""
+        from ..elastic.watchdog import watchdog_counters
+
+        return watchdog_counters()
 
     def prometheus_text(self) -> str:
         """Prometheus exposition-format metrics (the Triton /metrics role)."""
@@ -192,6 +230,12 @@ class InferenceServer:
             lines.append(f'ff_inference_requests_total{{model="{n}"}} {s["requests"]}')
             lines.append(f'ff_inference_failures_total{{model="{n}"}} {s["failures"]}')
             lines.append(f'ff_inference_avg_latency_ms{{model="{n}"}} {s["avg_latency_ms"]}')
+        if self._load_failure_counts:
+            lines.append("# TYPE ff_model_load_failures_total counter")
+            for n, count in sorted(self._load_failure_counts.items()):
+                lines.append(
+                    f'ff_model_load_failures_total{{model="{esc(n)}"}} '
+                    f"{count}")
         out = "\n".join(lines) + "\n"
         if self._elastic_events is not None:
             out += self._elastic_events.prometheus_text()
@@ -201,6 +245,14 @@ class InferenceServer:
             for code, n in sorted(analysis.items()):
                 out += (f'ff_plan_diagnostics_total{{code="{esc(code)}"}}'
                         f" {n}\n")
+        # durability + watchdog counters (ISSUE 3): ff_checkpoint_*_total
+        # and ff_watchdog_*_total, process-wide like the analysis counters
+        for prefix, counters in (
+                ("ff_checkpoint", self._durability_counters()),
+                ("ff_watchdog", self._watchdog_counters())):
+            for kind, n in sorted(counters.items()):
+                out += (f"# TYPE {prefix}_{kind}_total counter\n"
+                        f"{prefix}_{kind}_total {n}\n")
         return out
 
     def shutdown(self):

@@ -1,11 +1,20 @@
 """Checkpoint/resume (new capability — the reference has no model
-checkpointing, SURVEY.md §5)."""
+checkpointing, SURVEY.md §5) and the durability layer on top of it:
+atomic checksummed files, torn-write detection, manifest retention, and
+fallback to the newest VERIFIED checkpoint (ISSUE 3)."""
+import json
 import os
+import types
 
 import numpy as np
+import pytest
 
 import flexflow_tpu as ff
-from flexflow_tpu.runtime.checkpoint import restore_checkpoint, save_checkpoint
+from flexflow_tpu.runtime.checkpoint import (CheckpointError,
+                                             restore_checkpoint,
+                                             save_checkpoint,
+                                             verify_checkpoint)
+from flexflow_tpu.runtime.durability import DurableCheckpointer
 
 
 def build(seed_data):
@@ -47,3 +56,137 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     h2 = m2.fit(x, y, epochs=1)
     np.testing.assert_allclose(h1[-1]["sparse_cce"], h2[-1]["sparse_cce"],
                                rtol=1e-4, atol=1e-5)
+
+
+# -- typed errors on non-checkpoints (ISSUE 3 satellite) -----------------
+def test_restore_non_checkpoint_npz_raises_named_error(tmp_path):
+    """A plain npz (e.g. a repository weights.npz) used to die with a bare
+    KeyError: '__meta__'; now it's a CheckpointError naming the path."""
+    path = str(tmp_path / "weights.npz")
+    np.savez(path, w=np.ones((3, 3), np.float32))
+    with pytest.raises(CheckpointError, match="weights.npz"):
+        restore_checkpoint(path, build(0))
+    with pytest.raises(CheckpointError, match="not a flexflow_tpu"):
+        verify_checkpoint(path)
+
+
+def test_restore_missing_and_garbage_files(tmp_path):
+    with pytest.raises(CheckpointError, match="does not exist"):
+        restore_checkpoint(str(tmp_path / "nope"), build(0))
+    garbage = tmp_path / "bad.npz"
+    garbage.write_bytes(b"this is not a zip archive")
+    with pytest.raises(CheckpointError, match="bad.npz"):
+        restore_checkpoint(str(garbage), build(0))
+
+
+# -- checksums + bfloat16 ------------------------------------------------
+def _fake_model(params):
+    return types.SimpleNamespace(params=params, opt_state={}, state={},
+                                 _step_count=3)
+
+
+def test_bfloat16_roundtrip_with_checksums(tmp_path):
+    """bfloat16 arrays survive save/restore with CRC verification on: the
+    checksums cover the widened-to-f32 bytes as stored, and restore gets
+    the true dtype back."""
+    import ml_dtypes
+
+    rng = np.random.RandomState(0)
+    src = rng.randn(16, 8).astype(ml_dtypes.bfloat16)
+    path = save_checkpoint(str(tmp_path / "bf16"), _fake_model(
+        {"fc": {"kernel": src, "bias": np.zeros(8, np.float32)}}), step=5)
+    meta = verify_checkpoint(path)  # every array passes its CRC
+    assert meta["dtypes"] == {"params/fc/kernel": "bfloat16"}
+    assert set(meta["crc32"]) == {"params/fc/kernel", "params/fc/bias"}
+
+    dst = _fake_model({})
+    assert restore_checkpoint(path, dst) == 5
+    got = np.asarray(dst.params["fc"]["kernel"])
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got.astype(np.float32),
+                                  np.asarray(src).astype(np.float32))
+
+
+def test_crc_mismatch_detected(tmp_path):
+    """Bit rot (not just truncation): hand-edit the stored CRC table so an
+    intact array no longer matches — verification must fail."""
+    path = save_checkpoint(str(tmp_path / "c"), _fake_model(
+        {"fc": {"w": np.ones((4, 4), np.float32)}}), step=1)
+    data = dict(np.load(path, allow_pickle=False))
+    meta = json.loads(str(data.pop("__meta__")))
+    meta["crc32"]["params/fc/w"] ^= 0xFF
+    np.savez(path, __meta__=json.dumps(meta), **data)
+    with pytest.raises(CheckpointError, match="CRC32"):
+        verify_checkpoint(path)
+
+
+def test_save_is_atomic_no_tmp_left_behind(tmp_path):
+    path = save_checkpoint(str(tmp_path / "a"), _fake_model(
+        {"fc": {"w": np.ones(3, np.float32)}}), step=0)
+    assert os.path.basename(path) == "a.npz"
+    assert sorted(os.listdir(tmp_path)) == ["a.npz"]  # no .tmp.* residue
+
+
+# -- durable checkpointer: manifest, GC, verified fallback ---------------
+def _saver(tmp_path, **kw):
+    ckpt = DurableCheckpointer(str(tmp_path), **kw)
+    model = build(0)
+    return ckpt, model
+
+
+def test_manifest_retention_gc(tmp_path):
+    ckpt, model = _saver(tmp_path, keep_last=2)
+    for step in (0, 2, 4, 6):
+        ckpt.save(model, step=step)
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert files == ["ckpt_000004.npz", "ckpt_000006.npz"]
+    assert [e["step"] for e in ckpt.entries()] == [4, 6]
+    with open(ckpt.manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["keep_last"] == 2
+    step, path = ckpt.latest_verified()
+    assert step == 6 and path.endswith("ckpt_000006.npz")
+
+
+def test_torn_write_falls_back_to_previous_verified(tmp_path):
+    """Truncate the newest checkpoint mid-file (the crash-mid-save relic):
+    restore must fall back to the previous good one, not die."""
+    from flexflow_tpu.elastic import EventLog
+
+    events = EventLog()
+    ckpt = DurableCheckpointer(str(tmp_path), keep_last=3, events=events)
+    model = build(0)
+    ckpt.save(model, step=0)
+    model.fit(np.random.RandomState(0).randn(16, 16).astype(np.float32),
+              np.zeros((16, 1), np.int32), epochs=1)
+    ckpt.save(model, step=2)
+    newest = os.path.join(str(tmp_path), "ckpt_000002.npz")
+    size = os.path.getsize(newest)
+    with open(newest, "r+b") as f:
+        f.truncate(size // 2)
+
+    target = build(1)
+    step, path = ckpt.restore_latest(target)
+    assert step == 0 and path.endswith("ckpt_000000.npz")
+    assert len(events.events("checkpoint.corrupt")) == 1
+    fb = events.events("checkpoint.fallback")
+    assert len(fb) == 1 and fb[0].details["skipped"] == 1
+
+
+def test_no_verified_checkpoint_raises(tmp_path):
+    ckpt, model = _saver(tmp_path)
+    path = ckpt.save(model, step=0)
+    with open(path, "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(CheckpointError, match="no verified checkpoint"):
+        ckpt.restore_latest(build(1))
+
+
+def test_entries_survive_missing_manifest(tmp_path):
+    """A pre-durability dir (files, no MANIFEST.json) still restores: the
+    directory scan is the fallback source of truth."""
+    model = build(0)
+    save_checkpoint(str(tmp_path / "ckpt_000004"), model, step=4)
+    ckpt = DurableCheckpointer(str(tmp_path))
+    step, path = ckpt.latest_verified()
+    assert step == 4 and path.endswith("ckpt_000004.npz")

@@ -62,6 +62,43 @@ def test_retry_policy_backoff_bounded():
     assert delays[-1] == pytest.approx(0.5)
 
 
+def test_retry_jitter_seeded_deterministic():
+    """With a seeded random.Random the jitter — and so a drill's whole
+    retry timeline — replays exactly; the global-random fallback stays for
+    callers that don't care."""
+    import random
+
+    p = RetryPolicy(max_retries=5, base_delay_s=0.1, jitter_frac=0.5,
+                    max_delay_s=10.0)
+    d1 = [p.delay_s(k, random.Random(7)) for k in range(5)]
+    d2 = [p.delay_s(k, random.Random(7)) for k in range(5)]
+    assert d1 == d2
+    # jitter lands inside [base, base * (1 + jitter_frac)]
+    for k, d in enumerate(d1):
+        base = min(0.1 * 2.0 ** k, 10.0)
+        assert base <= d <= base * 1.5
+
+
+def test_call_with_retry_threads_rng_into_delays():
+    import random
+
+    events = EventLog()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise TransientFault("x")
+        return 1
+
+    policy = RetryPolicy(max_retries=5, base_delay_s=0.125, jitter_frac=1.0)
+    call_with_retry(flaky, policy, events=events, step=1,
+                    sleep=lambda s: None, rng=random.Random(3))
+    got = [e.details["delay_s"] for e in events.events("retry")]
+    replay = random.Random(3)
+    assert got == [policy.delay_s(k, replay) for k in range(3)]
+
+
 def test_call_with_retry_transient_then_success():
     events = EventLog()
     calls = {"n": 0}

@@ -383,6 +383,64 @@ def test_model_repository_checkpoint_restore(tmp_path):
         server.shutdown()
 
 
+def test_model_repository_isolates_failed_models(tmp_path):
+    """One model's missing artifact / corrupt checkpoint must not abort
+    loading every OTHER model (ISSUE 3 satellite): the bad entries are
+    recorded on the server and the good one serves."""
+    from flexflow_tpu.serving import ModelRepository
+
+    spec = {
+        "format": "flexflow_tpu_c_model",
+        "config": {"batch_size": 8},
+        "ops": [
+            {"type": "input", "name": "x", "dims": [8, 6],
+             "dtype": "float32", "inputs": [], "outputs": [1]},
+            {"type": "dense", "name": "fc", "inputs": [1], "outputs": [2],
+             "params": {"out_dim": 3}},
+            {"type": "softmax", "name": "sm", "inputs": [2],
+             "outputs": [3], "params": {}},
+        ],
+    }
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "model_spec.json").write_text(json.dumps(spec))
+    (good / "config.json").write_text(json.dumps(
+        {"format": "ff_cspec", "file": "model_spec.json"}))
+    # artifact file missing entirely
+    missing = tmp_path / "missing_artifact"
+    missing.mkdir()
+    (missing / "config.json").write_text(json.dumps(
+        {"format": "ff_cspec", "file": "nope.json"}))
+    # checkpoint points at a plain npz that is NOT a checkpoint
+    badckpt = tmp_path / "bad_ckpt"
+    badckpt.mkdir()
+    (badckpt / "model_spec.json").write_text(json.dumps(spec))
+    np.savez(str(badckpt / "weights.npz"), w=np.ones(3, np.float32))
+    (badckpt / "config.json").write_text(json.dumps(
+        {"format": "ff_cspec", "file": "model_spec.json",
+         "checkpoint": "weights.npz"}))
+
+    repo = ModelRepository(str(tmp_path))
+    server = InferenceServer()
+    try:
+        loaded = repo.load(server)
+        assert loaded == ["good"]
+        assert server.models() == ["good"]
+        out = server.infer("good", {"x": np.ones((8, 6), np.float32)},
+                           timeout=30.0)
+        assert np.asarray(out).shape == (8, 3)
+        failures = server.stats()["_load_failures"]
+        assert set(failures) == {"bad_ckpt", "missing_artifact"}
+        assert "CheckpointError" in failures["bad_ckpt"]
+        text = server.prometheus_text()
+        assert 'ff_model_load_failures_total{model="bad_ckpt"} 1' in text
+        # strict mode restores all-or-nothing for callers that want it
+        with pytest.raises(Exception):
+            repo.load(InferenceServer(), strict=True)
+    finally:
+        server.shutdown()
+
+
 def test_fold_batchnorm_preserves_inference():
     """Serving-time conv+BN folding: after a few training steps (non-trivial
     running stats), the folded graph's eval-mode predictions match the
