@@ -87,6 +87,13 @@ def main(argv=None):
         from .analysis.cli import run_analyze
 
         raise SystemExit(run_analyze(argv[1:]))
+    # observability capture: train a zoo model with tracing on; emit the
+    # Perfetto trace, simulator-calibration report, and metrics dump
+    # (docs/observability.md)
+    if argv and argv[0] == "profile":
+        from .obs.cli import run_profile
+
+        raise SystemExit(run_profile(argv[1:]))
     # script mode: first non-flag arg ending in .py
     script = next((a for a in argv if a.endswith(".py")), None)
     if script is not None:

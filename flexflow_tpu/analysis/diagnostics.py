@@ -17,7 +17,6 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
-import threading
 from typing import Dict, List, Optional, Sequence
 
 
@@ -167,22 +166,27 @@ class PlanAnalysisError(RuntimeError):
 
 
 # -- process-wide counters (exported on the serving /metrics endpoint) ----
-_counter_lock = threading.Lock()
-_counters: Dict[str, int] = {}
+# Backed by the obs metrics registry (obs/registry.py) as
+# ff_plan_diagnostics_total{code=...}; the accessors below are the
+# pre-registry API, kept as thin shims over the shared family.
+def _diag_counter():
+    from ..obs.registry import REGISTRY
+
+    return REGISTRY.counter(
+        "ff_plan_diagnostics_total",
+        "Plan-sanitizer diagnostics by FFTA code", labels=("code",))
 
 
 def record_report(report: DiagnosticReport) -> None:
     """Fold a report into the process-wide per-code counters."""
-    with _counter_lock:
-        for code, n in report.counts().items():
-            _counters[code] = _counters.get(code, 0) + n
+    c = _diag_counter()
+    for code, n in report.counts().items():
+        c.inc(n, code=code)
 
 
 def diagnostic_counters() -> Dict[str, int]:
-    with _counter_lock:
-        return dict(_counters)
+    return {key[0]: int(v) for key, v in _diag_counter().items() if v}
 
 
 def reset_counters() -> None:
-    with _counter_lock:
-        _counters.clear()
+    _diag_counter().reset()

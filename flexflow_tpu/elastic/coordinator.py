@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.tracing import get_tracer
 from ..runtime.checkpoint import CheckpointError
 from ..runtime.durability import DurableCheckpointer
 from .detector import FailureDetector
@@ -226,7 +227,8 @@ class ElasticCoordinator:
                 "deterministic (bad hyperparameters or data), and "
                 "replaying the same steps cannot heal it")
         err = RuntimeError("watchdog rollback")
-        ckpt_step, path = self._restore_latest_verified(self.model, err)
+        with get_tracer().span("elastic.rollback"):
+            ckpt_step, path = self._restore_latest_verified(self.model, err)
         reshard_params(self.model)
         self.events.record(RECOVERY_RESTORE, step=ckpt_step, path=path)
         # the rollback EVENT is recorded here, where the restore actually
@@ -239,7 +241,17 @@ class ElasticCoordinator:
     # -- recovery ----------------------------------------------------------
     def _recover(self, exc: TopologyLoss) -> int:
         """Shrink, re-search, restore, resume. Returns the step to resume
-        from (the latest checkpoint's step)."""
+        from (the latest checkpoint's step). The whole pipeline is one
+        `elastic.recover` span with `elastic.replan` / `elastic.restore`
+        nested inside — a recovery is visible in the same trace as the
+        steps around it."""
+        with get_tracer().span("elastic.recover",
+                               lost_chips=sorted(exc.lost_chips)) as sp:
+            step = self._recover_inner(exc)
+            sp.set(resume_step=step, survivors=len(self.device_ids))
+            return step
+
+    def _recover_inner(self, exc: TopologyLoss) -> int:
         self._recoveries += 1
         if self._recoveries > self.max_recoveries:
             raise RecoveryFailed(
@@ -274,7 +286,9 @@ class ElasticCoordinator:
         spec_path = self._write_spec(f"survivors_{self._recoveries}.json")
         # 2. re-plan: a fresh compile on the shrunken machine re-runs the
         # Unity search (when search_budget > 0) against the survivor spec
-        model = self.model_builder(self._config_for(survivors, spec_path))
+        with get_tracer().span("elastic.replan", n_devices=len(survivors)):
+            model = self.model_builder(self._config_for(survivors,
+                                                        spec_path))
         sr = model.search_result
         self.events.record(
             RECOVERY_SEARCH, step=self.detector.current_step,
@@ -296,7 +310,8 @@ class ElasticCoordinator:
         if self._last_ckpt is None:
             raise RecoveryFailed("no checkpoint to restore from") from exc
         expected = {name: set(ws) for name, ws in model.params.items()}
-        ckpt_step, path = self._restore_latest_verified(model, exc)
+        with get_tracer().span("elastic.restore"):
+            ckpt_step, path = self._restore_latest_verified(model, exc)
         got = {name: set(ws) for name, ws in model.params.items()}
         if expected != got:
             missing = set(expected) - set(got)
@@ -357,7 +372,10 @@ class ElasticCoordinator:
                     model.params, model.opt_state, model.state, inputs,
                     label, model._next_rng())
             except TopologyLoss as exc:
+                get_tracer().instant("elastic.detect", step=step,
+                                     lost_chips=sorted(exc.lost_chips))
                 resume = self._recover(exc)
+                get_tracer().instant("elastic.resume", step=resume)
                 # steps after the checkpoint were rolled back: replay them
                 step = resume
                 continue

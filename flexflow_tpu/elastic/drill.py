@@ -37,14 +37,11 @@ import numpy as np
 
 
 def _take(argv: List[str], flag: str, default, cast=int):
-    if flag in argv:
-        i = argv.index(flag)
-        if i + 1 >= len(argv):
-            raise SystemExit(f"missing value for {flag}")
-        val = cast(argv[i + 1])
-        del argv[i:i + 2]
-        return val
-    return default
+    # one canonical argv-popping helper (obs/cli.py); this wrapper only
+    # keeps the drill's historical int-default cast
+    from ..obs.cli import _take as _take_flag
+
+    return _take_flag(argv, flag, default, cast=cast)
 
 
 SCENARIOS = ("default", "nan-step", "corrupt-checkpoint")
@@ -61,6 +58,7 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
     budget = _take(argv, "--budget", 8)
     seed = _take(argv, "--seed", 0)
     tolerance = _take(argv, "--tolerance", 0.5, cast=float)
+    trace_out = _take(argv, "--trace-out", None, cast=str)
     if argv:
         print(f"warning: unrecognized drill flags {argv}", file=sys.stderr)
     if scenario not in SCENARIOS:
@@ -78,6 +76,14 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
     from ..runtime.platform import force_platform
 
     force_platform("cpu", n_host_devices=devices)
+
+    # --trace-out: capture the drill as a Chrome/Perfetto trace, so the
+    # recovery spans (elastic.recover/replan/restore, checkpoint.save/
+    # restore) are visible in the same timeline as the step dispatches
+    if trace_out:
+        from ..obs.tracing import enable_tracing
+
+        enable_tracing()
 
     import flexflow_tpu as ff
 
@@ -227,5 +233,10 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
         "events": counts,
         "metrics": metrics_lines,
     }
+    if trace_out:
+        from ..obs.tracing import get_tracer
+
+        summary["trace"] = get_tracer().export_chrome_trace(trace_out)
+        summary["trace_spans"] = get_tracer().span_names()
     print(json.dumps(summary))
     return 0 if ok else 1

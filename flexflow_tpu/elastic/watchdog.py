@@ -29,9 +29,9 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import threading
 from typing import Dict, Optional
 
+from ..obs.registry import REGISTRY
 from .events import (WATCHDOG_BAD_STEP, WATCHDOG_ROLLBACK, WATCHDOG_SKIP,
                      EventLog)
 
@@ -41,26 +41,24 @@ SKIP = "skip"
 ROLLBACK = "rollback"
 
 # process-wide watchdog counters, exported on the serving /metrics endpoint
-# as ff_watchdog_<kind>_total
-_COUNTS: Dict[str, int] = {}
-_COUNTS_LOCK = threading.Lock()
+# as ff_watchdog_<kind>_total — backed by the obs metrics registry; the
+# accessors below are the pre-registry API kept as shims
+_COUNTER_PREFIX = "ff_watchdog_"
 
 
 def _bump(kind: str) -> None:
-    with _COUNTS_LOCK:
-        _COUNTS[kind] = _COUNTS.get(kind, 0) + 1
+    REGISTRY.counter(f"{_COUNTER_PREFIX}{kind}_total",
+                     f"Training watchdog events: {kind}").inc()
 
 
 def watchdog_counters() -> Dict[str, int]:
     """Snapshot of the process-wide watchdog counters: bad_steps, skips,
     rollbacks."""
-    with _COUNTS_LOCK:
-        return dict(_COUNTS)
+    return REGISTRY.counters_with_prefix(_COUNTER_PREFIX)
 
 
 def reset_watchdog_counters() -> None:
-    with _COUNTS_LOCK:
-        _COUNTS.clear()
+    REGISTRY.reset_all(prefix=_COUNTER_PREFIX)
 
 
 class NumericBlowup(RuntimeError):

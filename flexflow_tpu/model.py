@@ -63,6 +63,8 @@ class FFModel:
         self._recompile_state = None
         self._op_strategies = None
         self.search_result = None
+        # per-step observability ring (obs/stepstats.py), populated by fit()
+        self.step_stats = None
         self._dataloaders: List[Any] = []
         self._accum_grad = self._accum_add = self._accum_update = None
         # (op_name, weight_name, fn) regularization terms added to the loss
@@ -566,10 +568,27 @@ class FFModel:
         comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
         parallel_axes: Optional[Dict[str, int]] = None,
     ) -> None:
-        """reference: FFModel::compile (model.cc:2803) — create operators from
-        layers, run the strategy search, build partitions/comms. Here: build
-        the PCG, pick a strategy (data-parallel default; Unity search when
-        search_budget > 0), build the mesh and compile the step functions."""
+        """reference: FFModel::compile (model.cc:2803) — create operators
+        from layers, run the strategy search, build partitions/comms. Here:
+        build the PCG, pick a strategy (data-parallel default; Unity search
+        when search_budget > 0), build the mesh and compile the step
+        functions. The whole pass is one `compile` span (obs/tracing.py),
+        with the search, plan-analysis, and step-build phases nested
+        inside it."""
+        from .obs.tracing import get_tracer
+
+        with get_tracer().span("compile", ops=len(self.ops)):
+            self._compile_inner(optimizer, loss_type, metrics, comp_mode,
+                                parallel_axes)
+
+    def _compile_inner(
+        self,
+        optimizer: Optional[Optimizer],
+        loss_type: LossType,
+        metrics: Sequence[MetricsType],
+        comp_mode: CompMode,
+        parallel_axes: Optional[Dict[str, int]],
+    ) -> None:
         self.optimizer = optimizer or SGDOptimizer(self, lr=self.config.learning_rate)
         # memory model input for the search: per-param optimizer state factor
         # (Adam: param+m+v, momentum-SGD: param+v, SGD: param)
@@ -701,6 +720,12 @@ class FFModel:
             self._export_task_graph(self.config.export_strategy_task_graph_file)
 
     def _build_step_functions(self) -> None:
+        from .obs.tracing import get_tracer
+
+        with get_tracer().span("compile.build_steps"):
+            self._build_step_functions_inner()
+
+    def _build_step_functions_inner(self) -> None:
         # stale accumulation closures would capture the OLD executor/optimizer
         self._accum_grad = self._accum_add = self._accum_update = None
         input_names = [op.name for op in self.input_ops]
@@ -804,8 +829,10 @@ class FFModel:
             return
         from .analysis import PlanAnalysisError, record_report
         from .elastic.events import EventLog
+        from .obs.tracing import get_tracer
 
-        report = self.analyze_plan()
+        with get_tracer().span("compile.analysis"):
+            report = self.analyze_plan()
         # stashed so post-compile consumers (the elastic coordinator's
         # recovery event) reuse this run instead of re-running the pipeline
         self._analysis_report = report
@@ -1144,13 +1171,23 @@ class FFModel:
                 watchdog.guard(self._step_count, mv["loss"])
 
         history = []
-        timer = None
-        if self.config.profiling:
-            from .runtime.profiling import IterationTimer
+        # per-step observability: every committed optimizer step (or
+        # K-step dispatch chunk) lands in a StepStats ring buffer — wall
+        # ms, samples/s, achieved TFLOP/s, MFU vs the machine spec's peak,
+        # loss — summarized at fit end and exported on the metrics
+        # registry. This subsumes the old IterationTimer: with
+        # config.profiling the same periodic samples/s line prints.
+        from .obs.stepstats import (StepStats, model_peak_tflops,
+                                    model_train_flops_per_step)
 
-            # in the chunked path one tick spans a whole K-step dispatch
-            timer = IterationTimer(bs * max(1, steps_per_execution),
-                                   print_freq=max(1, self.config.print_freq))
+        stats = StepStats(
+            flops_per_step=model_train_flops_per_step(self),
+            peak_tflops=model_peak_tflops(self),
+            print_freq=(max(1, self.config.print_freq)
+                        if self.config.profiling else 0),
+        )
+        self.step_stats = stats
+        stats.start()
         for epoch in range(epochs):
             self.reset_metrics()
             t0 = time.time()
@@ -1198,12 +1235,13 @@ class FFModel:
                     mv = {k2: float(np.asarray(v).mean())
                           for k2, v in mvals_k.items()}
                     self.perf_metrics.update(K * bs, mv)
+                    # one record per K-step dispatch; StepStats divides the
+                    # interval by K for the per-optimizer-step wall time
+                    stats.record_step(K * bs, loss=mv.get("loss"), steps=K)
                     _wd_guard(mv)  # per-chunk: the K-step mean loss
                     return mv
 
                 for chunk_i in range(chunks):
-                    if timer is not None:
-                        timer.tick()
                     if self._recompile_state is not None:
                         self._recompile_state.step(self)
                     batches = [load_host(chunk_i * K + j) for j in range(K)]
@@ -1242,6 +1280,7 @@ class FFModel:
                         label, self._next_rng())
                     mvals = {k2: float(v) for k2, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
+                    stats.record_step(bs, loss=mvals.get("loss"))
                     _wd_guard(mvals)
                 dt = time.time() - t0
                 summ = self.perf_metrics.summary()
@@ -1258,8 +1297,6 @@ class FFModel:
 
             # with accumulation, each update consumes accum_steps microbatches
             for step_i in range(n // (bs * accum_steps)):
-                if timer is not None:
-                    timer.tick()
                 if self._recompile_state is not None:
                     self._recompile_state.step(self)
                 base = step_i * accum_steps
@@ -1286,6 +1323,8 @@ class FFModel:
                     mvals = {k2: float(v) / accum_steps
                              for k2, v in mvals.items()}
                     self.perf_metrics.update(accum_steps * bs, mvals)
+                    stats.record_step(accum_steps * bs,
+                                      loss=mvals.get("loss"))
                     _wd_guard(mvals)
                 else:
                     self.params, self.opt_state, self.state, mvals = self._train_step(
@@ -1294,6 +1333,7 @@ class FFModel:
                     )
                     mvals = {k: float(v) for k, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
+                    stats.record_step(bs, loss=mvals.get("loss"))
                     _wd_guard(mvals)
             dt = time.time() - t0
             summ = self.perf_metrics.summary()
@@ -1305,6 +1345,12 @@ class FFModel:
                     f"epoch {epoch}: loss={mvals.get('loss', 0):.4f} "
                     f"acc={summ['accuracy']:.4f} {summ['throughput']:.1f} samples/s"
                 )
+        # fit-end step summary (wall ms percentiles, samples/s, TFLOP/s,
+        # MFU) — kept OFF the history records so their schema is unchanged
+        if len(stats):
+            _log.info(stats.format_summary())
+            if self.config.profiling:
+                print(stats.format_summary())
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None) -> Dict[str, float]:

@@ -1,0 +1,46 @@
+"""flexflow_tpu.obs — unified observability layer.
+
+Three primitives, one catalogue (docs/observability.md):
+
+ - `MetricsRegistry` (registry.py): typed Counter/Gauge/Histogram with
+   labels and THE Prometheus exposition renderer. The process-wide
+   default registry (`get_registry()`) carries every runtime counter
+   family; `reset_all()` zeroes it (the autouse test fixture).
+ - `Tracer` (tracing.py): nestable wall-clock spans, no-ops when
+   disabled, Chrome-trace-event/Perfetto JSON export.
+ - `StepStats` (stepstats.py): per-step ring buffer recorded by
+   FFModel.fit (wall ms, samples/s, TFLOP/s, MFU, loss).
+
+Plus `calibrate()` (calibration.py): the simulator's predicted step/op
+costs against measured reality — surfaced by
+`python -m flexflow_tpu profile`.
+"""
+from .calibration import CalibrationReport, OpCalibration, calibrate
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                       get_registry, iter_samples, parse_exposition,
+                       validate_exposition)
+from .stepstats import (StepStats, model_peak_tflops,
+                        model_train_flops_per_step)
+from .tracing import (Tracer, disable_tracing, enable_tracing, get_tracer,
+                      span, traced_dispatch)
+
+
+def reset_all() -> None:
+    """Zero every metric family in the default registry AND drop buffered
+    trace events — the one call the test autouse fixture needs so no
+    counter/span state leaks between tests."""
+    REGISTRY.reset_all()
+    tr = get_tracer()
+    tr.disable()
+    tr.clear()
+
+
+__all__ = [
+    "CalibrationReport", "OpCalibration", "calibrate",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "get_registry", "iter_samples", "parse_exposition",
+    "validate_exposition",
+    "StepStats", "model_peak_tflops", "model_train_flops_per_step",
+    "Tracer", "disable_tracing", "enable_tracing", "get_tracer", "span",
+    "traced_dispatch", "reset_all",
+]

@@ -1,0 +1,189 @@
+"""Simulator calibration: predicted vs measured cost, per op and per step.
+
+The paper's central bet is that a profiling-based cost simulator can rank
+parallelization strategies; this module measures how far the simulator's
+predictions drift from reality on the current backend. Two levels:
+
+ - STEP: the searched plan's predicted step cost
+   (`SearchResult.predicted_step_us`, or an analytic re-simulation of the
+   chosen strategies when no search ran) against the measured mean step
+   wall time from `FFModel.step_stats`.
+ - OP: the cost model's per-op forward estimate under each op's CHOSEN
+   strategy against an on-device micro-benchmark of the same op
+   (`search/simulator.OpCostCache` — the same measurement the measured-
+   cost search mode uses), so a systematic bias is attributable to a
+   specific op family.
+
+The report renders as a table, serializes to JSON (the `profile` CLI's
+calibration artifact), and publishes `ff_sim_step_calibration_ratio` —
+measured/predicted, 1.0 = perfectly calibrated — on the registry.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, List, Optional
+
+from .registry import REGISTRY
+
+
+@dataclasses.dataclass
+class OpCalibration:
+    op: str
+    op_type: str
+    strategy: str
+    predicted_us: float
+    measured_us: float  # NaN when the op is unmeasurable in isolation
+    error: Optional[str] = None
+
+    @property
+    def ratio(self) -> float:
+        if not (self.predicted_us > 0) or not math.isfinite(self.measured_us):
+            return float("nan")
+        return self.measured_us / self.predicted_us
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["ratio"] = self.ratio
+        return d
+
+
+@dataclasses.dataclass
+class CalibrationReport:
+    backend: str
+    predicted_step_us: Optional[float]
+    measured_step_us: Optional[float]
+    measured_steps: int
+    ops: List[OpCalibration]
+
+    @property
+    def step_ratio(self) -> float:
+        if not self.predicted_step_us or not self.measured_step_us:
+            return float("nan")
+        return self.measured_step_us / self.predicted_step_us
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "backend": self.backend,
+            "predicted_step_us": self.predicted_step_us,
+            "measured_step_us": self.measured_step_us,
+            "measured_steps": self.measured_steps,
+            "step_ratio": self.step_ratio,
+            "ops": [o.to_dict() for o in self.ops],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def format(self) -> str:
+        lines = [
+            f"simulator calibration ({self.backend} backend; ratio = "
+            "measured/predicted, 1.0 = perfectly calibrated)",
+            f"  step: predicted={_us(self.predicted_step_us)} "
+            f"measured={_us(self.measured_step_us)} "
+            f"over {self.measured_steps} step(s) "
+            f"ratio={_r(self.step_ratio)}",
+            f"  {'op':<28} {'type':<20} {'strategy':<14} "
+            f"{'pred us':>10} {'meas us':>10} {'ratio':>7}",
+        ]
+        for o in self.ops:
+            if o.error:
+                lines.append(
+                    f"  {o.op:<28} {o.op_type:<20} {o.strategy:<14} "
+                    f"{o.predicted_us:>10.1f} {'--':>10} {'--':>7}"
+                    f"  {o.error}")
+            else:
+                lines.append(
+                    f"  {o.op:<28} {o.op_type:<20} {o.strategy:<14} "
+                    f"{o.predicted_us:>10.1f} {o.measured_us:>10.1f} "
+                    f"{_r(o.ratio):>7}")
+        return "\n".join(lines)
+
+
+def _us(v: Optional[float]) -> str:
+    return f"{v:.1f}us" if v else "n/a"
+
+
+def _r(v: float) -> str:
+    return f"{v:.2f}" if math.isfinite(v) else "n/a"
+
+
+def predicted_step_us(model) -> Optional[float]:
+    """The plan's predicted step cost: the search's own number when a
+    search ran, otherwise an analytic re-simulation of the chosen (or
+    default) strategies — so calibration works for plain data-parallel
+    compiles too."""
+    sr = model.search_result
+    if sr is not None and getattr(sr, "predicted_step_us", None):
+        return float(sr.predicted_step_us)
+    if model.graph is None:
+        return None
+    from ..search.machine_model import make_machine_model
+    from ..search.simulator import Simulator
+
+    n_dev = max(1, model.config.total_devices)
+    sim = Simulator(make_machine_model(model.config, n_dev), model.config)
+    return float(sim.simulate(model.graph, model._op_strategies or {}))
+
+
+def calibrate(model, warmup: int = 1, repeats: int = 3,
+              max_ops: Optional[int] = None) -> CalibrationReport:
+    """Build the predicted-vs-profiled report for a compiled model.
+
+    Per-op measurement compiles each op as a micro-function over its real
+    input shapes (OpCostCache), so on CPU the measured side reflects the
+    host — the report states the backend to keep cross-backend numbers
+    from being compared blindly."""
+    import jax
+
+    from ..ffconst import OpType
+    from ..search.machine_model import make_machine_model
+    from ..search.simulator import CostModel, OpCostCache, OpStrategy
+
+    assert model.graph is not None, "compile() the model first"
+    n_dev = max(1, model.config.total_devices)
+    cost = CostModel(make_machine_model(model.config, n_dev), model.config)
+    cache = OpCostCache(model.config, warmup=warmup, repeats=repeats)
+    strategies = model._op_strategies or {}
+    default = OpStrategy(dp=1, tp=1)
+
+    rows: List[OpCalibration] = []
+    for op in model.graph.topo_order():
+        if op.op_type in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP):
+            continue
+        if max_ops is not None and len(rows) >= max_ops:
+            break
+        s = strategies.get(op.guid, default)
+        sdesc = f"dp={s.dp},tp={s.tp}" + (f",sp={s.sp}" if s.sp > 1 else "")
+        pred = cost.forward_time_us(op, s)
+        try:
+            meas = cache.measure_forward_us(op, s)
+            rows.append(OpCalibration(op.name, op.op_type.value, sdesc,
+                                      float(pred), float(meas)))
+        except Exception as e:  # unmeasurable ops (multi-output glue etc.)
+            rows.append(OpCalibration(
+                op.name, op.op_type.value, sdesc, float(pred),
+                float("nan"), error=f"{type(e).__name__}: {e}"))
+
+    stats = getattr(model, "step_stats", None)
+    measured_step = None
+    n_steps = 0
+    if stats is not None and len(stats):
+        # median, not mean: the first recorded step carries the jit
+        # compile and would swamp short calibration runs
+        measured_step = stats.summary()["p50_step_ms"] * 1e3
+        n_steps = len(stats)
+    report = CalibrationReport(
+        backend=jax.default_backend(),
+        predicted_step_us=predicted_step_us(model),
+        measured_step_us=measured_step,
+        measured_steps=n_steps,
+        ops=rows,
+    )
+    if math.isfinite(report.step_ratio):
+        REGISTRY.gauge(
+            "ff_sim_step_calibration_ratio",
+            "Measured/predicted step cost (1.0 = calibrated)",
+        ).set(report.step_ratio)
+    return report

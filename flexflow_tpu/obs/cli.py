@@ -1,0 +1,185 @@
+"""`python -m flexflow_tpu profile`: one-command observability capture.
+
+Trains a zoo model on synthetic data with the span tracer enabled and
+emits the full observability bundle into --out (default ./profile_out):
+
+    trace.json        Chrome-trace-event / Perfetto-loadable span timeline
+                      (search, compile, per-step executor dispatches,
+                      checkpoint saves when any happen)
+    calibration.json  simulator calibration: the searched plan's predicted
+    calibration.txt   step cost next to the measured steps, plus per-op
+                      predicted-vs-profiled forward costs
+    metrics.txt       Prometheus exposition dump of the process registry
+                      (validated against the exposition format before
+                      writing)
+
+All FFConfig flags pass through (`--budget 8` runs the Unity search so the
+trace contains the enumerate/prune/simulate phases and the calibration
+report an actual searched plan). Exit code 0 iff the run finished AND the
+emitted artifacts self-validate (trace JSON loads with spec-compliant
+events; metrics parse). The last stdout line is a JSON summary.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+# each entry is a set of alternatives: one of them must appear. A
+# steps_per_execution>1 run dispatches executor.multi_step instead of
+# per-step executor.train_step — both are "per-step spans"
+REQUIRED_SPANS = (
+    ("search",),
+    ("compile",),
+    ("executor.train_step", "executor.multi_step"),
+)
+
+
+def _take(argv: List[str], flag: str, default, cast=str):
+    """Pop `flag value` out of argv, or return default. The canonical
+    copy — elastic/drill.py wraps this with its int-default cast."""
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            raise SystemExit(f"missing value for {flag}")
+        val = cast(argv[i + 1])
+        del argv[i:i + 2]
+        return val
+    return default
+
+
+def validate_trace(path: str) -> List[str]:
+    """Load a Chrome trace JSON and check the events are spec-compliant:
+    valid JSON, every complete event carries name/ph/ts/dur/pid/tid, and
+    same-thread spans nest properly. Returns the span names present;
+    raises ValueError on any violation."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
+    by_tid = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "M":
+            continue
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in e:
+                raise ValueError(f"event missing {field!r}: {e}")
+        if ph == "X":
+            if "dur" not in e:
+                raise ValueError(f"X event missing dur: {e}")
+            by_tid.setdefault(e["tid"], []).append(e)
+    # nesting: within a thread, sort by (start, -end); a running stack of
+    # end times must contain each span inside its enclosing span
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -(e["ts"] + e["dur"])))
+        stack: List[float] = []
+        eps = 1e-3  # us; perf_counter_ns jitter guard
+        for e in evs:
+            end = e["ts"] + e["dur"]
+            while stack and e["ts"] >= stack[-1] - eps:
+                stack.pop()
+            if stack and end > stack[-1] + eps:
+                raise ValueError(
+                    f"span {e['name']!r} (tid {tid}) overlaps its parent "
+                    "instead of nesting")
+            stack.append(end)
+    return sorted({e["name"] for e in events
+                   if e.get("ph") in ("X", "i")})
+
+
+def run_profile(argv: Optional[List[str]] = None) -> int:
+    argv = list(argv or [])
+    model_name = _take(argv, "--model", "mnist_mlp")
+    out_dir = _take(argv, "--out", "profile_out")
+    epochs = _take(argv, "--epochs", None, cast=int)
+    saw_ffconfig_epochs = "-e" in argv  # FFConfig's own flag wins if given
+    max_ops = _take(argv, "--calibration-max-ops", None, cast=int)
+
+    from ..runtime.platform import honor_env_platform
+
+    honor_env_platform()
+
+    from . import (calibrate, enable_tracing, get_registry, get_tracer,
+                   validate_exposition)
+
+    tracer = enable_tracing()
+    tracer.clear()
+
+    import flexflow_tpu as ff
+
+    from ..__main__ import _synthetic
+
+    config = ff.FFConfig()
+    rest = config.parse_args(argv)
+    if rest:
+        print(f"warning: unrecognized flags {rest}", file=sys.stderr)
+    if epochs is not None:
+        config.epochs = epochs
+    elif not saw_ffconfig_epochs:
+        config.epochs = 2  # profile default: enough steps past jit warmup
+
+    model, xs, y = _synthetic(model_name, config)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY],
+    )
+    model.fit(xs, y, batch_size=config.batch_size, epochs=config.epochs,
+              steps_per_execution=config.steps_per_execution)
+
+    report = calibrate(model, max_ops=max_ops)
+    print(report.format())
+    print(model.step_stats.format_summary())
+
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = tracer.export_chrome_trace(
+        os.path.join(out_dir, "trace.json"))
+    with open(os.path.join(out_dir, "calibration.json"), "w") as f:
+        f.write(report.to_json())
+    with open(os.path.join(out_dir, "calibration.txt"), "w") as f:
+        f.write(report.format() + "\n")
+    metrics_text = get_registry().render()
+    metrics_path = os.path.join(out_dir, "metrics.txt")
+    with open(metrics_path, "w") as f:
+        f.write(metrics_text)
+
+    # self-validate the artifacts: a profile bundle that does not load in
+    # Perfetto or scrape as Prometheus text is a failure, not a warning
+    problems: List[str] = []
+    spans: List[str] = []
+    try:
+        spans = validate_trace(trace_path)
+    except (ValueError, KeyError, json.JSONDecodeError) as e:
+        problems.append(f"trace: {e}")
+    missing = [alts for alts in REQUIRED_SPANS
+               if not any(s in spans for s in alts)]
+    # a search span only exists when a search ran (search_budget > 0 with
+    # > 1 device); don't fail the single-device quick path on it
+    if ("search",) in missing and model.search_result is None:
+        missing.remove(("search",))
+    if missing:
+        problems.append(
+            "trace: missing required span(s) "
+            + str([" | ".join(alts) for alts in missing]))
+    try:
+        validate_exposition(metrics_text)
+    except ValueError as e:
+        problems.append(f"metrics: {e}")
+    sr = model.search_result
+    summary = {
+        "ok": not problems,
+        "model": model_name,
+        "out": out_dir,
+        "trace": trace_path,
+        "spans": spans,
+        "steps_recorded": len(model.step_stats),
+        "predicted_step_us": (sr.predicted_step_us if sr is not None
+                              else report.predicted_step_us),
+        "measured_step_us": report.measured_step_us,
+        "problems": problems,
+    }
+    print(json.dumps(summary))
+    return 0 if not problems else 1

@@ -1,0 +1,419 @@
+"""MetricsRegistry: typed Counter/Gauge/Histogram primitives with labels.
+
+One registry replaces the four hand-rolled counter dicts that grew up
+around the runtime (`analysis/diagnostics.py` plan-diagnostic counters,
+`runtime/durability.py` checkpoint counters, `elastic/watchdog.py`
+watchdog counters, serving `ModelMetrics`) and is the SINGLE Prometheus
+exposition renderer in the tree — every `/metrics` byte comes out of
+`MetricsRegistry.render()`.
+
+Design rules:
+ - a metric family is (name, kind, label names); re-requesting an existing
+   family returns the same object, and a kind/label mismatch is a loud
+   ValueError — two subsystems cannot silently publish incompatible series
+   under one name;
+ - `reset_all()` zeroes VALUES but keeps family registrations, so modules
+   that cached a handle at import time keep working across test resets;
+ - rendering escapes help text and label values per the exposition format
+   and `parse_exposition`/`validate_exposition` round-trip them — the
+   property the obs test suite pins.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+DEFAULT_LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
+
+
+def escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _Family:
+    """One metric family: shared name/help/label schema, per-labelset
+    values. Thread-safe — serving handler threads read while training
+    threads bump."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labels: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labels:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+        self.name = name
+        self.help = help
+        self.label_names: Tuple[str, ...] = tuple(labels)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"family declares {sorted(self.label_names)}")
+        return tuple(str(labels[ln]) for ln in self.label_names)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def items(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def remove(self, **labels) -> None:
+        """Drop one labelset's series (e.g. a model unregistered from a
+        server) so it stops rendering; the family stays registered."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
+    # -- rendering --------------------------------------------------------
+    def _sample_lines(self) -> List[str]:
+        items = self.items()
+        if not items and not self.label_names:
+            # an unlabeled family is born at 0 (prometheus-client
+            # semantics) — a reset family renders 0, not nothing
+            items = [((), 0.0)]
+        return [self._line(self.name, self.label_names, key, v)
+                for key, v in items]
+
+    @staticmethod
+    def _line(name: str, label_names: Sequence[str],
+              label_values: Sequence[str], v: float) -> str:
+        if label_names:
+            lbl = ",".join(
+                f'{ln}="{escape_label_value(lv)}"'
+                for ln, lv in zip(label_names, label_values))
+            return f"{name}{{{lbl}}} {_fmt(v)}"
+        return f"{name} {_fmt(v)}"
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        lines += self._sample_lines()
+        return "\n".join(lines) + "\n"
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (n={n})")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def set_total(self, value: float, **labels) -> None:
+        """Mirror an externally-accumulated monotonic total (e.g. an
+        EventLog's per-kind counts) into the exposition. Not for general
+        use — `inc` is the counter contract."""
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+
+def _norm_buckets(buckets: Sequence[float]) -> Tuple[float, ...]:
+    b = sorted(float(x) for x in buckets)
+    if not b or b[-1] != math.inf:
+        b.append(math.inf)
+    return tuple(b)
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram (`_bucket{le=}`/`_sum`/`_count`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labels: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        super().__init__(name, help, labels)
+        self.buckets: Tuple[float, ...] = _norm_buckets(buckets)
+        # per-labelset: [bucket counts..., sum, count]
+        self._hist: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            ent = self._hist.get(key)
+            if ent is None:
+                ent = self._hist[key] = [0.0] * (len(self.buckets) + 2)
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    ent[i] += 1
+            ent[-2] += v
+            ent[-1] += 1
+
+    def count(self, **labels) -> int:
+        key = self._key(labels)
+        with self._lock:
+            ent = self._hist.get(key)
+            return int(ent[-1]) if ent else 0
+
+    def sum(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            ent = self._hist.get(key)
+            return float(ent[-2]) if ent else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hist.clear()
+
+    def remove(self, **labels) -> None:
+        with self._lock:
+            self._hist.pop(self._key(labels), None)
+
+    def _sample_lines(self) -> List[str]:
+        out = []
+        with self._lock:
+            # deep-copy the per-labelset lists INSIDE the lock: a
+            # concurrent observe() mutates buckets, then sum, then count,
+            # and a lock-free read could emit a torn histogram
+            # (bucket{+Inf} != count) that breaks rate()/quantile math
+            items = sorted((k, list(v)) for k, v in self._hist.items())
+        for key, ent in items:
+            names = self.label_names + ("le",)
+            for i, le in enumerate(self.buckets):
+                out.append(self._line(f"{self.name}_bucket", names,
+                                      tuple(key) + (_fmt(le),), ent[i]))
+            out.append(self._line(f"{self.name}_sum", self.label_names,
+                                  key, ent[-2]))
+            out.append(self._line(f"{self.name}_count", self.label_names,
+                                  key, ent[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """A namespace of metric families with one exposition renderer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get_or_make(self, cls, name: str, help: str,
+                     labels: Sequence[str], **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or \
+                        fam.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind} with labels {fam.label_names}")
+                if "buckets" in kw:
+                    # a bucket mismatch is as incompatible as a kind
+                    # mismatch: the second caller's observations would
+                    # land in the first caller's boundaries
+                    want = _norm_buckets(kw["buckets"])
+                    if fam.buckets != want:
+                        raise ValueError(
+                            f"histogram {name!r} already registered with "
+                            f"buckets {fam.buckets}, requested {want}")
+                return fam
+            fam = cls(name, help, labels, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """buckets=None fetches/creates with the default boundaries and
+        never conflicts; explicit buckets must match an existing family."""
+        kw = {} if buckets is None else {"buckets": buckets}
+        return self._get_or_make(Histogram, name, help, labels, **kw)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def counters_with_prefix(self, prefix: str,
+                             suffix: str = "_total") -> Dict[str, int]:
+        """{middle: value} for unlabeled counters named
+        <prefix><middle><suffix> — the shim behind the pre-registry
+        accessors (`checkpoint_counters()`, `watchdog_counters()`)."""
+        out: Dict[str, int] = {}
+        for fam in self.families():
+            if (isinstance(fam, Counter) and not fam.label_names
+                    and fam.name.startswith(prefix)
+                    and fam.name.endswith(suffix)):
+                v = fam.value()
+                if v:
+                    out[fam.name[len(prefix):-len(suffix)]] = int(v)
+        return out
+
+    def reset_all(self, prefix: Optional[str] = None) -> None:
+        """Zero every family's values (registrations survive, so cached
+        handles stay live). prefix limits the reset to one family group."""
+        for fam in self.families():
+            if prefix is None or fam.name.startswith(prefix):
+                fam.reset()
+
+    def render(self) -> str:
+        """Prometheus exposition text for every family, sorted by name."""
+        return "".join(fam.render() for fam in self.families())
+
+
+# -- the process-wide default registry ------------------------------------
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+# -- exposition-format checking -------------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{(.*)\})?"                          # optional label block
+    r" ([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
+    r"(?: [0-9]+)?$")                         # optional timestamp
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str) -> Dict[str, Dict]:
+    """Strict-enough parser for the exposition subset we emit. Returns
+    {family name: {"type": ..., "help": ..., "samples":
+    [(name, {label: value}, float)]}}. Raises ValueError on any line that
+    does not parse — the checker the CI observability job and the obs
+    tests run over `/metrics` output."""
+    families: Dict[str, Dict] = {}
+
+    def fam(name: str) -> Dict:
+        return families.setdefault(
+            name, {"type": None, "help": None, "samples": []})
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                raise ValueError(f"line {lineno}: bad HELP: {line!r}")
+            fam(parts[2])["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if (len(parts) != 4 or not _NAME_RE.match(parts[2])
+                    or parts[3] not in ("counter", "gauge", "histogram",
+                                        "summary", "untyped")):
+                raise ValueError(f"line {lineno}: bad TYPE: {line!r}")
+            if families.get(parts[2], {}).get("type") is not None:
+                raise ValueError(
+                    f"line {lineno}: duplicate TYPE for {parts[2]}")
+            fam(parts[2])["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample: {line!r}")
+        name, label_block, value = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if label_block:
+            consumed = 0
+            for pm in _LABEL_PAIR_RE.finditer(label_block):
+                labels[pm.group(1)] = _unescape_label_value(pm.group(2))
+                consumed = pm.end()
+                if (consumed < len(label_block)
+                        and label_block[consumed] == ","):
+                    consumed += 1
+            if consumed != len(label_block):
+                raise ValueError(
+                    f"line {lineno}: bad label block: {label_block!r}")
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        fam(base if base in families else name)["samples"].append(
+            (name, labels, float(value.replace("Inf", "inf")
+                                 .replace("NaN", "nan"))))
+    return families
+
+
+def validate_exposition(text: str) -> Dict[str, Dict]:
+    """parse_exposition + structural checks: every sample belongs to a
+    family with a TYPE header, and histogram families carry their
+    _bucket/_sum/_count series."""
+    families = parse_exposition(text)
+    for name, f in families.items():
+        if f["samples"] and f["type"] is None:
+            raise ValueError(f"samples for {name} without a # TYPE header")
+        if f["type"] == "histogram":
+            kinds = {n.rsplit("_", 1)[-1] for n, _, _ in f["samples"]
+                     if n != name}
+            if f["samples"] and not {"sum", "count"} <= kinds:
+                raise ValueError(f"histogram {name} missing _sum/_count")
+    return families
+
+
+def iter_samples(text: str) -> Iterable[Tuple[str, Dict[str, str], float]]:
+    for f in parse_exposition(text).values():
+        yield from f["samples"]

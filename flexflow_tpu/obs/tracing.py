@@ -1,0 +1,215 @@
+"""Span tracer: nestable wall-clock spans with Chrome-trace-event export.
+
+The runtime is instrumented with `with tracer.span("name"):` blocks at
+every phase boundary (search enumerate/prune/simulate, compile, executor
+step dispatch, checkpoint save/restore, the elastic recovery pipeline,
+serving request handling). The contract that keeps this free to leave in
+hot loops:
+
+ - DISABLED (the default): `span()` is one attribute check returning a
+   shared no-op context manager — no allocation, no clock read, no lock.
+   `tests/test_obs.py` bounds the overhead.
+ - ENABLED: each span costs two monotonic clock reads plus one dict
+   append under a lock; the buffer is a ring (`max_events`) so a long
+   training run cannot grow memory without bound.
+
+Export is the Chrome trace-event JSON format (complete "X" events with
+`name`/`ph`/`ts`/`dur`/`pid`/`tid`), loadable in Perfetto / chrome://
+tracing. `ts` is microseconds from tracer start; spans on one thread nest
+by construction, so parent events always contain their children.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """The disabled-path context manager: a shared, stateless no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):  # matches _Span.set; still a no-op
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def set(self, **args) -> "_Span":
+        """Attach/override args mid-span (e.g. a result count discovered
+        while the span is open)."""
+        self.args.update(args)
+        return self
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self._tracer._emit(self.name, self._t0, t1, self.args)
+        return False
+
+
+class Tracer:
+    """A span buffer. One process-wide instance (`get_tracer()`) backs the
+    whole runtime; independent Tracers exist for tests."""
+
+    def __init__(self, enabled: bool = False, max_events: int = 200_000):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max_events)
+        self._epoch_ns = time.perf_counter_ns()
+        self._tids: Dict[int, int] = {}
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str, **args):
+        """Context manager timing a block. Near-zero cost when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker (Chrome "i" event) — e.g. the moment a
+        topology loss is detected, before recovery spans open."""
+        if not self.enabled:
+            return
+        now = time.perf_counter_ns()
+        self._append({
+            "name": name, "ph": "i", "s": "t",
+            "ts": (now - self._epoch_ns) / 1e3,
+            "pid": os.getpid(), "tid": self._tid(),
+            "args": args,
+        })
+
+    def _tid(self) -> int:
+        # Chrome trace tids render best small and stable per thread
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids) + 1)
+        return tid
+
+    def _emit(self, name: str, t0_ns: int, t1_ns: int,
+              args: Dict[str, Any]) -> None:
+        self._append({
+            "name": name, "ph": "X",
+            "ts": (t0_ns - self._epoch_ns) / 1e3,
+            "dur": (t1_ns - t0_ns) / 1e3,
+            "pid": os.getpid(), "tid": self._tid(),
+            "args": {k: _jsonable(v) for k, v in args.items()},
+        })
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    # -- control ----------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # -- export -----------------------------------------------------------
+    def events(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self._events)
+        if name is not None:
+            evs = [e for e in evs if e["name"] == name]
+        return evs
+
+    def span_names(self) -> List[str]:
+        return sorted({e["name"] for e in self.events()})
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The Chrome trace-event container Perfetto loads."""
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+            "args": {"name": "flexflow_tpu"},
+        }]
+        return {"traceEvents": meta + self.events(),
+                "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+# -- the process-wide tracer ----------------------------------------------
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def enable_tracing() -> Tracer:
+    _TRACER.enable()
+    return _TRACER
+
+
+def disable_tracing() -> None:
+    _TRACER.disable()
+
+
+def span(name: str, **args):
+    """Module-level convenience over the process tracer. Hot loops should
+    hoist `tr = get_tracer()` and call `tr.span` directly."""
+    return _TRACER.span(name, **args)
+
+
+def traced_dispatch(fn, name: str):
+    """Wrap a jitted step function so each host-side dispatch becomes a
+    span. The wall time is the DISPATCH (host call until the result's
+    futures are returned), not device completion — jax dispatch is async;
+    the per-step wall clock lives in StepStats. Disabled tracing is one
+    attribute check per call."""
+    tr = _TRACER
+
+    def wrapper(*a, **k):
+        if not tr.enabled:
+            return fn(*a, **k)
+        with tr.span(name):
+            return fn(*a, **k)
+
+    wrapper.__wrapped__ = fn
+    wrapper.__name__ = getattr(fn, "__name__", name)
+    return wrapper

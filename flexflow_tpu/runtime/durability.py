@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs.registry import REGISTRY
+from ..obs.tracing import get_tracer
 from .checkpoint import (CheckpointError, _fsync_dir, restore_checkpoint,
                          save_checkpoint, verify_checkpoint)
 
@@ -36,27 +37,25 @@ MANIFEST_NAME = "MANIFEST.json"
 MANIFEST_VERSION = 1
 
 # process-wide durability counters, exported on the serving /metrics
-# endpoint as ff_checkpoint_<kind>_total (same pattern as the plan
-# sanitizer's diagnostic_counters)
-_COUNTS: Dict[str, int] = {}
-_COUNTS_LOCK = threading.Lock()
+# endpoint as ff_checkpoint_<kind>_total — backed by the obs metrics
+# registry; the accessors below are the pre-registry API kept as shims
+_COUNTER_PREFIX = "ff_checkpoint_"
 
 
 def _bump(kind: str, n: int = 1) -> None:
-    with _COUNTS_LOCK:
-        _COUNTS[kind] = _COUNTS.get(kind, 0) + n
+    REGISTRY.counter(
+        f"{_COUNTER_PREFIX}{kind}_total",
+        f"Durable checkpoint events: {kind}").inc(n)
 
 
 def checkpoint_counters() -> Dict[str, int]:
     """Snapshot of the process-wide checkpoint counters: saved, restored,
     verified, corrupt, fallback, gc_removed."""
-    with _COUNTS_LOCK:
-        return dict(_COUNTS)
+    return REGISTRY.counters_with_prefix(_COUNTER_PREFIX)
 
 
 def reset_checkpoint_counters() -> None:
-    with _COUNTS_LOCK:
-        _COUNTS.clear()
+    REGISTRY.reset_all(prefix=_COUNTER_PREFIX)
 
 
 class DurableCheckpointer:
@@ -119,8 +118,9 @@ class DurableCheckpointer:
         """Atomic checkpoint write + manifest update + retention GC.
         Returns the checkpoint path."""
         fname = f"ckpt_{step:06d}.npz"
-        path = save_checkpoint(os.path.join(self.directory, fname), model,
-                               step=step)
+        with get_tracer().span("checkpoint.save", step=int(step)):
+            path = save_checkpoint(os.path.join(self.directory, fname),
+                                   model, step=step)
         _bump("saved")
         # re-saving a step (a replay after rollback/recovery) overwrites
         # the file; dedup the manifest entry so it appears once, as newest
@@ -173,8 +173,10 @@ class DurableCheckpointer:
     def restore_latest(self, model) -> Tuple[int, str]:
         """Restore the newest VERIFIED checkpoint into the model (in
         place). Returns (step, path)."""
-        step, path = self.latest_verified()
-        # already verified above; skip the second full read
-        restore_checkpoint(path, model, verify=False)
+        with get_tracer().span("checkpoint.restore") as sp:
+            step, path = self.latest_verified()
+            sp.set(step=int(step))
+            # already verified above; skip the second full read
+            restore_checkpoint(path, model, verify=False)
         _bump("restored")
         return step, path

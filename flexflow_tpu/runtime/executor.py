@@ -20,6 +20,7 @@ import numpy as np
 from ..core.graph import Graph
 from ..core.op import LoweringContext
 from ..ffconst import CompMode, OpType
+from ..obs.tracing import traced_dispatch
 from ..ops.common import emit_dtype
 from .metrics import Metrics
 
@@ -307,7 +308,10 @@ class Executor:
         fn = jax.jit(train_step, donate_argnums=donate)
         if self.step_wrapper is not None:
             fn = self.step_wrapper(fn)
-        self._train_step = fn
+        # span per host-side dispatch (outermost, so retries under the
+        # elastic wrapper are inside the span); a no-op while tracing is
+        # disabled
+        self._train_step = traced_dispatch(fn, "executor.train_step")
         return self._train_step
 
     def build_multi_step(self, optimizer, loss_fn, metrics: Metrics,
@@ -344,7 +348,7 @@ class Executor:
         fn = jax.jit(multi_step, donate_argnums=donate)
         if self.step_wrapper is not None:
             fn = self.step_wrapper(fn)
-        self._multi_step = fn
+        self._multi_step = traced_dispatch(fn, "executor.multi_step")
         return self._multi_step
 
     def build_eval_step(self, loss_fn, metrics: Metrics, final_tensor):
@@ -357,7 +361,8 @@ class Executor:
             mvals["loss"] = loss_fn(pred, label)
             return mvals, pred
 
-        self._eval_step = jax.jit(eval_step)
+        self._eval_step = traced_dispatch(jax.jit(eval_step),
+                                          "executor.eval_step")
         return self._eval_step
 
     def build_forward(self, final_tensor, mode: CompMode = CompMode.COMP_MODE_INFERENCE,
@@ -373,7 +378,8 @@ class Executor:
             )
             return values[final_tensor.guid], new_state
 
-        self._forward_jit = jax.jit(fwd)
+        self._forward_jit = traced_dispatch(jax.jit(fwd),
+                                            "executor.forward")
         return self._forward_jit
 
     def build_grad_step(self, loss_fn, final_tensor,

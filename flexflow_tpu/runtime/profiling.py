@@ -16,7 +16,6 @@ reference parity (SURVEY.md §5 "Tracing / profiling"):
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -83,42 +82,56 @@ def print_profile(rows: List[Dict], top: Optional[int] = 20) -> None:
 def print_event_log(events, sink=print, tail: Optional[int] = None) -> None:
     """Render an elastic EventLog (elastic/events.py) next to the timing
     output: one line per fault/retry/recovery record, then the per-kind
-    counts. tail=N limits to the last N events."""
-    evs = events.events()
-    if tail is not None:
-        evs = evs[-tail:]
-    if not evs:
+    counts. tail=N limits to the last N events; tail=0 shows no per-event
+    lines, only the counts summary (`evs[-0:]` would be the FULL list, so
+    zero is handled explicitly)."""
+    all_evs = events.events()
+    evs = all_evs if tail is None else (all_evs[-tail:] if tail > 0 else [])
+    if not all_evs:
         sink("elastic: no events")
         return
-    t0 = evs[0].time_s
-    for e in evs:
-        details = " ".join(f"{k}={v}" for k, v in sorted(e.details.items()))
-        sink(f"+{e.time_s - t0:8.3f}s step {e.step:>5} "
-             f"{e.kind:<22} {details}")
+    if evs:
+        t0 = evs[0].time_s
+        for e in evs:
+            details = " ".join(
+                f"{k}={v}" for k, v in sorted(e.details.items()))
+            sink(f"+{e.time_s - t0:8.3f}s step {e.step:>5} "
+                 f"{e.kind:<22} {details}")
     sink(events.summary())
 
 
 class IterationTimer:
     """Rolling per-iteration wall timing (reference: per-`--print-freq`
-    samples/s prints in the examples)."""
+    samples/s prints in the examples).
+
+    Kept as a thin compatibility wrapper: the internals now live in
+    `obs.StepStats` (FFModel.fit records there directly), which also
+    guards the dt == 0 case — consecutive ticks inside one clock quantum
+    (fast no-op steps on CPU CI) report 0 samples/s instead of dividing
+    by zero."""
 
     def __init__(self, batch_size: int, print_freq: int = 10,
                  sink=print):
+        from ..obs.registry import MetricsRegistry
+        from ..obs.stepstats import StepStats
+
         self.batch_size = batch_size
         self.print_freq = print_freq
         self.sink = sink
-        self._t0 = None
-        self._count = 0
+        # isolated registry: a user-driven timer (eval loops etc.) must
+        # not inflate the process-wide ff_train_steps_total/ff_step_*
+        # families that FFModel.fit's own StepStats publishes
+        self._stats = StepStats(print_freq=print_freq, sink=sink,
+                                registry=MetricsRegistry())
+        self._started = False
+
+    @property
+    def _count(self) -> int:
+        return self._stats.total_steps
 
     def tick(self):
-        now = time.perf_counter()
-        if self._t0 is None:
-            self._t0 = now
+        if not self._started:
+            self._stats.start()
+            self._started = True
             return
-        self._count += 1
-        if self._count % self.print_freq == 0:
-            dt = now - self._t0
-            self.sink(
-                f"iter {self._count}: {self.print_freq * self.batch_size / dt:.1f}"
-                f" samples/s ({dt / self.print_freq * 1e3:.1f} ms/iter)")
-            self._t0 = now
+        self._stats.record_step(self.batch_size)

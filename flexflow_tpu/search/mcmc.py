@@ -77,6 +77,17 @@ def mcmc_search(graph: Graph, config, machine: MachineModel,
     annealed strategy across factorizations wins (costed by the same
     Simulator — measured costs auto-enabled on real accelerators exactly
     as unity_optimize does — so the two searches are comparable)."""
+    from ..obs.tracing import get_tracer
+
+    with get_tracer().span("search", algo="mcmc", n_devices=n_devices):
+        return _mcmc_search_inner(graph, config, machine, batch_size,
+                                  n_devices, simulator)
+
+
+def _mcmc_search_inner(graph: Graph, config, machine: MachineModel,
+                       batch_size: int, n_devices: int,
+                       simulator: Optional[Simulator] = None
+                       ) -> SearchResult:
     from .substitution import (
         apply_substitutions,
         load_rule_spec,
@@ -133,4 +144,6 @@ def mcmc_search(graph: Graph, config, machine: MachineModel,
             best = r
     best.log = log + [f"mcmc selected: {best.mesh_axes} "
                       f"cost={best.cost_us:.1f}us"]
+    # calibration anchor (obs/calibration.py), same as the Unity path
+    best.predicted_step_us = best.cost_us
     return best

@@ -208,6 +208,12 @@ class SearchResult:
     cost_us: float
     memory_bytes: float
     log: List[str]
+    # the simulator's predicted per-step cost for the SELECTED plan —
+    # recorded so post-compile calibration (obs/calibration.py) can put
+    # prediction and measured step wall time side by side. Set by the
+    # search entry points from cost_us; a separate field because cost_us
+    # may later carry objective terms (lambda * memory) that are not time
+    predicted_step_us: Optional[float] = None
     # graph rewrites the search MATERIALIZED before choosing strategies —
     # exported so the --import path can replay them and op names match
     # (reference analog: the imported strategy file keys by guid hashes
@@ -346,6 +352,21 @@ class GraphSearchHelper:
     def graph_optimize(self, batch_size: int, n_devices: int,
                        memory_budget_bytes: Optional[float] = None,
                        rule_spec=None) -> SearchResult:
+        from ..obs.tracing import get_tracer
+
+        with get_tracer().span("search", n_devices=n_devices,
+                               batch_size=batch_size) as sp:
+            result = self._graph_optimize_inner(batch_size, n_devices,
+                                                memory_budget_bytes,
+                                                rule_spec)
+            sp.set(cost_us=result.cost_us, axes=result.mesh_axes,
+                   simulated=result.candidates_simulated,
+                   pruned=result.candidates_pruned)
+            return result
+
+    def _graph_optimize_inner(self, batch_size: int, n_devices: int,
+                              memory_budget_bytes: Optional[float] = None,
+                              rule_spec=None) -> SearchResult:
         from .substitution import (
             apply_substitutions,
             load_rule_spec,
@@ -422,6 +443,9 @@ class GraphSearchHelper:
             best.greedy_search_rules = True
         best.candidates_simulated = self.candidates_simulated
         best.candidates_pruned = self.candidates_pruned
+        # calibration anchor (obs/calibration.py): the selected plan's
+        # predicted step cost, compared post-compile with measured steps
+        best.predicted_step_us = best.cost_us
         self.log.append(
             f"plan sanitizer: {self.candidates_simulated} factorization(s) "
             f"simulated, {self.candidates_pruned} pruned before costing")
@@ -433,6 +457,9 @@ class GraphSearchHelper:
         lam * memory objective: enumerate mesh factorizations, segment-DP
         each (reference: Graph::optimal_cost via the DP in graph.cc:1586;
         lam is the lambda of the memory-aware search, graph.cc:2075)."""
+        from ..obs.tracing import get_tracer
+
+        tracer = get_tracer()
         candidates: List[SearchResult] = []
         # plan-sanitizer pruning (analysis/passes.py): the cheap
         # factorization pass rejects infeasible mesh tuples — non-dividing
@@ -464,18 +491,23 @@ class GraphSearchHelper:
         if self.config.only_data_parallel:
             tuples = [(n_devices, 1, 1, 1, 1)]
         feasible = []
-        for fact in tuples:
-            if prune:
-                if factorization_diagnostics(graph, self.config, batch_size,
-                                             fact, sp_pred=sp_feasible,
-                                             expert_counts=expert_counts,
-                                             has_spatial=has_spatial):
-                    self.candidates_pruned += 1
-                    continue
-            elif fact[4] > 1 and (sp_feasible is None
-                                  or not sp_feasible(fact[4])):
-                fact = fact[:4] + (1,)
-            feasible.append(fact)
+        with tracer.span("search.enumerate", n_devices=n_devices,
+                         candidates=len(tuples)) as _sp_enum:
+            for fact in tuples:
+                if prune:
+                    if factorization_diagnostics(
+                            graph, self.config, batch_size, fact,
+                            sp_pred=sp_feasible,
+                            expert_counts=expert_counts,
+                            has_spatial=has_spatial):
+                        self.candidates_pruned += 1
+                        continue
+                elif fact[4] > 1 and (sp_feasible is None
+                                      or not sp_feasible(fact[4])):
+                    fact = fact[:4] + (1,)
+                feasible.append(fact)
+            _sp_enum.set(feasible=len(feasible),
+                         pruned=len(tuples) - len(feasible))
         # Stage 1 (cheap): per-segment DP + one full-graph simulate per mesh
         # factorization. Stage 2 (expensive): the cross-segment best-first
         # refinement — O(budget x boundary-ops x menu x simulate) — runs
@@ -485,17 +517,19 @@ class GraphSearchHelper:
         # (reference analog: graph.cc's memoized DP exists precisely to
         # keep the 100+-op x many-machine-view regime tractable).
         seeded = []
-        for dp, tp, ep, ap, sp in feasible:
-            self.candidates_simulated += 1
-            strategies: Dict[int, OpStrategy] = {}
-            for seg in self._segments(graph):
-                strategies.update(
-                    self._optimize_segment(seg, dp, tp, batch_size,
-                                           ep=ep, ap=ap, sp=sp, lam=lam))
-            cost = self.sim.simulate(graph, strategies)
-            mem = self.sim.memory_bytes(graph, strategies)
-            seeded.append((cost + lam * mem, (dp, tp, ep, ap, sp),
-                           strategies, cost, mem))
+        with tracer.span("search.simulate", factorizations=len(feasible)):
+            for dp, tp, ep, ap, sp in feasible:
+                self.candidates_simulated += 1
+                strategies: Dict[int, OpStrategy] = {}
+                for seg in self._segments(graph):
+                    strategies.update(
+                        self._optimize_segment(seg, dp, tp, batch_size,
+                                               ep=ep, ap=ap, sp=sp,
+                                               lam=lam))
+                cost = self.sim.simulate(graph, strategies)
+                mem = self.sim.memory_bytes(graph, strategies)
+                seeded.append((cost + lam * mem, (dp, tp, ep, ap, sp),
+                               strategies, cost, mem))
         seeded.sort(key=lambda x: x[0])
         top_k = max(1, int(getattr(self.config, "refine_top_k", 4)))
         for rank, (obj, (dp, tp, ep, ap, sp), strategies, cost,
@@ -506,9 +540,12 @@ class GraphSearchHelper:
                 # column->row TP pairing on a chain, where every node is
                 # its own segment) — re-optimize single-op flips against
                 # the FULL-graph simulate
-                strategies = self._refine_global(graph, strategies, dp, tp,
-                                                 batch_size, ep, ap, lam,
-                                                 sp=sp)
+                with tracer.span("search.refine",
+                                 factorization=f"dp={dp},tp={tp},ep={ep},"
+                                               f"ap={ap},sp={sp}"):
+                    strategies = self._refine_global(
+                        graph, strategies, dp, tp, batch_size, ep, ap,
+                        lam, sp=sp)
                 cost = self.sim.simulate(graph, strategies)
                 mem = self.sim.memory_bytes(graph, strategies)
             candidates.append(
@@ -905,12 +942,21 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
         from .. import native
 
         if native.available():
-            applied = apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
-            result = native.optimize_strategy(
-                graph, config, machine, batch_size, n_devices
-            )
+            from ..obs.tracing import get_tracer
+
+            # the native core runs enumerate/prune/simulate internally;
+            # one "search" span still marks the phase in the trace
+            with get_tracer().span("search", backend="native",
+                                   n_devices=n_devices) as sp:
+                applied = apply_substitutions(
+                    graph, rule_set_from_spec(spec, is_taso))
+                result = native.optimize_strategy(
+                    graph, config, machine, batch_size, n_devices
+                )
+                sp.set(cost_us=result.cost_us, axes=result.mesh_axes)
             if applied:
                 result.log.append(f"substitutions: {applied}")
+            result.predicted_step_us = result.cost_us
             return result
     helper = GraphSearchHelper(graph, config, machine, simulator)
     budget = None

@@ -15,6 +15,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs.registry import REGISTRY, MetricsRegistry
+from ..obs.tracing import get_tracer
 from .batcher import DynamicBatcher
 from .model import InferenceModel
 
@@ -22,39 +24,112 @@ from .model import InferenceModel
 class ModelMetrics:
     """Per-model request metrics (the Triton metrics-endpoint role):
     request/failure counts and latency aggregates, exported as JSON stats
-    and Prometheus-style text."""
+    and — via the server's MetricsRegistry (obs/registry.py) — as
+    `ff_inference_requests_total` / `ff_inference_failures_total` /
+    `ff_inference_latency_ms` series on /metrics. The class keeps its
+    pre-registry `record()`/`stats()` API; it is now a thin per-model
+    view over the registry families plus a max-latency aggregate the
+    exposition format has no primitive for."""
 
-    def __init__(self):
-        self.requests = 0
-        self.failures = 0
-        self.total_ms = 0.0
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 model: str = ""):
+        self.model = model
+        reg = registry if registry is not None else MetricsRegistry()
+        (self._requests, self._failures, self._avg,
+         self._latency) = _inference_families(reg)
         self.max_ms = 0.0
         self._lock = threading.Lock()
+        # a fresh ModelMetrics starts from zero even when the name was
+        # served before (register() after unregister(), or a repository
+        # reload): pre-registry behavior, and stats() must not mix two
+        # lifetimes (old requests with a reset max_ms). Zero-valued
+        # request/failure/avg series are then re-seeded so a freshly
+        # registered, idle model still renders on /metrics (dashboards
+        # join on series existence) — also pre-registry behavior.
+        self.remove_series()
+        self._requests.inc(0, model=model)
+        self._failures.inc(0, model=model)
+        self._avg.set(0.0, model=model)
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value(model=self.model))
+
+    @property
+    def failures(self) -> int:
+        return int(self._failures.value(model=self.model))
 
     def record(self, ms: float, ok: bool) -> None:
-        with self._lock:
-            self.requests += 1
-            if not ok:
-                self.failures += 1
-            else:
-                self.total_ms += ms
+        self._requests.inc(model=self.model)
+        if not ok:
+            self._failures.inc(model=self.model)
+        else:
+            self._latency.observe(ms, model=self.model)
+            with self._lock:
                 self.max_ms = max(self.max_ms, ms)
 
     def stats(self) -> Dict[str, float]:
+        # the three families lock independently, so a concurrent record()
+        # can land between reads. Read failures BEFORE requests: done can
+        # then only over-count by an in-flight success whose latency sum
+        # is still pending — the avg skews transiently low instead of a
+        # success being mis-bucketed as a failure (done = 0 with recorded
+        # latency). max(done, 0) guards the remaining race.
+        failures = self.failures
+        requests = self.requests
+        done = max(0, requests - failures)
+        total_ms = self._latency.sum(model=self.model)
+        return {
+            "requests": requests,
+            "failures": failures,
+            "avg_latency_ms": round(total_ms / done, 3) if done else 0.0,
+            "max_latency_ms": round(self.max_ms, 3),
+        }
+
+    def remove_series(self) -> None:
+        """Drop this model's series from the registry (unregister, or a
+        fresh registration under the same name) so stale values neither
+        render on /metrics nor seed the next incarnation's stats."""
+        for fam in (self._requests, self._failures, self._avg,
+                    self._latency):
+            fam.remove(model=self.model)
         with self._lock:
-            done = self.requests - self.failures
-            return {
-                "requests": self.requests,
-                "failures": self.failures,
-                "avg_latency_ms": round(self.total_ms / done, 3) if done else 0.0,
-                "max_latency_ms": round(self.max_ms, 3),
-            }
+            self.max_ms = 0.0
+
+
+def _inference_families(reg: MetricsRegistry):
+    """The per-server inference metric families, registered eagerly so
+    /metrics always carries their TYPE headers (pre-registry behavior)."""
+    return (
+        reg.counter("ff_inference_requests_total",
+                    "Inference requests", labels=("model",)),
+        reg.counter("ff_inference_failures_total",
+                    "Failed inference requests", labels=("model",)),
+        reg.gauge("ff_inference_avg_latency_ms",
+                  "Mean successful-request latency", labels=("model",)),
+        reg.histogram("ff_inference_latency_ms",
+                      "Successful-request latency distribution",
+                      labels=("model",)),
+    )
 
 
 class InferenceServer:
     def __init__(self):
         self._models: Dict[str, DynamicBatcher] = {}
         self._metrics: Dict[str, ModelMetrics] = {}
+        self._start_time = time.time()
+        # per-server metric registry: per-model series live here (two
+        # servers in one process must not cross-pollute each other's
+        # request counts); process-wide families (ff_plan_diagnostics,
+        # ff_checkpoint_*, ff_watchdog_*, step stats) render from the
+        # default registry — prometheus_text() concatenates both through
+        # the one shared exposition renderer
+        self.registry = MetricsRegistry()
+        _inference_families(self.registry)
+        self._load_failures_counter = self.registry.counter(
+            "ff_model_load_failures_total",
+            "Repository scans that failed to load a model",
+            labels=("model",))
         # name -> (GenerativeSession, lock, policy dict): sessions
         # serialize on their device state chain (one request at a time per
         # session); the policy dict holds the registration-time decode
@@ -64,10 +139,9 @@ class InferenceServer:
         # /metrics when attached
         self._elastic_events = None
         # models a repository scan failed to load: name -> latest error
-        # string, plus a cumulative per-model failure count (serving keeps
-        # running on the models that DID load)
+        # string (serving keeps running on the models that DID load); the
+        # cumulative per-model failure counts live on the registry family
         self._load_failures: Dict[str, str] = {}
-        self._load_failure_counts: Dict[str, int] = {}
 
     def record_load_failure(self, name: str, error: BaseException) -> None:
         """Note a model the repository could not load; surfaced in stats()
@@ -75,8 +149,7 @@ class InferenceServer:
         repeated scans so rate()-style alerting keeps firing while the
         entry stays broken."""
         self._load_failures[name] = f"{type(error).__name__}: {error}"
-        self._load_failure_counts[name] = \
-            self._load_failure_counts.get(name, 0) + 1
+        self._load_failures_counter.inc(model=name)
 
     def attach_elastic_events(self, events) -> None:
         """Surface an elastic EventLog's per-kind counters on the metrics
@@ -92,12 +165,24 @@ class InferenceServer:
                                  max_delay_ms=max_delay_ms)
         batcher.start()
         self._models[name] = batcher
-        self._metrics[name] = ModelMetrics()
+        self._metrics[name] = ModelMetrics(self.registry, name)
+
+    def _metrics_for(self, name: str) -> ModelMetrics:
+        """Existing ModelMetrics for `name`, or a fresh one — constructed
+        LAZILY: ModelMetrics.__init__ zeroes the model's series, so an
+        eagerly-built setdefault default would wipe live counters on
+        every call."""
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = ModelMetrics(self.registry, name)
+        return m
 
     def unregister(self, name: str) -> None:
         b = self._models.pop(name, None)
         self._generative.pop(name, None)
-        self._metrics.pop(name, None)
+        m = self._metrics.pop(name, None)
+        if m is not None:
+            m.remove_series()
         if b:
             b.stop()
 
@@ -114,7 +199,8 @@ class InferenceServer:
         metrics = self._metrics.get(name)
         t0 = time.perf_counter()
         try:
-            out = batcher.infer(inputs, timeout=timeout)
+            with get_tracer().span("serve.infer", model=name):
+                out = batcher.infer(inputs, timeout=timeout)
         except Exception:
             if metrics is not None:
                 metrics.record(0.0, ok=False)
@@ -146,7 +232,7 @@ class InferenceServer:
             session, threading.Lock(),
             {"tokens_per_dispatch": max(1, int(tokens_per_dispatch)),
              "temperature": float(temperature), "top_k": top_k})
-        self._metrics.setdefault(name, ModelMetrics())
+        self._metrics_for(name)
 
     def generate(self, name: str, prompt_ids: np.ndarray,
                  max_new_tokens: int, eos_id: Optional[int] = None,
@@ -154,11 +240,11 @@ class InferenceServer:
         if name not in self._generative:
             raise KeyError(f"no generative session {name!r}")
         session, lock, policy = self._generative[name]
-        metrics = self._metrics.setdefault(name, ModelMetrics())
+        metrics = self._metrics_for(name)
         t0 = time.perf_counter()
         ok = False
         try:
-            with lock:
+            with lock, get_tracer().span("serve.generate", model=name):
                 # partial batches are handled by the session itself
                 # (padding by tiling; rows decode independently); its
                 # ValueErrors describe malformed client prompts
@@ -215,45 +301,26 @@ class InferenceServer:
         return watchdog_counters()
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition-format metrics (the Triton /metrics role)."""
-        lines = [
-            "# TYPE ff_inference_requests_total counter",
-            "# TYPE ff_inference_failures_total counter",
-            "# TYPE ff_inference_avg_latency_ms gauge",
-        ]
-        def esc(v: str) -> str:  # Prometheus label-value escaping
-            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
+        """Prometheus exposition-format metrics (the Triton /metrics
+        role). One renderer — `MetricsRegistry.render()` — over two
+        registries: this server's per-model families plus the process-wide
+        default registry, which carries `ff_plan_diagnostics_total`,
+        `ff_checkpoint_*`, `ff_watchdog_*`, and the training step stats
+        without any per-family code here. Derived/mirrored series
+        (avg-latency gauge, elastic event counts) are synced right before
+        rendering so a scrape is point-in-time consistent."""
+        avg = self.registry.gauge("ff_inference_avg_latency_ms",
+                                  "Mean successful-request latency",
+                                  labels=("model",))
         for n, m in sorted(self._metrics.items()):
-            s = m.stats()
-            n = esc(n)
-            lines.append(f'ff_inference_requests_total{{model="{n}"}} {s["requests"]}')
-            lines.append(f'ff_inference_failures_total{{model="{n}"}} {s["failures"]}')
-            lines.append(f'ff_inference_avg_latency_ms{{model="{n}"}} {s["avg_latency_ms"]}')
-        if self._load_failure_counts:
-            lines.append("# TYPE ff_model_load_failures_total counter")
-            for n, count in sorted(self._load_failure_counts.items()):
-                lines.append(
-                    f'ff_model_load_failures_total{{model="{esc(n)}"}} '
-                    f"{count}")
-        out = "\n".join(lines) + "\n"
+            avg.set(m.stats()["avg_latency_ms"], model=n)
         if self._elastic_events is not None:
-            out += self._elastic_events.prometheus_text()
-        analysis = self._analysis_counters()
-        if analysis:
-            out += "# TYPE ff_plan_diagnostics_total counter\n"
-            for code, n in sorted(analysis.items()):
-                out += (f'ff_plan_diagnostics_total{{code="{esc(code)}"}}'
-                        f" {n}\n")
-        # durability + watchdog counters (ISSUE 3): ff_checkpoint_*_total
-        # and ff_watchdog_*_total, process-wide like the analysis counters
-        for prefix, counters in (
-                ("ff_checkpoint", self._durability_counters()),
-                ("ff_watchdog", self._watchdog_counters())):
-            for kind, n in sorted(counters.items()):
-                out += (f"# TYPE {prefix}_{kind}_total counter\n"
-                        f"{prefix}_{kind}_total {n}\n")
-        return out
+            c = self.registry.counter(
+                "ff_elastic_events_total",
+                "Elastic runtime events by kind", labels=("kind",))
+            for kind, n in self._elastic_events.counts().items():
+                c.set_total(n, kind=kind)
+        return self.registry.render() + REGISTRY.render()
 
     def shutdown(self):
         for name in list(self._models) + list(self._generative):
@@ -284,6 +351,18 @@ class InferenceServer:
                 parts = self.path.strip("/").split("/")
                 if self.path == "/v2/models":
                     self._reply(200, {"models": server_ref.models()})
+                elif self.path == "/healthz":
+                    # liveness + readiness in one: 200 with the serving
+                    # inventory; a registered-but-empty server is still
+                    # healthy (Triton's /v2/health/ready role)
+                    self._reply(200, {
+                        "status": "ok",
+                        "models": server_ref.models(),
+                        "generative": sorted(server_ref._generative),
+                        "load_failures": sorted(server_ref._load_failures),
+                        "uptime_s": round(
+                            time.time() - server_ref._start_time, 3),
+                    })
                 elif self.path == "/metrics":
                     body = server_ref.prometheus_text().encode()
                     self.send_response(200)

@@ -11,3 +11,19 @@ created."""
 from flexflow_tpu.runtime.platform import force_platform
 
 force_platform("cpu", n_host_devices=8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs_state():
+    """Process-wide observability state must not leak between tests: one
+    obs.reset_all() zeroes every registry counter family (plan
+    diagnostics, checkpoint, watchdog, step stats) and drops buffered
+    trace spans — replacing the three separate reset_*_counters calls
+    tests previously had to remember."""
+    import flexflow_tpu.obs as obs
+
+    obs.reset_all()
+    yield
+    obs.reset_all()

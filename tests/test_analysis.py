@@ -444,7 +444,9 @@ def test_metrics_export_analyzer_counters():
     assert 'ff_plan_diagnostics_total{code="FFTA023"} 1' in text
     assert server.stats()["_analysis"]["FFTA023"] == 1
     reset_counters()
-    assert "ff_plan_diagnostics_total" not in server.prometheus_text()
+    # post-reset the registry keeps the family registered (TYPE/HELP
+    # headers may render) but every per-code sample is gone
+    assert "ff_plan_diagnostics_total{" not in server.prometheus_text()
 
 
 # ---------------------------------------------------------------------
