@@ -94,6 +94,12 @@ def main(argv=None):
         from .obs.cli import run_profile
 
         raise SystemExit(run_profile(argv[1:]))
+    # serving load test: continuous batching vs the lockstep generation
+    # path on a mixed-length workload (docs/serving.md)
+    if argv and argv[0] == "serve-bench":
+        from .serving.sched.bench import run_bench
+
+        raise SystemExit(run_bench(argv[1:]))
     # script mode: first non-flag arg ending in .py
     script = next((a for a in argv if a.endswith(".py")), None)
     if script is not None:

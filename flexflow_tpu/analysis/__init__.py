@@ -9,13 +9,16 @@ Public surface:
  - factorization_diagnostics — the cheap per-candidate check the Unity
    search prunes with;
  - diagnostic_counters — process-wide per-code counters, exported on the
-   serving /metrics endpoint.
+   serving /metrics endpoint;
+ - plan_memory_bytes — the memory model behind the FFTA010/011 fit gate,
+   also used to size the serving KV-cache pool against HBM
+   (serving/sched/kvpool.py).
 """
 from .diagnostics import (CODE_CATALOG, Diagnostic, DiagnosticReport,
                           PlanAnalysisError, Severity, diagnostic_counters,
                           make_diag, record_report, reset_counters)
 from .passes import (AnalysisContext, default_strategies_for,
-                     factorization_diagnostics)
+                     factorization_diagnostics, plan_memory_bytes)
 from .pipeline import (ALL_PASSES, CHEAP_PASSES, PASS_REGISTRY,
                        analyze_plan, check_plan)
 
@@ -35,6 +38,7 @@ __all__ = [
     "diagnostic_counters",
     "factorization_diagnostics",
     "make_diag",
+    "plan_memory_bytes",
     "record_report",
     "reset_counters",
 ]

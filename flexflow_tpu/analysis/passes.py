@@ -236,18 +236,25 @@ def factorization_diagnostics(graph: Graph, config, batch_size: int,
 # ---------------------------------------------------------------------
 # pass 2: memory fit
 # ---------------------------------------------------------------------
-def pass_memory_fit(ctx: AnalysisContext) -> List[Diagnostic]:
-    if ctx.machine is None:
-        return []
+def plan_memory_bytes(graph: Graph, machine, config=None, strategies=None,
+                      optimizer_state_factor: Optional[float] = None):
+    """Per-chip bytes of a plan (sharded weights x optimizer-state factor +
+    saved activations) via CostModel.op_memory_bytes. Returns
+    (total_bytes, worst_op, worst_op_bytes). Shared by the FFTA010/011
+    memory-fit gate below and the serving KV-pool sizing
+    (serving/sched/kvpool.py), so "what fits in HBM" has ONE definition.
+    optimizer_state_factor=1.0 sizes an inference deployment (weights
+    only, no optimizer moments)."""
     from ..search.simulator import CostModel, OpStrategy
-    from .diagnostics import Severity
 
-    cost = CostModel(ctx.machine, ctx.config)
+    cost = CostModel(machine, config)
+    if optimizer_state_factor is not None:
+        cost.opt_state_factor = float(optimizer_state_factor)
     default = OpStrategy()
     total = 0.0
     worst_op, worst_bytes = None, -1.0
-    for op in ctx.graph.ops.values():
-        s = ctx.strategy_of(op) or default
+    for op in graph.ops.values():
+        s = (strategies or {}).get(op.guid) or default
         try:
             b = cost.op_memory_bytes(op, s)
         except Exception:
@@ -255,6 +262,16 @@ def pass_memory_fit(ctx: AnalysisContext) -> List[Diagnostic]:
         total += b
         if b > worst_bytes:
             worst_op, worst_bytes = op, b
+    return total, worst_op, worst_bytes
+
+
+def pass_memory_fit(ctx: AnalysisContext) -> List[Diagnostic]:
+    if ctx.machine is None:
+        return []
+    from .diagnostics import Severity
+
+    total, worst_op, worst_bytes = plan_memory_bytes(
+        ctx.graph, ctx.machine, ctx.config, ctx.strategies)
     cap = ctx.machine.memory_budget_bytes()
     # an explicitly set --memory-budget is authoritative, the way the
     # memory-aware Unity/MCMC searches treat it — the gate and the search

@@ -306,22 +306,35 @@ class MultiHeadAttentionOp(Op):
     def _decode_step(self, ctx, q, k, v, weights, scale):
         """One incremental-decoding step: q/k/v are projections of the single
         new token (B, 1, h, d); the K/V caches (B, M, h, d) are updated at
-        decode_pos and attended with a <= pos mask."""
+        decode_pos and attended with a <= pos mask.
+
+        decode_pos may be a traced SCALAR (every row at the same position —
+        the lockstep GenerativeSession path) or a traced (B,) VECTOR of
+        per-row positions (continuous batching, serving/sched/continuous.py:
+        each slot decodes its own sequence, so slot i writes its K/V at
+        pos[i] and masks to its own length)."""
         pos = ctx.decode_pos
         kc = ctx.state[(self.name, "k_cache")]
         vc = ctx.state[(self.name, "v_cache")]
-        kc = jax.lax.dynamic_update_slice(
-            kc, k.astype(kc.dtype), (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(
-            vc, v.astype(vc.dtype), (0, pos, 0, 0))
+        if getattr(pos, "ndim", 0) == 1:
+            rows = jnp.arange(kc.shape[0])
+            kc = kc.at[rows, pos].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, pos].set(v[:, 0].astype(vc.dtype))
+            mask = jnp.arange(kc.shape[1])[None, :] <= pos[:, None]  # (B, M)
+            mask = mask[:, None, None, :]
+        else:
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (0, pos, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, pos, 0, 0))
+            mask = (jnp.arange(kc.shape[1]) <= pos)[None, None, None, :]
         ctx.state_updates[(self.name, "k_cache")] = kc
         ctx.state_updates[(self.name, "v_cache")] = vc
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, kc.astype(q.dtype),
             preferred_element_type=jnp.float32,
         ) * scale  # (B, h, 1, M)
-        mask = jnp.arange(kc.shape[1]) <= pos
-        logits = jnp.where(mask[None, None, None, :], logits, -1e30)
+        logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
                           vc.astype(q.dtype))

@@ -12,12 +12,22 @@ the same FFModel/PCG core instead of a parallel re-implementation:
   coalescing, the role of Triton's dynamic_batching scheduler.
 - InferenceServer (serving/server.py): multi-model registry + optional
   stdlib HTTP JSON endpoint (the Triton server role).
+- sched/ (serving/sched/): continuous-batching generation — PagedKVPool,
+  iteration-level ContinuousBatcher, AdmissionController backpressure,
+  and the `serve-bench` load harness (docs/serving.md).
 """
 from .model import InferenceModel
-from .batcher import DynamicBatcher
+from .batcher import BatcherStopped, DynamicBatcher
 from .server import InferenceServer, ModelMetrics
 from .repository import ModelRepository
 from .optimize import fold_batchnorm
+from .sched import (AdmissionController, AdmissionError, ContinuousBatcher,
+                    GenRequest, PagedKVPool, PoolSaturated, QueueFull,
+                    RequestCancelled, RequestState, RequestTooLarge)
 
-__all__ = ["InferenceModel", "DynamicBatcher", "InferenceServer",
-           "ModelMetrics", "ModelRepository", "fold_batchnorm"]
+__all__ = ["InferenceModel", "DynamicBatcher", "BatcherStopped",
+           "InferenceServer", "ModelMetrics", "ModelRepository",
+           "fold_batchnorm", "AdmissionController", "AdmissionError",
+           "ContinuousBatcher", "GenRequest", "PagedKVPool",
+           "PoolSaturated", "QueueFull", "RequestCancelled",
+           "RequestState", "RequestTooLarge"]

@@ -12,6 +12,27 @@ import numpy as np
 from ..ffconst import CompMode, OpType
 
 
+def sampling_logits(probs, temperature: float, top_k):
+    """THE sampling policy core, shared by the lockstep batched `_pick`
+    and the continuous batcher's per-row pick (serving/sched/continuous
+    .py) so the two decode paths can never drift: log-probs at
+    `temperature`, optionally truncated to the top_k most likely tokens
+    via a kth-largest threshold (O(V log k), the hot decode path). Works
+    on (V,) rows and (b, V) batches alike."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.log(probs.astype(jnp.float32) + 1e-9) / temperature
+    if top_k is not None:
+        kk = int(top_k)
+        if kk < 1:
+            raise ValueError(f"top_k={top_k}: must be >= 1")
+        kk = min(kk, logits.shape[-1])
+        kth = jax.lax.top_k(logits, kk)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return logits
+
+
 class GenerativeSession:
     """Incremental decoding session over a compiled causal-transformer
     FFModel whose final tensor is a distribution over the vocabulary.
@@ -37,22 +58,19 @@ class GenerativeSession:
                          if op.op_type == OpType.MULTIHEAD_ATTENTION]
         if not self.attn_ops:
             raise ValueError("generation needs multihead_attention ops")
-        from ..ops.common import matmul_dtype
+        # ONE cache-geometry definition (heads/kdim/vdim + compute dtype —
+        # bf16 under mixed precision, the dominant serving memory) shared
+        # with the continuous batcher and the pool's HBM sizing
+        from .sched.kvpool import kv_cache_spec
 
         b = model.config.batch_size
-        self._caches: Dict[str, Dict[str, object]] = {}
-        for op in self.attn_ops:
-            heads = op.params["num_heads"]
-            kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
-            vdim = op.params.get("vdim") or op.params["embed_dim"] // heads
-            # cache in the attention compute dtype (bf16 under mixed
-            # precision): the KV cache is the dominant serving memory
-            cdt = matmul_dtype(model.config,
-                               op.inputs[0].dtype.jnp_dtype)
-            self._caches[op.name] = {
+        self._caches: Dict[str, Dict[str, object]] = {
+            name: {
                 "k_cache": jnp.zeros((b, self.max_len, heads, kdim), cdt),
                 "v_cache": jnp.zeros((b, self.max_len, heads, vdim), cdt),
             }
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(model)
+        }
 
         executor = model.executor
         final_guid = model.final_tensor.guid
@@ -88,16 +106,7 @@ class GenerativeSession:
 
         if temperature <= 0.0:
             return jnp.argmax(probs, axis=-1).astype(jnp.int32)
-        logits = jnp.log(probs.astype(jnp.float32) + 1e-9) / temperature
-        if top_k is not None:
-            kk = int(top_k)
-            if kk < 1:
-                raise ValueError(f"top_k={top_k}: must be >= 1")
-            kk = min(kk, logits.shape[-1])
-            # kth-largest threshold via lax.top_k (O(V log k), the hot
-            # decode path) rather than a full sort
-            kth = jax.lax.top_k(logits, kk)[0][:, -1:]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        logits = sampling_logits(probs, temperature, top_k)
         key = jax.random.fold_in(base_key, pos)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
@@ -169,9 +178,9 @@ class GenerativeSession:
         if n_real < b:
             # pad partial batches by tiling the last real prompt: rows
             # decode independently (each has its own KV-cache rows), so
-            # the real rows' tokens are exact; with an eos_id the early
-            # stop waits on the padded rows too — compute, not
-            # correctness, cost
+            # the real rows' tokens are exact; padded rows are marked
+            # finished from step 0 below, so an eos early stop never
+            # waits on them
             prompt_ids = np.concatenate(
                 [prompt_ids, np.tile(prompt_ids[-1:], (b - n_real, 1))],
                 axis=0)
@@ -203,6 +212,10 @@ class GenerativeSession:
 
         out = []
         finished = np.zeros(b, dtype=bool)
+        # padding rows are DONE before the first step: under sampling (or
+        # any future non-tiled padding) they would otherwise emit tokens
+        # of their own and hold the whole batch past the real rows' eos
+        finished[n_real:] = True
         K = max(1, int(tokens_per_dispatch))
         if K > 1:
             # chunked decode: tok holds the NEXT token to emit; each scan

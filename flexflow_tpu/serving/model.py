@@ -30,6 +30,14 @@ class InferenceModel:
     def input_names(self) -> List[str]:
         return [op.name for op in self.model.input_ops]
 
+    @property
+    def input_specs(self) -> Dict[str, tuple]:
+        """name -> trailing (per-row) dims of each input, the shape
+        contract DynamicBatcher.submit validates requests against so one
+        malformed request cannot fail a whole coalesced batch."""
+        return {op.name: tuple(op.outputs[0].dims[1:])
+                for op in self.model.input_ops}
+
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:

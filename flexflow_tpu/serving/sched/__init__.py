@@ -1,0 +1,35 @@
+"""Continuous-batching generation subsystem (ISSUE 5).
+
+Role parity: the iteration-level scheduling loop of Orca-style serving and
+the paged KV allocation of vLLM, grafted onto the repo's incremental
+decoding path (serving/generate.py) instead of the lockstep
+one-batch-at-a-time `GenerativeSession.generate`:
+
+ - `PagedKVPool` (kvpool.py): the KV cache block-allocated in fixed-size
+   pages with a per-sequence page table; capacity derived from the machine
+   spec's HBM via the analysis memory model (`analysis.plan_memory_bytes`).
+ - `ContinuousBatcher` (continuous.py): per-request state machine
+   (QUEUED -> PREFILL -> DECODE -> FINISHED); every decode iteration steps
+   ALL active slots at their own positions (the vector-decode_pos path in
+   ops/attention.py), finished requests free their slot and pages
+   immediately, and queued requests prefill into freed slots while the
+   rest keep decoding.
+ - `AdmissionController` (admission.py): bounded queue + admit-time page
+   budget so every accepted request can finish; typed backpressure the
+   HTTP endpoint maps to 429.
+ - `serve-bench` (bench.py): the load generator that measures the win
+   over the lockstep path (docs/serving.md).
+"""
+from .admission import (AdmissionController, AdmissionError, QueueFull,
+                        PoolSaturated, RequestTooLarge)
+from .continuous import (BatcherStopped, ContinuousBatcher, GenRequest,
+                         RequestCancelled, RequestState)
+from .kvpool import (PagedKVPool, PoolExhausted, derive_num_slots,
+                     kv_bytes_per_token, kv_cache_spec)
+
+__all__ = [
+    "AdmissionController", "AdmissionError", "QueueFull", "PoolSaturated",
+    "RequestTooLarge", "BatcherStopped", "ContinuousBatcher", "GenRequest",
+    "RequestCancelled", "RequestState", "PagedKVPool", "PoolExhausted",
+    "derive_num_slots", "kv_bytes_per_token", "kv_cache_spec",
+]

@@ -1,0 +1,176 @@
+"""Admission control for continuous-batching serving.
+
+The contract: a request is either rejected AT SUBMIT with a typed error
+(mapped to an HTTP status by server.py) or it is guaranteed to finish.
+The guarantee has two legs:
+
+ - STATIC: ``prompt`` must fit the prefill window and
+   ``prompt + max_new_tokens`` must fit one slot's cache span
+   (`RequestTooLarge`, HTTP 400 — retrying is pointless). Because the
+   pool is slot-dense (kvpool.py), a request that satisfies this and
+   reaches a slot owns every page it can ever need — `extend()` cannot
+   fail mid-decode, so there is no vLLM-style preemption hazard.
+ - DYNAMIC: backpressure. The wait queue is bounded both by request
+   count (``max_queue``) and by PAGES — admitted-but-unscheduled
+   requests may reserve at most ``queue_pages_budget`` pages (default:
+   two pool turnovers, ``2 * pool.total_pages`` — enough to absorb a
+   submission burst the scheduler has not drained into free slots yet,
+   small enough that a flood of long requests trips backpressure before
+   the backlog represents minutes of decode). A request
+   whose worst-case pages exceed what is left of that backlog budget is
+   `PoolSaturated`; one that hits the count bound is `QueueFull`. Both
+   are HTTP 429: retry with backoff.
+
+Scheduled (active) requests are backed by real pool pages, tracked by
+the pool itself; the controller only meters the backlog.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .kvpool import PagedKVPool
+
+
+class AdmissionError(RuntimeError):
+    """Base of all admission rejections; http_status is what server.py
+    replies with."""
+
+    http_status = 429
+    reason = "rejected"
+
+
+class QueueFull(AdmissionError):
+    reason = "queue_full"
+
+    def __init__(self, depth: int, limit: int):
+        super().__init__(
+            f"admission queue full ({depth}/{limit} waiting); retry later")
+
+
+class PoolSaturated(AdmissionError):
+    reason = "pool_saturated"
+
+    def __init__(self, need: int, backlog: int, budget: int):
+        super().__init__(
+            f"KV pool saturated: request needs {need} pages but queued"
+            f" requests already reserve {backlog}/{budget} backlog pages;"
+            " retry later")
+
+
+class RequestTooLarge(AdmissionError):
+    http_status = 400
+    reason = "too_large"
+
+
+class AdmissionController:
+    """Bounded queue + page budget over one PagedKVPool.
+
+    `admit()` is the single gate: static limits, queue count bound, and
+    the backlog page budget. `on_scheduled()` moves a request's pages out
+    of the backlog when the scheduler gives it a slot (the pool then
+    carries them); `release()` clears whatever side it is on when the
+    request leaves (finished, failed, or never scheduled). All three are
+    idempotent per request id.
+    """
+
+    def __init__(self, pool: PagedKVPool, window: int,
+                 max_queue: int = 64,
+                 queue_pages_budget: Optional[int] = None,
+                 registry=None):
+        self.pool = pool
+        self.window = int(window)
+        self.max_queue = int(max_queue)
+        self.queue_pages_budget = int(
+            2 * pool.total_pages if queue_pages_budget is None
+            else queue_pages_budget)
+        self._lock = threading.Lock()
+        self._queued_pages: Dict[object, int] = {}  # req id -> pages
+        self._admit_times: Dict[object, float] = {}
+        if registry is None:
+            from ...obs.registry import REGISTRY as registry  # noqa: N813
+        # gauge series carry the pool's label: two servers'/models'
+        # controllers in one process must not clobber each other
+        self._pool_label = pool.label
+        self._g_queue = registry.gauge(
+            "ff_serving_queue_depth",
+            "Admitted requests waiting for a decode slot",
+            labels=("pool",))
+        self._g_queue.set(0, pool=self._pool_label)
+        self._c_rejected = registry.counter(
+            "ff_serving_rejections_total",
+            "Requests rejected at admission by reason", labels=("reason",))
+
+    # -- the gate ----------------------------------------------------------
+    def admit(self, req_id, prompt_len: int, max_new_tokens: int) -> None:
+        """Admit or raise. On success the request's worst-case pages count
+        against the backlog budget until `on_scheduled`."""
+        prompt_len = int(prompt_len)
+        max_new_tokens = int(max_new_tokens)
+        if prompt_len < 1:
+            self._c_rejected.inc(reason=RequestTooLarge.reason)
+            raise RequestTooLarge("empty prompt")
+        if prompt_len > self.window:
+            self._c_rejected.inc(reason=RequestTooLarge.reason)
+            raise RequestTooLarge(
+                f"prompt length {prompt_len} exceeds the prefill window"
+                f" ({self.window})")
+        worst = prompt_len + max(0, max_new_tokens)
+        if worst > self.pool.max_len:
+            self._c_rejected.inc(reason=RequestTooLarge.reason)
+            raise RequestTooLarge(
+                f"prompt ({prompt_len}) + max_new_tokens"
+                f" ({max_new_tokens}) = {worst} exceeds the cache capacity"
+                f" ({self.pool.max_len})")
+        need = self.pool.pages_for(worst)
+        with self._lock:
+            depth = len(self._queued_pages)
+            if depth >= self.max_queue:
+                self._c_rejected.inc(reason=QueueFull.reason)
+                raise QueueFull(depth, self.max_queue)
+            backlog = sum(self._queued_pages.values())
+            if backlog + need > self.queue_pages_budget:
+                self._c_rejected.inc(reason=PoolSaturated.reason)
+                raise PoolSaturated(need, backlog, self.queue_pages_budget)
+            self._queued_pages[req_id] = need
+            self._admit_times[req_id] = time.monotonic()
+            self._g_queue.set(len(self._queued_pages), pool=self._pool_label)
+
+    def on_scheduled(self, req_id) -> float:
+        """The scheduler moved the request from the queue into a slot
+        (the pool now carries its pages). Returns its queue wait in
+        seconds — the starvation signal serve-bench asserts on."""
+        with self._lock:
+            self._queued_pages.pop(req_id, None)
+            self._g_queue.set(len(self._queued_pages), pool=self._pool_label)
+            t = self._admit_times.pop(req_id, None)
+            return 0.0 if t is None else time.monotonic() - t
+
+    def release(self, req_id) -> None:
+        """Clear a request that left without being scheduled (failed or
+        drained at shutdown). Idempotent; scheduled requests were already
+        cleared by on_scheduled."""
+        with self._lock:
+            self._queued_pages.pop(req_id, None)
+            self._admit_times.pop(req_id, None)
+            self._g_queue.set(len(self._queued_pages), pool=self._pool_label)
+
+    # -- accounting --------------------------------------------------------
+    def backlog_pages(self) -> int:
+        with self._lock:
+            return sum(self._queued_pages.values())
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queued_pages)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "queue_depth": len(self._queued_pages),
+                "max_queue": self.max_queue,
+                "backlog_pages": sum(self._queued_pages.values()),
+                "queue_pages_budget": self.queue_pages_budget,
+                "pages_total": self.pool.total_pages,
+            }

@@ -1,0 +1,589 @@
+"""ContinuousBatcher: iteration-level scheduling over the paged KV pool.
+
+The lockstep path (`GenerativeSession.generate`) has three structural
+costs for multi-request traffic: the whole batch decodes until the SLOWEST
+request finishes, partial batches burn compute on tiled padding rows, and
+a new request waits for the entire previous batch. This module removes all
+three with the Orca insight — schedule at ITERATION granularity:
+
+ - every decode dispatch steps ALL active slots at their OWN positions
+   (the vector-``decode_pos`` path in ops/attention.py writes slot i's K/V
+   at ``pos[i]`` and masks attention to its own length);
+ - a request that emits EOS or hits ``max_new_tokens`` releases its slot
+   and pool pages THAT iteration;
+ - a queued request prefills into the freed slot on the next iteration
+   (one batch-1 prefill dispatch scattered into its slot's cache rows)
+   while every other sequence keeps decoding — nobody restarts, nobody
+   waits for a batch boundary.
+
+Requests move through a small state machine::
+
+    QUEUED --admit+slot--> PREFILL --first token--> DECODE --eos/max--> FINISHED
+        \\                                             \\
+         +------------------ FAILED <------------------+
+
+Per-request token streams: `submit()` returns a `GenRequest` whose
+`.stream()` yields tokens as the scheduler emits them (server.py wires
+this through `/generate` with ``"stream": true``) and whose `.result()`
+blocks for the full array.
+
+Determinism: greedy decode (temperature<=0) is token-identical to the
+lockstep path for the same prompt — per-row attention is independent of
+batch composition. Sampled decode draws per-REQUEST keys
+(fold_in(PRNGKey(request.seed), position)), so a request's tokens are a
+function of its own (seed, prompt) and never of co-scheduled traffic.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ffconst import CompMode, OpType
+from ..batcher import BatcherStopped
+from .admission import AdmissionController
+from .kvpool import PagedKVPool, derive_num_slots, kv_cache_spec
+
+
+class RequestCancelled(RuntimeError):
+    """The caller cancelled a still-queued request (ContinuousBatcher
+    .cancel) before it reached a slot."""
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+_DONE = object()
+
+
+class GenRequest:
+    """Handle for one submitted generation request."""
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
+                 eos_id: Optional[int], seed: int):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.seed = int(seed)
+        self.state = RequestState.QUEUED
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self._stream: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.queue_wait_s: Optional[float] = None
+
+    # -- consumer API ------------------------------------------------------
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token ids in emission order; raises the request's error if
+        it failed. Each next() waits at most `timeout` seconds."""
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.id}: no token within {timeout}s")
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until finished; returns the (n,) int32 generated tokens."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"request {self.id} not finished within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.tokens, np.int32)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    # -- scheduler side ----------------------------------------------------
+    def _emit(self, tok: int) -> None:
+        self.tokens.append(int(tok))
+        self._stream.put(int(tok))
+
+    def _finish(self) -> None:
+        self.state = RequestState.FINISHED
+        self.t_done = time.monotonic()
+        self._stream.put(_DONE)
+        self._done.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.state = RequestState.FAILED
+        self.error = err
+        self.t_done = time.monotonic()
+        self._stream.put(err)
+        self._done.set()
+
+
+class _Slot:
+    """One active sequence bound to a pool slot."""
+
+    __slots__ = ("req", "slot", "pos", "emitted", "last_tok", "key",
+                 "t_last_emit")
+
+    def __init__(self, req: GenRequest, slot: int, key: np.ndarray):
+        self.req = req
+        self.slot = slot
+        self.pos = 0          # cache position the NEXT decode writes at
+        self.emitted = 0
+        self.last_tok = 0
+        self.key = key        # (2,) uint32 per-request PRNG key
+        self.t_last_emit = time.monotonic()
+
+
+class ContinuousBatcher:
+    """Continuous-batching scheduler over a compiled causal-transformer
+    FFModel (same model contract as GenerativeSession: final tensor is a
+    vocab distribution, the declared input seq length is the prefill
+    window).
+
+    temperature/top_k are BATCHER-level policy (each combination jits a
+    decode step — client-chosen values would be a compile-DoS surface,
+    the same rule register_generative applies); per-request `seed` is an
+    operand and free.
+
+    Metrics default to the PROCESS-WIDE obs registry (like ff_checkpoint_*
+    and ff_watchdog_*), which every server's /metrics already concatenates
+    — passing a per-server registry here would render duplicate families.
+    Pass an explicit `registry` only for isolated tests.
+    """
+
+    def __init__(self, model, max_len: int, num_slots: Optional[int] = None,
+                 page_size: int = 16, machine=None, max_queue: int = 64,
+                 queue_pages_budget: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 registry=None):
+        if getattr(model.executor, "mesh", None) is not None:
+            # a mesh is fine as long as nothing is actually partitioned
+            # (the common replicated case — e.g. a dp axis the batch does
+            # not divide): sharding CONSTRAINTS assume the compiled batch,
+            # which the batch-polymorphic prefill/decode dispatches break
+            for op in model.graph.ops.values():
+                for t in list(op.outputs) + list(op.weights):
+                    ps = getattr(t, "parallel_shape", None)
+                    if ps is not None and any(
+                            p is not None for p in ps.partition_spec()):
+                        raise ValueError(
+                            "ContinuousBatcher serves unsharded models;"
+                            f" tensor {t.name!r} is partitioned"
+                            f" ({ps.partition_spec()}) and its sharding"
+                            " constraint assumes the compiled batch")
+        self.model = model
+        self.max_len = int(max_len)
+        self.window = model.input_ops[0].outputs[0].dims[1]
+        if self.max_len < self.window:
+            raise ValueError(
+                f"max_len={max_len} smaller than the prefill window"
+                f" ({self.window})")
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k={top_k}: must be >= 1")
+        if float(temperature) < 0.0:
+            raise ValueError(f"temperature={temperature}: must be >= 0")
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.attn_ops = [op for op in model.graph.ops.values()
+                         if op.op_type == OpType.MULTIHEAD_ATTENTION]
+        if not self.attn_ops:
+            raise ValueError("generation needs multihead_attention ops")
+        if num_slots is None:
+            num_slots = derive_num_slots(model, self.max_len,
+                                         machine=machine)
+        self.num_slots = int(num_slots)
+
+        if registry is None:
+            from ...obs.registry import REGISTRY as registry  # noqa: N813
+        self.registry = registry
+        self.pool = PagedKVPool(self.num_slots, self.max_len,
+                                page_size=page_size, registry=registry)
+        self.admission = AdmissionController(
+            self.pool, self.window, max_queue=max_queue,
+            queue_pages_budget=queue_pages_budget, registry=registry)
+        self._g_active = registry.gauge(
+            "ff_serving_slots_active", "Decode slots holding a live request",
+            labels=("pool",))
+        self._g_active.set(0, pool=self.pool.label)
+        self._h_ttft = registry.histogram(
+            "ff_serving_ttft_ms", "Submit-to-first-token latency")
+        self._h_itl = registry.histogram(
+            "ff_serving_itl_ms", "Inter-token latency during decode")
+        self._c_requests = registry.counter(
+            "ff_serving_requests_total",
+            "Continuous-batching requests by outcome", labels=("outcome",))
+        self._c_tokens = registry.counter(
+            "ff_serving_tokens_total", "Tokens generated")
+
+        self._build_fns()
+        self._caches = self._zero_caches()
+        self._rid = itertools.count()
+        self._queue: List[GenRequest] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.num_slots
+        self._cv = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._completed = 0
+        self._failed = 0
+
+    # -- jitted device functions ------------------------------------------
+    def _zero_caches(self):
+        import jax.numpy as jnp
+
+        # kv_cache_spec is the SAME geometry derive_num_slots sized the
+        # pool with — allocation can never drift from the HBM estimate
+        return {
+            name: {
+                "k_cache": jnp.zeros(
+                    (self.num_slots, self.max_len, heads, kdim), cdt),
+                "v_cache": jnp.zeros(
+                    (self.num_slots, self.max_len, heads, vdim), cdt),
+            }
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
+        }
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        executor = model.executor
+        final_guid = model.final_tensor.guid
+        input_name = model.input_ops[0].name
+        max_len = self.max_len
+        attn_names = [op.name for op in self.attn_ops]
+        temperature, top_k = self.temperature, self.top_k
+
+        from ..generate import sampling_logits
+
+        def pick_row(probs_row, pos, key):
+            """Next token from one row's (V,) distribution — the per-row
+            mirror of GenerativeSession._pick (same sampling_logits policy
+            core): greedy at temperature<=0, else categorical at
+            fold_in(key, pos), so a request's tokens depend only on its
+            own (seed, position), never on which slots it shares the
+            iteration with."""
+            if temperature <= 0.0:
+                return jnp.argmax(probs_row, axis=-1).astype(jnp.int32)
+            logits = sampling_logits(probs_row, temperature, top_k)
+            return jax.random.categorical(
+                jax.random.fold_in(key, pos), logits).astype(jnp.int32)
+
+        def small_caches(big):
+            return {
+                name: {
+                    "k_cache": jnp.zeros((1,) + big[name]["k_cache"].shape[1:],
+                                         big[name]["k_cache"].dtype),
+                    "v_cache": jnp.zeros((1,) + big[name]["v_cache"].shape[1:],
+                                         big[name]["v_cache"].dtype),
+                }
+                for name in attn_names
+            }
+
+        def prefill_one(params, state, caches, tokens, slot, plen, key):
+            """Prefill ONE request (tokens: (1, window), prompt in the
+            first plen positions) into pool slot `slot`: run the batch-1
+            forward with fresh batch-1 caches, scatter the filled rows
+            into the slot-dense pool caches, and pick the first token from
+            the last real prompt position."""
+            st = {**state, **small_caches(caches)}
+            values, new_state, _ = executor.forward_values(
+                params, st, {input_name: tokens}, None,
+                CompMode.COMP_MODE_INFERENCE, fill_kv_cache=True)
+            probs = values[final_guid]  # (1, window, V)
+            new_caches = {}
+            for name in attn_names:
+                kc = caches[name]["k_cache"]
+                vc = caches[name]["v_cache"]
+                new_caches[name] = {
+                    "k_cache": jax.lax.dynamic_update_slice(
+                        kc, new_state[name]["k_cache"].astype(kc.dtype),
+                        (slot, 0, 0, 0)),
+                    "v_cache": jax.lax.dynamic_update_slice(
+                        vc, new_state[name]["v_cache"].astype(vc.dtype),
+                        (slot, 0, 0, 0)),
+                }
+            row = jax.lax.dynamic_slice_in_dim(
+                probs, plen - 1, 1, axis=1)[0, 0]  # (V,)
+            tok = pick_row(row, plen - 1, key)
+            return tok, new_caches
+
+        def decode_all(params, state, caches, toks, pos, keys):
+            """One decode iteration over EVERY slot: toks (S,) last tokens,
+            pos (S,) per-slot write positions, keys (S, 2) per-request PRNG
+            keys. Inactive slots carry dummy operands; their outputs are
+            discarded host-side."""
+            flat = {}
+            for name in attn_names:
+                flat[name] = dict(caches[name])
+            st = {**state, **flat}
+            values, new_state, _ = executor.forward_values(
+                params, st, {input_name: toks[:, None]}, None,
+                CompMode.COMP_MODE_INFERENCE, decode_pos=pos)
+            probs = values[final_guid][:, 0, :]  # (S, V)
+            next_tok = jax.vmap(pick_row)(probs, pos, keys)
+            new_caches = {
+                name: {"k_cache": new_state[name]["k_cache"],
+                       "v_cache": new_state[name]["v_cache"]}
+                for name in attn_names
+            }
+            return next_tok, new_caches
+
+        # donate the pool caches: the scheduler always threads the newest
+        # ones through, so XLA updates them in place
+        self._prefill_fn = jax.jit(prefill_one, donate_argnums=(2,))
+        self._decode_fn = jax.jit(decode_all, donate_argnums=(2,))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            if self._thread is not None and self._thread.is_alive():
+                # a previous stop() timed out with actives still draining:
+                # a second loop thread would race on the donated caches
+                raise RuntimeError(
+                    "previous scheduler thread is still draining; cannot"
+                    " restart until it exits")
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop accepting work. ACTIVE requests decode to completion (their
+        pages are reserved, so they are bounded); QUEUED requests fail with
+        BatcherStopped — the same typed-shutdown contract DynamicBatcher
+        has."""
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+            if not t.is_alive():
+                self._thread = None
+            # else: keep the handle — start() must refuse to spawn a
+            # second loop over the same (donated) cache arrays
+        self._drain_queue(BatcherStopped("batcher stopped"))
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client API --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_id: Optional[int] = None, seed: int = 0) -> GenRequest:
+        """Admit one request (prompt_ids: (L,) or (1, L) int tokens).
+        Raises an AdmissionError subclass on rejection; otherwise returns
+        a GenRequest whose stream()/result() deliver the tokens."""
+        from ...obs.tracing import get_tracer
+
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1:
+            raise ValueError(
+                "continuous batching takes ONE prompt per request —"
+                f" expected shape (L,) or (1, L), got {prompt.shape}")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}: need >= 1")
+        rid = next(self._rid)
+        with self._cv:
+            if not self._running:
+                raise BatcherStopped("batcher is not running")
+            with get_tracer().span("serve.admit", request=rid):
+                self.admission.admit(rid, prompt.size, max_new_tokens)
+            req = GenRequest(rid, prompt, max_new_tokens, eos_id, seed)
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req
+
+    def cancel(self, req: GenRequest) -> bool:
+        """Best-effort cancel of a STILL-QUEUED request: removes it from
+        the wait queue, releases its admission reservation, and fails it
+        with RequestCancelled. Returns False when the request already
+        reached a slot (or finished) — scheduled work runs to completion
+        (its pages are owned; there is no mid-decode preemption path)."""
+        with self._cv:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                return False
+        self.admission.release(req.id)
+        self._failed += 1
+        self._c_requests.inc(outcome="cancelled")
+        req._fail(RequestCancelled(f"request {req.id} cancelled"))
+        return True
+
+    def stats(self) -> Dict[str, object]:
+        with self._cv:
+            active = sum(1 for s in self._slots if s is not None)
+            queued = len(self._queue)
+        return {
+            "queue_depth": queued,
+            "slots_active": active,
+            "completed": self._completed,
+            "failed": self._failed,
+            "pool": self.pool.stats(),
+            "admission": self.admission.stats(),
+        }
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+
+        from ...obs.tracing import get_tracer
+
+        tracer = get_tracer()
+        params = self.model.params
+        state = self.model.state
+        try:
+            while True:
+                with self._cv:
+                    while (self._running and not self._queue
+                           and not any(self._slots)):
+                        self._cv.wait(timeout=0.1)
+                    if not self._running and not any(self._slots):
+                        break
+                    running = self._running
+
+                # 1) fill free slots from the queue (skipped once stopping:
+                #    queued requests fail fast in stop())
+                if running:
+                    self._schedule_prefills(params, state, tracer)
+
+                # 2) one decode iteration over all active slots
+                active = [s for s in self._slots if s is not None]
+                if not active:
+                    continue
+                toks = np.zeros(self.num_slots, np.int32)
+                pos = np.zeros(self.num_slots, np.int32)
+                keys = np.zeros((self.num_slots, 2), np.uint32)
+                for s in active:
+                    toks[s.slot] = s.last_tok
+                    pos[s.slot] = s.pos
+                    keys[s.slot] = s.key
+                with tracer.span("serve.decode", slots=len(active)):
+                    next_tok, self._caches = self._decode_fn(
+                        params, state, self._caches, jnp.asarray(toks),
+                        jnp.asarray(pos), jnp.asarray(keys))
+                    next_tok = np.asarray(next_tok)
+                now = time.monotonic()
+                for s in active:
+                    self._h_itl.observe((now - s.t_last_emit) * 1e3)
+                    s.t_last_emit = now
+                    self.pool.extend(s.req.id, 1)
+                    s.pos += 1
+                    self._emit_token(s, int(next_tok[s.slot]))
+        except BaseException as e:  # scheduler died: fail everything
+            self._fail_all(e)
+        finally:
+            self._g_active.set(0, pool=self.pool.label)
+
+    def _schedule_prefills(self, params, state, tracer) -> None:
+        import jax.numpy as jnp
+
+        while True:
+            with self._cv:
+                if not self._queue or self.pool.free_slot_count() == 0:
+                    return
+                req = self._queue.pop(0)
+            req.state = RequestState.PREFILL
+            req.queue_wait_s = self.admission.on_scheduled(req.id)
+            plen = req.prompt.size
+            slot_idx = self.pool.alloc(req.id, plen)
+            padded = np.zeros((1, self.window), np.int32)
+            padded[0, :plen] = req.prompt
+            import jax
+
+            key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+            with tracer.span("serve.prefill", request=req.id, tokens=plen):
+                tok, self._caches = self._prefill_fn(
+                    params, state, self._caches, jnp.asarray(padded),
+                    slot_idx, plen, jnp.asarray(key))
+                tok = int(tok)
+            s = _Slot(req, slot_idx, key)
+            s.pos = plen
+            s.last_tok = tok
+            self._slots[slot_idx] = s
+            req.state = RequestState.DECODE
+            req.t_first_token = time.monotonic()
+            self._h_ttft.observe((req.t_first_token - req.t_submit) * 1e3)
+            self._sync_active_gauge()
+            self._emit_token(s, tok)
+
+    def _emit_token(self, s: _Slot, tok: int) -> None:
+        """Deliver one generated token; retire the request when it hits
+        EOS or its budget — releasing the slot and pages IMMEDIATELY so
+        the next iteration can reuse them."""
+        req = s.req
+        req._emit(tok)
+        s.last_tok = tok
+        s.emitted += 1
+        self._c_tokens.inc()
+        if ((req.eos_id is not None and tok == req.eos_id)
+                or s.emitted >= req.max_new_tokens):
+            self._retire(s)
+
+    def _retire(self, s: _Slot) -> None:
+        self._slots[s.slot] = None
+        self.pool.free(s.req.id)
+        self.admission.release(s.req.id)
+        self._completed += 1
+        self._c_requests.inc(outcome="completed")
+        self._sync_active_gauge()
+        s.req._finish()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _sync_active_gauge(self) -> None:
+        self._g_active.set(sum(1 for s in self._slots if s is not None),
+                           pool=self.pool.label)
+
+    def _drain_queue(self, err: BaseException) -> None:
+        with self._cv:
+            pending, self._queue = self._queue, []
+        for req in pending:
+            self.admission.release(req.id)
+            self._failed += 1
+            self._c_requests.inc(outcome="failed")
+            req._fail(err)
+
+    def _fail_all(self, err: BaseException) -> None:
+        with self._cv:
+            self._running = False
+            slots, self._slots = list(self._slots), [None] * self.num_slots
+        for s in slots:
+            if s is None:
+                continue
+            self.pool.free(s.req.id)
+            self.admission.release(s.req.id)
+            self._failed += 1
+            self._c_requests.inc(outcome="failed")
+            s.req._fail(err)
+        self._drain_queue(err)

@@ -1,0 +1,244 @@
+"""PagedKVPool: the serving KV cache block-allocated in fixed-size pages.
+
+Physical layout vs logical pages
+--------------------------------
+The device arrays backing the pool are slot-dense: per attention op one
+``(num_slots, max_len, heads, head_dim)`` K and V cache, exactly the layout
+the incremental-decoding kernels already consume (ops/attention.py). A
+*page* is a fixed span of ``page_size`` consecutive token positions inside
+one slot, so page id ``slot * pages_per_slot + block`` names physical rows
+``[block*page_size, (block+1)*page_size)`` of that slot. The per-sequence
+page table therefore maps a sequence's logical token blocks to real cache
+rows — pages are allocated as the sequence grows and returned the moment it
+finishes, which is what gives continuous batching its accounting: admission
+reasons about *pages*, utilization reports live tokens rather than
+worst-case slots, and a finished short request frees capacity mid-decode
+instead of at batch end.
+
+What this deliberately does NOT do (yet) is scatter one sequence across
+slots: a sequence's pages are consecutive blocks of the slot it occupies,
+so the attention kernel needs no gather. The portable-redistribution view
+of arXiv:2112.01075 applies unchanged if the elastic coordinator re-plans
+the serving mesh — pool pages are named independently of devices, so
+resharding is a page-table rewrite plus an array reshard.
+
+Capacity comes from the machine spec's HBM through the SAME memory model
+the plan sanitizer gates compiles with (`analysis.plan_memory_bytes`):
+HBM minus the model's inference footprint, divided by KV bytes per token
+times ``max_len`` per slot (`derive_num_slots`).
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+from typing import Dict, List, Optional
+
+from ...ffconst import OpType
+
+# distinguishes concurrent pools' gauge series on /metrics
+_POOL_IDS = itertools.count()
+
+
+class PoolExhausted(RuntimeError):
+    """No free slot/pages for an allocation. Under admission control this
+    is unreachable for admitted requests — reaching it means the caller
+    bypassed the controller's page reservation."""
+
+
+class PagedKVPool:
+    """Page allocator + accounting over the slot-dense KV cache arrays.
+
+    The pool manages ALLOCATION only; the device arrays live on the
+    ContinuousBatcher (they are jit-carried state). Thread-safe: the
+    scheduler thread allocates/extends while server threads read
+    utilization for /metrics.
+    """
+
+    def __init__(self, num_slots: int, max_len: int, page_size: int = 16,
+                 registry=None, label: Optional[str] = None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots={num_slots}: need at least one")
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size}: need >= 1")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.pages_per_slot = math.ceil(self.max_len / self.page_size)
+        self.total_pages = self.num_slots * self.pages_per_slot
+        # the `pool` label value on this pool's gauge series: two pools in
+        # one process (a multi-model server) must not clobber each other's
+        # set()-style gauges
+        self.label = label or f"pool{next(_POOL_IDS)}"
+        self._lock = threading.Lock()
+        self._free_slots: List[int] = list(range(self.num_slots))[::-1]
+        # seq_id -> (slot, [page ids]) ; pages are consecutive blocks of
+        # the slot, so len(pages) tracks ceil(tokens/page_size)
+        self._table: Dict[object, tuple] = {}
+        self._tokens: Dict[object, int] = {}
+        if registry is None:
+            from ...obs.registry import REGISTRY as registry  # noqa: N813
+        self._g_used = registry.gauge(
+            "ff_kvpool_pages_used", "KV-cache pages currently allocated",
+            labels=("pool",))
+        self._g_total = registry.gauge(
+            "ff_kvpool_pages_total", "KV-cache pool capacity in pages",
+            labels=("pool",))
+        self._g_total.set(self.total_pages, pool=self.label)
+        self._g_used.set(0, pool=self.label)
+
+    # -- sizing helpers ----------------------------------------------------
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages a sequence of n_tokens occupies (>= 1: even an empty
+        reservation pins its first page so admission stays conservative)."""
+        return max(1, math.ceil(n_tokens / self.page_size))
+
+    # -- allocation --------------------------------------------------------
+    def alloc(self, seq_id, n_tokens: int) -> int:
+        """Claim a free slot and the pages for the sequence's first
+        n_tokens (its prompt). Returns the slot index."""
+        need = self.pages_for(n_tokens)
+        if n_tokens > self.max_len:
+            raise PoolExhausted(
+                f"sequence of {n_tokens} tokens exceeds the per-slot"
+                f" capacity ({self.max_len})")
+        with self._lock:
+            if seq_id in self._table:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            if not self._free_slots:
+                live = sum(len(p) for _, p in self._table.values())
+                raise PoolExhausted(
+                    f"all {self.num_slots} slots in use"
+                    f" ({live} pages live)")
+            slot = self._free_slots.pop()
+            pages = [slot * self.pages_per_slot + b for b in range(need)]
+            self._table[seq_id] = (slot, pages)
+            self._tokens[seq_id] = int(n_tokens)
+        self._sync_gauges()
+        return slot
+
+    def extend(self, seq_id, n_tokens: int = 1) -> None:
+        """Account n_tokens more for a live sequence, pulling in the next
+        page(s) of its slot when a block boundary is crossed."""
+        with self._lock:
+            if seq_id not in self._table:
+                raise KeyError(f"sequence {seq_id!r} not allocated")
+            slot, pages = self._table[seq_id]
+            total = self._tokens[seq_id] + int(n_tokens)
+            if total > self.max_len:
+                raise PoolExhausted(
+                    f"sequence {seq_id!r} grew to {total} tokens, past the"
+                    f" per-slot capacity ({self.max_len})")
+            need = self.pages_for(total)
+            while len(pages) < need:
+                pages.append(slot * self.pages_per_slot + len(pages))
+            self._tokens[seq_id] = total
+        self._sync_gauges()
+
+    def free(self, seq_id) -> None:
+        """Release a sequence's slot and pages (idempotent: freeing an
+        unknown id is a no-op so failure paths can always clean up)."""
+        with self._lock:
+            ent = self._table.pop(seq_id, None)
+            self._tokens.pop(seq_id, None)
+            if ent is None:
+                return
+            self._free_slots.append(ent[0])
+        self._sync_gauges()
+
+    # -- accounting --------------------------------------------------------
+    def slot_of(self, seq_id) -> Optional[int]:
+        with self._lock:
+            ent = self._table.get(seq_id)
+            return ent[0] if ent else None
+
+    def pages_of(self, seq_id) -> List[int]:
+        with self._lock:
+            ent = self._table.get(seq_id)
+            return list(ent[1]) if ent else []
+
+    def pages_used(self) -> int:
+        with self._lock:
+            return sum(len(pages) for _, pages in self._table.values())
+
+    def free_slot_count(self) -> int:
+        with self._lock:
+            return len(self._free_slots)
+
+    def live_sequences(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def utilization(self) -> float:
+        """Live pages / capacity, 0..1."""
+        return self.pages_used() / self.total_pages
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "slots": self.num_slots,
+            "slots_free": self.free_slot_count(),
+            "pages_used": self.pages_used(),
+            "pages_total": self.total_pages,
+            "page_size": self.page_size,
+            "utilization": round(self.utilization(), 4),
+        }
+
+    def _sync_gauges(self) -> None:
+        self._g_used.set(self.pages_used(), pool=self.label)
+
+
+def kv_cache_spec(model) -> List[tuple]:
+    """[(op_name, heads, kdim, vdim, jnp cache dtype)] for every attention
+    op — THE cache geometry. Shared by pool sizing (`kv_bytes_per_token`),
+    the ContinuousBatcher's slot caches, and GenerativeSession's lockstep
+    caches, so the HBM estimate can never drift from what actually gets
+    allocated. The dtype is the attention compute dtype (bf16 under mixed
+    precision — the KV cache is the dominant serving memory)."""
+    from ...ops.common import matmul_dtype
+
+    out = []
+    for op in model.graph.ops.values():
+        if op.op_type != OpType.MULTIHEAD_ATTENTION:
+            continue
+        heads = op.params["num_heads"]
+        kdim = op.params.get("kdim") or op.params["embed_dim"] // heads
+        vdim = op.params.get("vdim") or op.params["embed_dim"] // heads
+        cdt = matmul_dtype(model.config, op.inputs[0].dtype.jnp_dtype)
+        out.append((op.name, heads, kdim, vdim, cdt))
+    if not out:
+        raise ValueError(
+            "model has no multihead_attention ops: nothing to cache")
+    return out
+
+
+def kv_bytes_per_token(model) -> int:
+    """Bytes of K+V cache one token position costs across every attention
+    op (see kv_cache_spec for the geometry/dtype contract)."""
+    import jax.numpy as jnp
+
+    return sum(heads * (kdim + vdim) * jnp.dtype(cdt).itemsize
+               for _, heads, kdim, vdim, cdt in kv_cache_spec(model))
+
+
+def derive_num_slots(model, max_len: int, machine=None,
+                     max_slots: int = 64, min_slots: int = 1) -> int:
+    """Slots the machine's HBM can hold: (HBM - model inference footprint)
+    / (KV bytes per token x max_len). The model footprint comes from the
+    SAME memory model the plan sanitizer's FFTA010 fit gate uses
+    (`analysis.plan_memory_bytes`, optimizer_state_factor=1 — serving
+    keeps weights, not optimizer moments). Clamped to [min_slots,
+    max_slots]: the floor keeps a toy chip spec serving, the ceiling keeps
+    a 16 GB chip from compiling a 40k-row decode batch."""
+    from ...analysis import plan_memory_bytes
+
+    if machine is None:
+        from ...search.machine_model import make_machine_model
+
+        machine = make_machine_model(
+            model.config, max(1, model.config.num_devices))
+    model_bytes, _, _ = plan_memory_bytes(
+        model.graph, machine, model.config, optimizer_state_factor=1.0)
+    free = machine.memory_budget_bytes() - model_bytes
+    per_slot = kv_bytes_per_token(model) * int(max_len)
+    slots = int(free // per_slot) if per_slot > 0 else min_slots
+    return max(int(min_slots), min(int(max_slots), slots))

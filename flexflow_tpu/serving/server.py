@@ -135,6 +135,10 @@ class InferenceServer:
         # session); the policy dict holds the registration-time decode
         # knobs (tokens_per_dispatch/temperature/top_k)
         self._generative: Dict[str, tuple] = {}
+        # name -> ContinuousBatcher (serving/sched/): iteration-level
+        # scheduling over the paged KV pool — requests from many clients
+        # interleave in one decode batch instead of serializing on a lock
+        self._continuous: Dict[str, object] = {}
         # elastic runtime event log (elastic/events.py), exported on
         # /metrics when attached
         self._elastic_events = None
@@ -180,11 +184,14 @@ class InferenceServer:
     def unregister(self, name: str) -> None:
         b = self._models.pop(name, None)
         self._generative.pop(name, None)
+        cb = self._continuous.pop(name, None)
         m = self._metrics.pop(name, None)
         if m is not None:
             m.remove_series()
         if b:
             b.stop()
+        if cb is not None:
+            cb.stop()
 
     def models(self):
         return sorted(self._models)
@@ -228,15 +235,45 @@ class InferenceServer:
             raise ValueError(f"top_k={top_k}: must be >= 1")
         if float(temperature) < 0.0:
             raise ValueError(f"temperature={temperature}: must be >= 0")
+        if name in self._continuous:
+            raise ValueError(
+                f"{name!r} already has a continuous batcher; pick one"
+                " serving mode per name")
         self._generative[name] = (
             session, threading.Lock(),
             {"tokens_per_dispatch": max(1, int(tokens_per_dispatch)),
              "temperature": float(temperature), "top_k": top_k})
         self._metrics_for(name)
 
+    def register_continuous(self, name: str, batcher,
+                            start: bool = True) -> None:
+        """Register a ContinuousBatcher (serving/sched/continuous.py) for
+        POST /v2/models/<name>/generate: requests stream through the
+        iteration-level scheduler instead of serializing on a per-session
+        lock, and AdmissionError rejections surface as HTTP 429/400
+        backpressure. The batcher's decode policy (temperature/top_k) is
+        fixed at construction — same compile-DoS rule as
+        register_generative."""
+        if name in self._generative:
+            raise ValueError(
+                f"{name!r} already has a lockstep generative session;"
+                " pick one serving mode per name")
+        old = self._continuous.get(name)
+        if old is not None and old is not batcher:
+            # re-registration (model reload): the old scheduler thread and
+            # its KV-cache device arrays must not leak
+            old.stop()
+        self._continuous[name] = batcher
+        if start:
+            batcher.start()
+        self._metrics_for(name)
+
     def generate(self, name: str, prompt_ids: np.ndarray,
                  max_new_tokens: int, eos_id: Optional[int] = None,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0):
+        if name in self._continuous:
+            return self._generate_continuous(
+                name, prompt_ids, max_new_tokens, eos_id=eos_id, seed=seed)
         if name not in self._generative:
             raise KeyError(f"no generative session {name!r}")
         session, lock, policy = self._generative[name]
@@ -256,10 +293,56 @@ class InferenceServer:
         finally:
             metrics.record((time.perf_counter() - t0) * 1e3, ok)
 
+    def _generate_continuous(self, name: str, prompt_ids, max_new_tokens,
+                             eos_id=None, seed: int = 0):
+        """Fan an (n, L) prompt array out as n independent requests and
+        gather their token lists (ragged when eos fires at different
+        steps). Admission is ALL-OR-NOTHING per HTTP request: if row k is
+        rejected, rows 0..k-1 are cancelled (best-effort — rows a slot
+        already picked up run to completion and are discarded) and the
+        AdmissionError propagates for the 429/400 mapping, so a retrying
+        client does not leave orphaned work compounding the overload."""
+        batcher = self._continuous[name]
+        metrics = self._metrics_for(name)
+        prompts = _prompt_rows(prompt_ids)
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with get_tracer().span("serve.generate", model=name,
+                                   requests=len(prompts)):
+                reqs = []
+                try:
+                    for row in prompts:
+                        reqs.append(batcher.submit(
+                            row, max_new_tokens, eos_id=eos_id, seed=seed))
+                except Exception:
+                    for r in reqs:
+                        batcher.cancel(r)
+                    raise
+                out = [r.result(timeout=600.0).tolist() for r in reqs]
+            ok = True
+            return out
+        finally:
+            metrics.record((time.perf_counter() - t0) * 1e3, ok)
+
+    def generate_stream(self, name: str, prompt_ids, max_new_tokens,
+                        eos_id=None, seed: int = 0):
+        """Submit ONE prompt to a continuous batcher and return the
+        GenRequest handle — its .stream() yields tokens as the scheduler
+        emits them (the HTTP endpoint's "stream": true path)."""
+        if name not in self._continuous:
+            raise KeyError(f"no continuous batcher {name!r}")
+        return self._continuous[name].submit(
+            np.asarray(prompt_ids, np.int32), max_new_tokens,
+            eos_id=eos_id, seed=seed)
+
     def stats(self, name: Optional[str] = None):
         if name is not None:
             return self._metrics[name].stats()
         out = {n: m.stats() for n, m in sorted(self._metrics.items())}
+        if self._continuous:
+            out["_continuous"] = {n: b.stats()
+                                  for n, b in sorted(self._continuous.items())}
         if self._elastic_events is not None:
             out["_elastic"] = self._elastic_events.counts()
         analysis = self._analysis_counters()
@@ -323,7 +406,8 @@ class InferenceServer:
         return self.registry.render() + REGISTRY.render()
 
     def shutdown(self):
-        for name in list(self._models) + list(self._generative):
+        for name in (list(self._models) + list(self._generative)
+                     + list(self._continuous)):
             self.unregister(name)
 
     # -- optional HTTP endpoint ---------------------------------------
@@ -359,6 +443,7 @@ class InferenceServer:
                         "status": "ok",
                         "models": server_ref.models(),
                         "generative": sorted(server_ref._generative),
+                        "continuous": sorted(server_ref._continuous),
                         "load_failures": sorted(server_ref._load_failures),
                         "uptime_s": round(
                             time.time() - server_ref._start_time, 3),
@@ -379,12 +464,47 @@ class InferenceServer:
                 else:
                     self._reply(404, {"error": "not found"})
 
+            def _stream_generate(self, name: str, prompt, req: dict):
+                """"stream": true — per-token NDJSON over a close-delimited
+                HTTP/1.0 response: one {"token": t} line per generated
+                token as the scheduler emits it, then a {"done": ...}
+                trailer with the full sequence."""
+                gen = server_ref.generate_stream(
+                    name, prompt, int(req.get("max_new_tokens", 16)),
+                    eos_id=req.get("eos_id"), seed=int(req.get("seed") or 0))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                toks = []
+                try:
+                    for tok in gen.stream(timeout=600.0):
+                        toks.append(tok)
+                        self.wfile.write(
+                            (json.dumps({"token": tok}) + "\n").encode())
+                        self.wfile.flush()
+                    trailer = {"done": True, "tokens": toks}
+                except OSError:  # client disconnected mid-stream
+                    return
+                except Exception as e:  # headers already sent: error trailer
+                    trailer = {"done": False, "tokens": toks,
+                               "error": f"{type(e).__name__}: {e}"}
+                try:
+                    self.wfile.write((json.dumps(trailer) + "\n").encode())
+                except OSError:
+                    # response is committed and the client is gone —
+                    # nothing left to reply with (do_POST must NOT try a
+                    # second status line)
+                    pass
+
             def do_POST(self):
+                from .sched.admission import AdmissionError
+
                 parts = self.path.strip("/").split("/")
                 if (len(parts) == 4 and parts[0] == "v2"
                         and parts[1] == "models"
                         and parts[3] == "generate"):
-                    if parts[2] not in server_ref._generative:
+                    continuous = parts[2] in server_ref._continuous
+                    if not continuous and parts[2] not in server_ref._generative:
                         self._reply(
                             404, {"error": f"no generative session "
                                            f"{parts[2]!r}"})
@@ -396,14 +516,29 @@ class InferenceServer:
                             self._reply(
                                 400, {"error": "missing 'prompt' field"})
                             return
-                        prompt = np.asarray(req["prompt"], dtype=np.int32)
+                        # continuous fans ragged rows out as independent
+                        # requests; the lockstep session needs a rectangle
+                        prompt = (req["prompt"] if continuous
+                                  else np.asarray(req["prompt"],
+                                                  dtype=np.int32))
+                        if continuous and req.get("stream"):
+                            self._stream_generate(
+                                parts[2], np.asarray(prompt, np.int32), req)
+                            return
                         toks = server_ref.generate(
                             parts[2], prompt,
                             int(req.get("max_new_tokens", 16)),
                             eos_id=req.get("eos_id"),
                             seed=int(req.get("seed") or 0),
                         )
-                        self._reply(200, {"tokens": toks.tolist()})
+                        toks = (toks.tolist()
+                                if isinstance(toks, np.ndarray) else toks)
+                        self._reply(200, {"tokens": toks})
+                    except AdmissionError as e:
+                        # typed backpressure: 429 for transient saturation
+                        # (retry with backoff), 400 for can-never-fit
+                        self._reply(e.http_status,
+                                    {"error": str(e), "reason": e.reason})
                     except ValueError as e:  # malformed request shape
                         self._reply(400, {"error": str(e)})
                     except Exception as e:
@@ -444,3 +579,16 @@ def _is_int_list(v) -> bool:
     while isinstance(v, (list, tuple)) and v:
         v = v[0]
     return isinstance(v, int)
+
+
+def _prompt_rows(prompt_ids):
+    """Normalize a prompt payload into a list of (L,) int32 rows.
+    Continuous batching fans rows out as independent requests, so RAGGED
+    lists of lists are legal (the lockstep path needs a rectangle)."""
+    if isinstance(prompt_ids, np.ndarray):
+        return ([prompt_ids.astype(np.int32)] if prompt_ids.ndim == 1
+                else [r.astype(np.int32) for r in prompt_ids])
+    if isinstance(prompt_ids, (list, tuple)) and prompt_ids and \
+            isinstance(prompt_ids[0], (list, tuple, np.ndarray)):
+        return [np.asarray(r, np.int32) for r in prompt_ids]
+    return [np.asarray(prompt_ids, np.int32)]
