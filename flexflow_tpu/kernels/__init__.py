@@ -4,7 +4,23 @@ there; here ring attention (sequence/context parallelism over ICI) is a new
 capability required by BASELINE.md's north star."""
 from .ring_attention import ring_attention, ring_attention_sharded
 
-__all__ = ["ring_attention", "ring_attention_sharded", "get_shard_map"]
+__all__ = ["ring_attention", "ring_attention_sharded", "get_shard_map",
+           "pvary"]
+
+
+def pvary(x, axes):
+    """Mark a value varying over manual mesh axes — jax>=0.7 spells this
+    lax.pcast(..., to="varying") / lax.pvary and requires it on shard_map
+    scan carries (the vma type check); older jax has no vma type system,
+    so the mark is an identity there. One shim for all kernels, same role
+    as get_shard_map below."""
+    import jax.lax as lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
 
 
 def get_shard_map(check_vma: bool = True):

@@ -57,7 +57,9 @@ def gpipe_stage_loop(stage_fn: Callable, local_params, x_micro,
 
     # the scan carry becomes stage-varying after one tick: mark the init
     # accordingly (shard_map vma type check; same pattern as ring_attention)
-    zero = lax.pcast(jnp.zeros_like(x_micro[0]), (axis_name,), to="varying")
+    from . import pvary
+
+    zero = pvary(jnp.zeros_like(x_micro[0]), (axis_name,))
     _, outs = lax.scan(tick, zero, jnp.arange(ticks))
     # microbatch i completes on the last stage at tick i + S - 1
     outs = lax.slice_in_dim(outs, n_stages - 1, n_stages - 1 + m, axis=0)

@@ -64,10 +64,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     b, _, h, dv = v.shape
 
     # accumulators for the online softmax; marked varying over the ring axis
-    # (the new shard_map vma check requires carry in/out types to agree)
-    acc0 = jax.lax.pcast(jnp.zeros((b, l_local, h, dv), jnp.float32), vary_axes, to="varying")
-    m0 = jax.lax.pcast(jnp.full((b, h, l_local), -jnp.inf, jnp.float32), vary_axes, to="varying")
-    l0 = jax.lax.pcast(jnp.zeros((b, h, l_local), jnp.float32), vary_axes, to="varying")
+    # (the new shard_map vma check requires carry in/out types to agree;
+    # identity on jax versions without the vma type system)
+    from . import pvary
+
+    acc0 = pvary(jnp.zeros((b, l_local, h, dv), jnp.float32), vary_axes)
+    m0 = pvary(jnp.full((b, h, l_local), -jnp.inf, jnp.float32), vary_axes)
+    l0 = pvary(jnp.zeros((b, h, l_local), jnp.float32), vary_axes)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 

@@ -304,15 +304,25 @@ class MultiHeadAttentionOp(Op):
         return [out]
 
     def _decode_step(self, ctx, q, k, v, weights, scale):
-        """One incremental-decoding step: q/k/v are projections of the single
-        new token (B, 1, h, d); the K/V caches (B, M, h, d) are updated at
-        decode_pos and attended with a <= pos mask.
+        """One incremental-decoding step: q/k/v are projections of the new
+        token(s) (B, C, h, d); the K/V caches (B, M, h, d) are updated at
+        decode_pos and attended with a causal <= position mask.
 
         decode_pos may be a traced SCALAR (every row at the same position —
         the lockstep GenerativeSession path) or a traced (B,) VECTOR of
         per-row positions (continuous batching, serving/sched/continuous.py:
         each slot decodes its own sequence, so slot i writes its K/V at
-        pos[i] and masks to its own length)."""
+        pos[i] and masks to its own length).
+
+        The scalar form doubles as the CHUNK-OFFSET PREFILL entry: with
+        C > 1 query tokens at offset `pos`, the chunk's K/V rows are
+        written at cache positions [pos, pos+C) and query j attends rows
+        <= pos+j — causal over the already-filled prefix plus the chunk
+        itself. That is what lets the continuous batcher split a long
+        prompt into fixed-size chunks interleaved with decode iterations
+        (serving/sched/continuous.py) instead of stalling every in-flight
+        decode behind one monolithic prefill. The vector form stays
+        single-token (one decode step per slot)."""
         pos = ctx.decode_pos
         kc = ctx.state[(self.name, "k_cache")]
         vc = ctx.state[(self.name, "v_cache")]
@@ -327,7 +337,9 @@ class MultiHeadAttentionOp(Op):
                 kc, k.astype(kc.dtype), (0, pos, 0, 0))
             vc = jax.lax.dynamic_update_slice(
                 vc, v.astype(vc.dtype), (0, pos, 0, 0))
-            mask = (jnp.arange(kc.shape[1]) <= pos)[None, None, None, :]
+            qpos = pos + jnp.arange(q.shape[1])  # (C,) absolute positions
+            mask = (jnp.arange(kc.shape[1])[None, :]
+                    <= qpos[:, None])[None, None, :, :]  # (1, 1, C, M)
         ctx.state_updates[(self.name, "k_cache")] = kc
         ctx.state_updates[(self.name, "v_cache")] = vc
         logits = jnp.einsum(

@@ -8,28 +8,36 @@ one-batch-at-a-time `GenerativeSession.generate`:
  - `PagedKVPool` (kvpool.py): the KV cache block-allocated in fixed-size
    pages with a per-sequence page table; capacity derived from the machine
    spec's HBM via the analysis memory model (`analysis.plan_memory_bytes`).
+ - `PrefixCache` (kvpool.py, ISSUE 6): hash-addressed, refcounted,
+   copy-on-write store of immutable prefix pages in a device-side band —
+   identical page-aligned prompt prefixes are prefilled once and installed
+   into new slots by device copy, with LRU eviction under a page budget.
  - `ContinuousBatcher` (continuous.py): per-request state machine
    (QUEUED -> PREFILL -> DECODE -> FINISHED); every decode iteration steps
    ALL active slots at their own positions (the vector-decode_pos path in
    ops/attention.py), finished requests free their slot and pages
-   immediately, and queued requests prefill into freed slots while the
-   rest keep decoding.
+   immediately, queued requests prefill into freed slots while the rest
+   keep decoding, and prefills run in fixed-size CHUNKS interleaved with
+   decode (the chunk-offset scalar-decode_pos path) so long prompts never
+   stall in-flight decodes.
  - `AdmissionController` (admission.py): bounded queue + admit-time page
-   budget so every accepted request can finish; typed backpressure the
-   HTTP endpoint maps to 429.
+   budget (crediting expected prefix sharing) so every accepted request
+   can finish; typed backpressure the HTTP endpoint maps to 429.
  - `serve-bench` (bench.py): the load generator that measures the win
-   over the lockstep path (docs/serving.md).
+   over the lockstep path, incl. shared-prefix and long-prefill
+   scenarios (docs/serving.md).
 """
 from .admission import (AdmissionController, AdmissionError, QueueFull,
                         PoolSaturated, RequestTooLarge)
 from .continuous import (BatcherStopped, ContinuousBatcher, GenRequest,
                          RequestCancelled, RequestState)
-from .kvpool import (PagedKVPool, PoolExhausted, derive_num_slots,
-                     kv_bytes_per_token, kv_cache_spec)
+from .kvpool import (PagedKVPool, PoolExhausted, PrefixCache,
+                     derive_num_slots, kv_bytes_per_token, kv_cache_spec)
 
 __all__ = [
     "AdmissionController", "AdmissionError", "QueueFull", "PoolSaturated",
     "RequestTooLarge", "BatcherStopped", "ContinuousBatcher", "GenRequest",
     "RequestCancelled", "RequestState", "PagedKVPool", "PoolExhausted",
-    "derive_num_slots", "kv_bytes_per_token", "kv_cache_spec",
+    "PrefixCache", "derive_num_slots", "kv_bytes_per_token",
+    "kv_cache_spec",
 ]

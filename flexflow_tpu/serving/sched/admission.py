@@ -4,12 +4,15 @@ The contract: a request is either rejected AT SUBMIT with a typed error
 (mapped to an HTTP status by server.py) or it is guaranteed to finish.
 The guarantee has two legs:
 
- - STATIC: ``prompt`` must fit the prefill window and
-   ``prompt + max_new_tokens`` must fit one slot's cache span
-   (`RequestTooLarge`, HTTP 400 — retrying is pointless). Because the
-   pool is slot-dense (kvpool.py), a request that satisfies this and
-   reaches a slot owns every page it can ever need — `extend()` cannot
-   fail mid-decode, so there is no vLLM-style preemption hazard.
+ - STATIC: ``prompt + max_new_tokens`` must fit one slot's cache span,
+   and — only when the batcher prefills in ONE shot (``window`` is set) —
+   ``prompt`` must fit the prefill window (`RequestTooLarge`, HTTP 400 —
+   retrying is pointless). Chunked prefill passes ``window=None``: a
+   prompt longer than the model's declared input length is legal because
+   it is fed to the device in fixed-size chunks. Because the pool is
+   slot-dense (kvpool.py), a request that satisfies this and reaches a
+   slot owns every page it can ever need — `extend()` cannot fail
+   mid-decode, so there is no vLLM-style preemption hazard.
  - DYNAMIC: backpressure. The wait queue is bounded both by request
    count (``max_queue``) and by PAGES — admitted-but-unscheduled
    requests may reserve at most ``queue_pages_budget`` pages (default:
@@ -20,6 +23,14 @@ The guarantee has two legs:
    whose worst-case pages exceed what is left of that backlog budget is
    `PoolSaturated`; one that hits the count bound is `QueueFull`. Both
    are HTTP 429: retry with backoff.
+
+   The backlog budget CREDITS expected prefix sharing: a request whose
+   prompt matches pages already resident in the pool's `PrefixCache`
+   passes ``shared_pages`` here and is metered at its *incremental* cost
+   (suffix + output pages), so admission admits more shared-prefix
+   traffic than naive worst-case sizing says fits. The credit is sound
+   because the budget throttles backlog prefill work, not physical
+   safety — safety still comes from the slot-dense ownership above.
 
 Scheduled (active) requests are backed by real pool pages, tracked by
 the pool itself; the controller only meters the backlog.
@@ -75,12 +86,15 @@ class AdmissionController:
     idempotent per request id.
     """
 
-    def __init__(self, pool: PagedKVPool, window: int,
+    def __init__(self, pool: PagedKVPool, window: Optional[int],
                  max_queue: int = 64,
                  queue_pages_budget: Optional[int] = None,
                  registry=None):
         self.pool = pool
-        self.window = int(window)
+        # None = no prefill-window cap (chunked prefill feeds the device
+        # in fixed-size chunks, so the model's declared input length no
+        # longer bounds the prompt)
+        self.window = None if window is None else int(window)
         self.max_queue = int(max_queue)
         self.queue_pages_budget = int(
             2 * pool.total_pages if queue_pages_budget is None
@@ -103,15 +117,20 @@ class AdmissionController:
             "Requests rejected at admission by reason", labels=("reason",))
 
     # -- the gate ----------------------------------------------------------
-    def admit(self, req_id, prompt_len: int, max_new_tokens: int) -> None:
+    def admit(self, req_id, prompt_len: int, max_new_tokens: int,
+              shared_pages: int = 0) -> None:
         """Admit or raise. On success the request's worst-case pages count
-        against the backlog budget until `on_scheduled`."""
+        against the backlog budget until `on_scheduled`. shared_pages:
+        prefix pages the pool's cache is expected to install instead of
+        prefilling (the batcher probes `PrefixCache.match` at submit) —
+        credited against the backlog budget, never against the static
+        per-slot capacity check."""
         prompt_len = int(prompt_len)
         max_new_tokens = int(max_new_tokens)
         if prompt_len < 1:
             self._c_rejected.inc(reason=RequestTooLarge.reason)
             raise RequestTooLarge("empty prompt")
-        if prompt_len > self.window:
+        if self.window is not None and prompt_len > self.window:
             self._c_rejected.inc(reason=RequestTooLarge.reason)
             raise RequestTooLarge(
                 f"prompt length {prompt_len} exceeds the prefill window"
@@ -123,7 +142,7 @@ class AdmissionController:
                 f"prompt ({prompt_len}) + max_new_tokens"
                 f" ({max_new_tokens}) = {worst} exceeds the cache capacity"
                 f" ({self.pool.max_len})")
-        need = self.pool.pages_for(worst)
+        need = max(1, self.pool.pages_for(worst) - max(0, int(shared_pages)))
         with self._lock:
             depth = len(self._queued_pages)
             if depth >= self.max_queue:

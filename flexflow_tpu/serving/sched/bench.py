@@ -1,24 +1,37 @@
 """serve-bench: the load generator that MEASURES continuous batching.
 
-``python -m flexflow_tpu serve-bench`` builds a tiny causal transformer,
-drives a mixed prompt/output-length workload through BOTH serving paths —
-the continuous batcher (iteration-level scheduling over the paged KV
-pool) and the lockstep ``GenerativeSession`` baseline (fixed batches,
-every batch decodes until its slowest request finishes) — and reports
-aggregate tokens/s plus TTFT / per-request latency percentiles, so the
-scheduling win is a number, not an assertion.
+``python -m flexflow_tpu serve-bench`` builds a tiny causal transformer
+and drives one of three workloads (``--workload``):
 
-Hard checks (exit 1 on violation), which is what the CI `serving-load`
-job runs:
+ - ``mixed`` (default): mixed prompt/output lengths through BOTH serving
+   paths — the continuous batcher vs the lockstep ``GenerativeSession``
+   baseline — reporting aggregate tokens/s plus TTFT / latency
+   percentiles, so the scheduling win is a number, not an assertion.
+ - ``shared-prefix``: N requests over K distinct system prompts (ISSUE
+   6). One leader per group prefills cold; followers hit the prefix
+   cache. Reports tokens/s, the pool's pages-saved accounting, and TTFT
+   percentiles split by prefix-hit vs miss, and HARD-ASSERTS (a) every
+   request's greedy tokens are identical to a cache-cold lockstep
+   reference and (b) hit TTFT is at least ``--ttft-ratio`` (default 3x)
+   lower than miss TTFT.
+ - ``long-prefill``: in-flight decodes vs one long-prompt request, run
+   with chunked prefill and again with one-shot prefill. HARD-ASSERTS
+   that (a) the long request's tokens are identical in both runs and (b)
+   the in-flight decoders' p99 inter-token latency during the long
+   prefill is at least ``--itl-ratio`` (default 3x) lower chunked than
+   the one-shot stall — the no-full-prompt-stall acceptance bound.
+
+Hard checks for every workload (exit 1 on violation), which is what the
+CI `serving-load` job runs:
  - every submitted request FINISHES with exactly its requested token
    count — zero dropped or hung futures;
  - no request waits in the admission queue past ``--deadline`` seconds;
  - the metrics the run emitted render through the obs exposition
    validator (`obs.validate_exposition`).
 
-``--assert-speedup X`` additionally fails the run when continuous/lockstep
-aggregate tokens/s falls below X — meant for local measurement boxes, not
-shared CI runners where wall-clock is noise.
+``--assert-speedup X`` additionally fails the mixed run when
+continuous/lockstep aggregate tokens/s falls below X — meant for local
+measurement boxes, not shared CI runners where wall-clock is noise.
 """
 from __future__ import annotations
 
@@ -76,25 +89,64 @@ def make_workload(n: int, prompt_min: int, prompt_max: int, out_min: int,
     return reqs
 
 
+def make_shared_prefix_workload(n: int, groups: int, prefix_len: int,
+                                suffix_min: int, suffix_max: int,
+                                out_min: int, out_max: int, vocab: int,
+                                seed: int) -> List[Dict]:
+    """N requests over `groups` distinct system prompts: request i carries
+    prefix (i % groups) plus a unique suffix. The first request of each
+    group is the LEADER (cold prefill that populates the prefix cache);
+    the rest should hit."""
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(1, vocab, size=(prefix_len,)).astype(np.int32)
+                for _ in range(groups)]
+    reqs = []
+    for i in range(n):
+        g = i % groups
+        slen = int(rng.randint(suffix_min, suffix_max + 1))
+        reqs.append({
+            "prompt": np.concatenate(
+                [prefixes[g],
+                 rng.randint(1, vocab, size=(slen,)).astype(np.int32)]),
+            "max_new": int(rng.randint(out_min, out_max + 1)),
+            "group": g,
+            "leader": i < groups,
+        })
+    return reqs
+
+
 def _pct(xs: List[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
 
 
 def run_continuous(model, workload, max_len: int, slots: int,
-                   page_size: int, deadline_s: float) -> Dict:
+                   page_size: int, deadline_s: float,
+                   prefill_chunk=None) -> Dict:
     from .admission import QueueFull, PoolSaturated
     from .continuous import ContinuousBatcher
 
     batcher = ContinuousBatcher(
         model, max_len=max_len, num_slots=slots, page_size=page_size,
+        prefill_chunk_tokens=prefill_chunk,
+        prefix_cache_pages=0 if prefill_chunk == 0 else None,
         max_queue=max(len(workload), 1))
     handles = []
     backpressured = 0
     with batcher:
         # warmup OUTSIDE the timed window: the first prefill + decode
         # dispatches trigger the jit compiles; both paths get the same
-        # treatment so the comparison is scheduling, not compilation
-        batcher.submit(workload[0]["prompt"][:2], 2).result(timeout=600.0)
+        # treatment so the comparison is scheduling, not compilation.
+        # Two multi-chunk all-zero submits cover every chunked-prefill
+        # path (chunk, fused last chunk, insert, and — second time —
+        # install); zeros never collide with real prompts
+        # the warmup prompt must itself be admissible: cap it to the
+        # cache span (2 new tokens) and the one-shot window
+        warm_len = min(page_size * 2 + 1, max_len - 2)
+        if batcher.prefill_chunk_tokens == 0:
+            warm_len = min(2, warm_len)  # single prefill compile
+        warm = np.zeros(max(1, warm_len), np.int32)
+        batcher.submit(warm, 2).result(timeout=600.0)
+        batcher.submit(warm, 2).result(timeout=600.0)
         t0 = time.monotonic()
         for w in workload:
             # a well-behaved client: 429-class rejections (queue/pool
@@ -166,10 +218,170 @@ def run_lockstep(model, workload, max_len: int) -> Dict:
     }
 
 
+def run_shared_prefix(model, workload, max_len: int, slots: int,
+                      page_size: int, prefix_cache_pages: int,
+                      deadline_s: float) -> Dict:
+    """Drive the shared-prefix workload: leaders first (cold prefills that
+    populate the cache), then followers in waves of `slots` so queue wait
+    never pollutes the TTFT comparison. Every request's tokens are checked
+    against a cache-cold lockstep reference — the greedy-parity acceptance
+    bound."""
+    from ..generate import GenerativeSession
+    from .continuous import ContinuousBatcher
+
+    session = GenerativeSession(model, max_len=max_len)
+    refs = [session.generate(w["prompt"][None, :], w["max_new"])[0]
+            for w in workload]
+
+    batcher = ContinuousBatcher(
+        model, max_len=max_len, num_slots=slots, page_size=page_size,
+        prefix_cache_pages=prefix_cache_pages,
+        max_queue=max(len(workload), 1))
+    leaders = [(i, w) for i, w in enumerate(workload) if w["leader"]]
+    followers = [(i, w) for i, w in enumerate(workload) if not w["leader"]]
+    handles: List = [None] * len(workload)
+    with batcher:
+        # warmup outside the timed window: the first (cold) run compiles
+        # chunk / fused-last-chunk / insert, the second (hitting its own
+        # insert) compiles the install path. All-zero tokens can never
+        # collide with real prompts (make_*_workload draws from
+        # [1, vocab))
+        warm = np.zeros(
+            max(1, min(batcher.pool.page_size * 2 + 1, max_len - 2)),
+            np.int32)
+        batcher.submit(warm, 2).result(timeout=600.0)
+        batcher.submit(warm, 2).result(timeout=600.0)
+        t0 = time.monotonic()
+        for i, w in leaders:
+            handles[i] = batcher.submit(w["prompt"], w["max_new"])
+        for i, _ in leaders:
+            handles[i].result(timeout=600.0)
+        # followers in waves of `slots`: every follower gets a slot
+        # immediately, so its TTFT measures prefill cost, not queueing
+        for lo in range(0, len(followers), slots):
+            wave = followers[lo:lo + slots]
+            for i, w in wave:
+                handles[i] = batcher.submit(w["prompt"], w["max_new"])
+            for i, _ in wave:
+                handles[i].result(timeout=600.0)
+        wall = time.monotonic() - t0
+        stats = batcher.stats()
+    tokens = sum(len(h.tokens) for h in handles)
+    dropped = sum(1 for h, w in zip(handles, workload)
+                  if h.error is not None or len(h.tokens) != w["max_new"])
+    parity_bad = sum(
+        1 for h, ref in zip(handles, refs)
+        if not np.array_equal(np.asarray(h.tokens, np.int32),
+                              np.asarray(ref)))
+    hit_ttfts = [h.ttft_s * 1e3 for h in handles
+                 if h.cache_hit and h.ttft_s is not None]
+    miss_ttfts = [h.ttft_s * 1e3 for h in handles
+                  if not h.cache_hit and h.ttft_s is not None]
+    waits = [h.queue_wait_s or 0.0 for h in handles]
+    prefix_stats = stats["pool"].get("prefix", {})
+    return {
+        "wall_s": round(wall, 3),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "dropped": dropped,
+        "parity_mismatches": parity_bad,
+        "requests": len(workload),
+        "hits": len(hit_ttfts),
+        "misses": len(miss_ttfts),
+        "ttft_hit_ms_p50": round(_pct(hit_ttfts, 50), 2),
+        "ttft_hit_ms_p95": round(_pct(hit_ttfts, 95), 2),
+        "ttft_miss_ms_p50": round(_pct(miss_ttfts, 50), 2),
+        "ttft_miss_ms_p95": round(_pct(miss_ttfts, 95), 2),
+        "ttft_miss_over_hit_p50": round(
+            _pct(miss_ttfts, 50) / _pct(hit_ttfts, 50), 2)
+        if hit_ttfts and _pct(hit_ttfts, 50) > 0 else 0.0,
+        "pages_saved": prefix_stats.get("pages_saved", 0),
+        "prefix": prefix_stats,
+        "max_queue_wait_s": round(max(waits), 3) if waits else 0.0,
+        "starved": sum(1 for w in waits if w > deadline_s),
+        "stats": stats,
+    }
+
+
+def _itl_during(handles, t_start: float, t_end: float) -> List[float]:
+    """Inter-token gaps (ms) of the given requests that OVERLAP
+    [t_start, t_end] — the in-flight decoders' latency while the long
+    prefill was running. Overlap, not containment: the one-shot stall is
+    a single gap that starts before the prefill and ends after it, and it
+    must be counted."""
+    gaps = []
+    for h in handles:
+        ts = h.token_times
+        for a, b in zip(ts, ts[1:]):
+            if a <= t_end and b >= t_start:
+                gaps.append((b - a) * 1e3)
+    return gaps
+
+
+def run_long_prefill(model, max_len: int, slots: int, page_size: int,
+                     long_len: int, long_out: int, decoder_out: int,
+                     chunk: int, vocab: int, seed: int) -> Dict:
+    """One run of the long-prefill scenario: slots-1 short-prompt decoders
+    start decoding, then one `long_len`-token prompt arrives. chunk=0 is
+    the one-shot baseline (the full-prompt stall); chunk>0 interleaves.
+    Returns per-run ITL stats + the long request's tokens (for the
+    chunked-vs-one-shot parity assert)."""
+    from .continuous import ContinuousBatcher
+
+    rng = np.random.RandomState(seed)
+    dec_prompts = [rng.randint(1, vocab, size=(8,)).astype(np.int32)
+                   for _ in range(max(1, slots - 1))]
+    long_prompt = rng.randint(1, vocab, size=(long_len,)).astype(np.int32)
+    batcher = ContinuousBatcher(
+        model, max_len=max_len, num_slots=slots, page_size=page_size,
+        prefill_chunk_tokens=chunk,
+        # cache off: both runs must be cache-cold for a fair stall
+        # comparison (and one-shot cannot use it anyway)
+        prefix_cache_pages=0,
+        max_queue=slots + 4)
+    with batcher:
+        # warmup covers both the multi-chunk and fused-final-chunk paths
+        batcher.submit(
+            np.zeros(max(1, min(2 * page_size + 1, max_len - 2)), np.int32),
+            2).result(timeout=600.0)
+        decoders = [batcher.submit(p, decoder_out) for p in dec_prompts]
+        # wait until every decoder is actually decoding
+        deadline = time.monotonic() + 600.0
+        for d in decoders:
+            while not d.token_times:
+                if d.error is not None or time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"decoder {d.id} never produced a token"
+                        f" (error={d.error})")
+                time.sleep(0.005)
+        t_submit = time.monotonic()
+        long_req = batcher.submit(long_prompt, long_out)
+        long_toks = long_req.result(timeout=600.0)
+        t_first = long_req.t_first_token
+        for d in decoders:
+            d.result(timeout=600.0)
+    stall = _itl_during(decoders, t_submit, t_first)
+    all_gaps = [g for h in decoders
+                for g in np.diff(np.asarray(h.token_times)) * 1e3]
+    return {
+        "chunk": chunk,
+        "long_prompt_tokens": int(long_len),
+        "ttft_long_ms": round((t_first - t_submit) * 1e3, 2),
+        "decode_itl_ms_median": round(_pct(all_gaps, 50), 2),
+        "stall_itl_ms_p99": round(_pct(stall, 99), 2),
+        "stall_itl_ms_max": round(max(stall), 2) if stall else 0.0,
+        "stall_samples": len(stall),
+        "long_tokens": [int(t) for t in long_toks],
+        "decoder_tokens": [[int(t) for t in d.tokens] for d in decoders],
+    }
+
+
 def run_bench(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="flexflow_tpu serve-bench",
         description="continuous-batching vs lockstep serving load test")
+    ap.add_argument("--workload", default="mixed",
+                    choices=("mixed", "shared-prefix", "long-prefill"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -183,6 +395,9 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="prefill chunk tokens for the mixed workload"
+                         " (default: batcher default; 0 = one-shot)")
     ap.add_argument("--deadline", type=float, default=120.0,
                     help="max tolerated admission-queue wait, seconds")
     ap.add_argument("--no-baseline", action="store_true",
@@ -191,7 +406,33 @@ def run_bench(argv=None) -> int:
                     help="fail unless continuous/lockstep tokens/s >= X")
     ap.add_argument("--report", default=None,
                     help="write the result JSON here")
+    # shared-prefix workload
+    ap.add_argument("--prefix-groups", type=int, default=4,
+                    help="distinct system prompts (shared-prefix)")
+    ap.add_argument("--prefix-len", type=int, default=128,
+                    help="system-prompt length in tokens (shared-prefix)")
+    ap.add_argument("--suffix-min", type=int, default=2)
+    ap.add_argument("--suffix-max", type=int, default=8)
+    ap.add_argument("--prefix-cache-pages", type=int, default=None,
+                    help="band page budget (default: batcher default)")
+    ap.add_argument("--ttft-ratio", type=float, default=3.0,
+                    help="require miss/hit TTFT p50 >= this"
+                         " (shared-prefix)")
+    # long-prefill workload
+    ap.add_argument("--long-prompt", type=int, default=4096,
+                    help="long request's prompt length (long-prefill)")
+    ap.add_argument("--long-out", type=int, default=4)
+    ap.add_argument("--decoder-out", type=int, default=96,
+                    help="tokens each in-flight decoder generates")
+    ap.add_argument("--itl-ratio", type=float, default=3.0,
+                    help="require one-shot stall max / chunked stall p99"
+                         " >= this (long-prefill)")
     args = ap.parse_args(argv)
+
+    if args.workload == "shared-prefix":
+        return _run_shared_prefix_cli(args)
+    if args.workload == "long-prefill":
+        return _run_long_prefill_cli(args)
 
     window = args.prompt_max
     max_len = args.prompt_max + args.out_max
@@ -211,7 +452,8 @@ def run_bench(argv=None) -> int:
           f" ({total_requested} tokens requested)")
 
     cont = run_continuous(model, workload, max_len, args.slots,
-                          args.page_size, args.deadline)
+                          args.page_size, args.deadline,
+                          prefill_chunk=args.prefill_chunk)
     print(f"[serve-bench] continuous: {cont['tokens']} tokens in"
           f" {cont['wall_s']}s = {cont['tokens_per_s']} tok/s |"
           f" ttft p50/p95 {cont['ttft_ms_p50']}/{cont['ttft_ms_p95']} ms |"
@@ -247,21 +489,28 @@ def run_bench(argv=None) -> int:
                 f"speedup {speedup:.2f}x below required"
                 f" {args.assert_speedup}x")
 
-    # the run's own metrics must render through the one exposition
-    # renderer and parse back — the same check CI runs over /metrics
+    _check_exposition(failures)
+    return _finish(args, report, failures)
+
+
+def _check_exposition(failures: List[str], extra_required=()) -> None:
+    """The run's own metrics must render through the one exposition
+    renderer and parse back — the same check CI runs over /metrics."""
     from ...obs import validate_exposition
     from ...obs.registry import REGISTRY
 
     text = REGISTRY.render()
     validate_exposition(text)
-    for required in ("ff_kvpool_pages_total", "ff_serving_slots_active",
-                     "ff_serving_ttft_ms", "ff_serving_itl_ms",
-                     "ff_serving_queue_depth"):
+    for required in (("ff_kvpool_pages_total", "ff_serving_slots_active",
+                      "ff_serving_ttft_ms", "ff_serving_itl_ms",
+                      "ff_serving_queue_depth") + tuple(extra_required)):
         if required not in text:
             failures.append(f"metric {required} missing from exposition")
     print("[serve-bench] metrics exposition: valid"
           f" ({len(text.splitlines())} lines)")
 
+
+def _finish(args, report: Dict, failures: List[str]) -> int:
     if args.report:
         with open(args.report, "w") as f:
             json.dump(report, f, indent=2, default=str)
@@ -273,3 +522,133 @@ def run_bench(argv=None) -> int:
         return 1
     print("[serve-bench] OK")
     return 0
+
+
+def _run_shared_prefix_cli(args) -> int:
+    """N requests over K distinct system prompts: the multi-tenant KV
+    reuse measurement (ISSUE 6 acceptance: hit TTFT >= --ttft-ratio lower
+    than miss TTFT, nonzero pages-saved, greedy tokens identical to the
+    cache-cold lockstep path)."""
+    window = args.prefix_len + args.suffix_max
+    max_len = window + args.out_max
+    print(f"[serve-bench] shared-prefix: {args.requests} requests over"
+          f" {args.prefix_groups} system prompts of {args.prefix_len}"
+          f" tokens, suffixes {args.suffix_min}-{args.suffix_max},"
+          f" outputs {args.out_min}-{args.out_max}")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    workload = make_shared_prefix_workload(
+        args.requests, args.prefix_groups, args.prefix_len,
+        args.suffix_min, args.suffix_max, args.out_min, args.out_max,
+        args.vocab, args.seed)
+    # every follower must be able to hit: budget >= the resident groups
+    # (+2 pages for the warmup request's own insert)
+    pages = args.prefix_cache_pages
+    if pages is None:
+        import math
+
+        pages = 2 + args.prefix_groups * math.ceil(
+            (args.prefix_len + args.suffix_max) / args.page_size)
+    res = run_shared_prefix(model, workload, max_len, args.slots,
+                            args.page_size, pages, args.deadline)
+    print(f"[serve-bench] {res['tokens']} tokens in {res['wall_s']}s ="
+          f" {res['tokens_per_s']} tok/s | hits {res['hits']} misses"
+          f" {res['misses']} | pages_saved {res['pages_saved']}")
+    print(f"[serve-bench] ttft p50 hit/miss:"
+          f" {res['ttft_hit_ms_p50']}/{res['ttft_miss_ms_p50']} ms"
+          f" (miss/hit = {res['ttft_miss_over_hit_p50']}x, require >="
+          f" {args.ttft_ratio}x) | p95 hit/miss:"
+          f" {res['ttft_hit_ms_p95']}/{res['ttft_miss_ms_p95']} ms")
+
+    failures = []
+    if res["dropped"]:
+        failures.append(f"{res['dropped']} requests dropped/short")
+    if res["starved"]:
+        failures.append(f"{res['starved']} requests starved past"
+                        f" {args.deadline}s")
+    if res["parity_mismatches"]:
+        failures.append(
+            f"{res['parity_mismatches']} requests' greedy tokens differ"
+            " from the cache-cold lockstep reference")
+    if res["misses"] != args.prefix_groups:
+        failures.append(
+            f"expected exactly {args.prefix_groups} cold leaders, got"
+            f" {res['misses']} misses")
+    if res["hits"] != args.requests - args.prefix_groups:
+        failures.append(
+            f"expected every follower to hit, got {res['hits']}/"
+            f"{args.requests - args.prefix_groups}")
+    if res["pages_saved"] <= 0:
+        failures.append("ff_kvpool_pages_saved stayed zero")
+    if res["ttft_miss_over_hit_p50"] < args.ttft_ratio:
+        failures.append(
+            f"hit TTFT only {res['ttft_miss_over_hit_p50']}x lower than"
+            f" miss (required {args.ttft_ratio}x)")
+    _check_exposition(failures, extra_required=(
+        "ff_kvpool_pages_saved", "ff_prefix_cache_hits_total",
+        "ff_prefix_cache_misses_total", "ff_prefix_cache_pages"))
+    return _finish(args, {"config": vars(args), "shared_prefix": res},
+                   failures)
+
+
+def _run_long_prefill_cli(args) -> int:
+    """One long-prompt request vs in-flight decoders, chunked then
+    one-shot (ISSUE 6 acceptance: bounded in-flight ITL during a 4k-token
+    prefill, token-identical to the unchunked path)."""
+    window = args.long_prompt  # the one-shot baseline pads to the window
+    max_len = args.long_prompt + max(args.long_out, args.decoder_out) + 8
+    print(f"[serve-bench] long-prefill: {args.long_prompt}-token prompt"
+          f" against {max(1, args.slots - 1)} in-flight decoders"
+          f" ({args.decoder_out} tokens each), chunk {args.page_size}"
+          " vs one-shot")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    chunked = run_long_prefill(
+        model, max_len, args.slots, args.page_size, args.long_prompt,
+        args.long_out, args.decoder_out, args.page_size, args.vocab,
+        args.seed)
+    oneshot = run_long_prefill(
+        model, max_len, args.slots, args.page_size, args.long_prompt,
+        args.long_out, args.decoder_out, 0, args.vocab, args.seed)
+    print(f"[serve-bench] chunked:  long TTFT {chunked['ttft_long_ms']} ms"
+          f" | in-flight ITL during prefill p99/max"
+          f" {chunked['stall_itl_ms_p99']}/{chunked['stall_itl_ms_max']} ms"
+          f" ({chunked['stall_samples']} samples, decode median"
+          f" {chunked['decode_itl_ms_median']} ms)")
+    print(f"[serve-bench] one-shot: long TTFT {oneshot['ttft_long_ms']} ms"
+          f" | in-flight ITL during prefill max"
+          f" {oneshot['stall_itl_ms_max']} ms"
+          f" ({oneshot['stall_samples']} samples)")
+
+    failures = []
+    if chunked["long_tokens"] != oneshot["long_tokens"]:
+        failures.append(
+            "long request's greedy tokens differ between chunked and"
+            " one-shot prefill")
+    if chunked["decoder_tokens"] != oneshot["decoder_tokens"]:
+        failures.append("in-flight decoders' tokens differ between runs")
+    if chunked["stall_samples"] == 0:
+        failures.append(
+            "no in-flight decode tokens landed during the chunked"
+            " prefill — raise --decoder-out")
+    # the acceptance bound: chunking keeps in-flight ITL bounded where
+    # one-shot stalls every decoder for the whole prompt
+    stall_ratio = (oneshot["stall_itl_ms_max"]
+                   / max(chunked["stall_itl_ms_p99"], 1e-9))
+    print(f"[serve-bench] stall ratio (one-shot max / chunked p99):"
+          f" {stall_ratio:.1f}x (require >= {args.itl_ratio}x)")
+    if stall_ratio < args.itl_ratio:
+        failures.append(
+            f"chunked prefill only bounded in-flight ITL {stall_ratio:.1f}x"
+            f" below the one-shot stall (required {args.itl_ratio}x)")
+    _check_exposition(failures)
+    report = {"config": vars(args), "long_prefill": {
+        "chunked": {k: v for k, v in chunked.items()
+                    if k not in ("long_tokens", "decoder_tokens")},
+        "one_shot": {k: v for k, v in oneshot.items()
+                     if k not in ("long_tokens", "decoder_tokens")},
+        "stall_ratio": round(stall_ratio, 2),
+    }}
+    return _finish(args, report, failures)

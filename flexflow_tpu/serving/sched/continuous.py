@@ -22,6 +22,26 @@ Requests move through a small state machine::
         \\                                             \\
          +------------------ FAILED <------------------+
 
+PREFILL is a RESUMABLE state: by default prompts are prefilled in
+fixed-size CHUNKS (one KV page per scheduler iteration, via the
+chunk-offset entry in ops/attention.py), interleaved with decode
+iterations — a 4k-token prompt no longer freezes every in-flight decode
+for its whole prefill, it costs each decoder one chunk of extra latency
+per iteration instead. Chunking also removes the prompt <= window
+admission cap: the model's declared input length bounds the CHUNK, not
+the prompt. ``prefill_chunk_tokens=0`` restores the legacy one-shot
+prefill.
+
+Multi-tenant prefix reuse (kvpool.PrefixCache): when a scheduled prompt's
+page-aligned prefix is already cached, the cached K/V rows are INSTALLED
+into the sequence's slot by a device-side copy and only the suffix is
+prefilled — at millions-of-users scale most traffic shares a system
+prompt, so the hit path turns TTFT from O(prompt) into O(suffix). Cold
+prefills insert their full prefix pages into the cache as they finish.
+Admission credits the expected sharing against its backlog page budget
+(admission.py), so shared-prefix floods admit deeper than worst-case
+sizing says.
+
 Per-request token streams: `submit()` returns a `GenRequest` whose
 `.stream()` yields tokens as the scheduler emits them (server.py wires
 this through `/generate` with ``"stream": true``) and whose `.result()`
@@ -85,6 +105,13 @@ class GenRequest:
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.queue_wait_s: Optional[float] = None
+        # emission timestamp per token — what serve-bench computes
+        # inter-token latencies from (the chunked-prefill acceptance bound)
+        self.token_times: List[float] = []
+        # prefix-cache outcome, set when the scheduler takes the request:
+        # cache_hit = >=1 page of the prompt was installed from the cache
+        self.cache_hit = False
+        self.prefix_tokens = 0
 
     # -- consumer API ------------------------------------------------------
     def stream(self, timeout: Optional[float] = None):
@@ -123,6 +150,7 @@ class GenRequest:
     # -- scheduler side ----------------------------------------------------
     def _emit(self, tok: int) -> None:
         self.tokens.append(int(tok))
+        self.token_times.append(time.monotonic())
         self._stream.put(int(tok))
 
     def _finish(self) -> None:
@@ -143,7 +171,7 @@ class _Slot:
     """One active sequence bound to a pool slot."""
 
     __slots__ = ("req", "slot", "pos", "emitted", "last_tok", "key",
-                 "t_last_emit")
+                 "t_last_emit", "plen", "filled", "shared", "small")
 
     def __init__(self, req: GenRequest, slot: int, key: np.ndarray):
         self.req = req
@@ -153,6 +181,13 @@ class _Slot:
         self.last_tok = 0
         self.key = key        # (2,) uint32 per-request PRNG key
         self.t_last_emit = time.monotonic()
+        self.plen = 0         # prompt length
+        self.filled = 0       # prompt tokens already in the cache (chunked
+        #                       prefill resumes here each iteration)
+        self.shared = 0       # leading tokens installed from the prefix
+        #                       cache (pinned shared pages; CoW boundary)
+        self.small = None     # per-prefill batch-1 caches, dropped at the
+        #                       finish scatter
 
 
 class ContinuousBatcher:
@@ -166,6 +201,13 @@ class ContinuousBatcher:
     the same rule register_generative applies); per-request `seed` is an
     operand and free.
 
+    prefill_chunk_tokens (default: one KV page) splits every prefill into
+    fixed-size chunks interleaved with decode iterations; 0 restores the
+    legacy one-shot prefill (and with it the prompt <= window cap).
+    prefix_cache_pages budgets the hash-addressed prefix cache's device
+    band (default: two slots' worth when chunking; 0 disables reuse —
+    see kvpool.PrefixCache for the sharing/CoW contract).
+
     Metrics default to the PROCESS-WIDE obs registry (like ff_checkpoint_*
     and ff_watchdog_*), which every server's /metrics already concatenates
     — passing a per-server registry here would render duplicate families.
@@ -176,7 +218,9 @@ class ContinuousBatcher:
                  page_size: int = 16, machine=None, max_queue: int = 64,
                  queue_pages_budget: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 registry=None):
+                 registry=None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_cache_pages: Optional[int] = None):
         if getattr(model.executor, "mesh", None) is not None:
             # a mesh is fine as long as nothing is actually partitioned
             # (the common replicated case — e.g. a dp axis the batch does
@@ -195,7 +239,24 @@ class ContinuousBatcher:
         self.model = model
         self.max_len = int(max_len)
         self.window = model.input_ops[0].outputs[0].dims[1]
-        if self.max_len < self.window:
+        # chunked prefill (default ON, one page per chunk): PREFILL becomes
+        # a resumable state interleaved with decode iterations, and the
+        # prompt is no longer bounded by the model's declared input length.
+        # 0 = legacy one-shot prefill (pads to the window, cache-cold).
+        if prefill_chunk_tokens is None:
+            chunk = int(page_size)
+        else:
+            chunk = int(prefill_chunk_tokens)
+            if chunk < 0:
+                raise ValueError(
+                    f"prefill_chunk_tokens={prefill_chunk_tokens}:"
+                    " need >= 0 (0 = one-shot prefill)")
+        # the chunk is fed through the model input, so it must fit the
+        # declared window
+        self.prefill_chunk_tokens = min(chunk, self.window) if chunk else 0
+        if self.prefill_chunk_tokens == 0 and self.max_len < self.window:
+            # one-shot prefill scatters a full (1, window) pass into the
+            # slot's cache rows; chunked prefill has no such floor
             raise ValueError(
                 f"max_len={max_len} smaller than the prefill window"
                 f" ({self.window})")
@@ -209,25 +270,55 @@ class ContinuousBatcher:
                          if op.op_type == OpType.MULTIHEAD_ATTENTION]
         if not self.attn_ops:
             raise ValueError("generation needs multihead_attention ops")
+        # prefix cache sizing: default two slots' worth of band pages when
+        # chunked prefill is on (the hit path needs the chunk-offset entry
+        # to prefill just the suffix); 0 disables reuse
+        import math as _math
+
+        pages_per_slot = _math.ceil(self.max_len / int(page_size))
+        full_pages_per_slot = self.max_len // int(page_size)
+        if prefix_cache_pages is None:
+            prefix_pages = 2 * pages_per_slot if self.prefill_chunk_tokens \
+                else 0
+        else:
+            prefix_pages = int(prefix_cache_pages)
+        if prefix_pages and not self.prefill_chunk_tokens:
+            raise ValueError(
+                "prefix caching requires chunked prefill"
+                " (prefill_chunk_tokens > 0): installing a cached prefix"
+                " leaves only the suffix to prefill, which needs the"
+                " chunk-offset entry")
+        if full_pages_per_slot == 0:
+            prefix_pages = 0  # no full page fits a slot: nothing cacheable
+        band_slots = (_math.ceil(prefix_pages / full_pages_per_slot)
+                      if prefix_pages else 0)
         if num_slots is None:
-            num_slots = derive_num_slots(model, self.max_len,
-                                         machine=machine)
+            # the band lives in HBM next to the decode slots: carve it out
+            # of the derived capacity so the memory model stays honest
+            num_slots = max(1, derive_num_slots(model, self.max_len,
+                                                machine=machine)
+                            - band_slots)
         self.num_slots = int(num_slots)
 
         if registry is None:
             from ...obs.registry import REGISTRY as registry  # noqa: N813
         self.registry = registry
         self.pool = PagedKVPool(self.num_slots, self.max_len,
-                                page_size=page_size, registry=registry)
+                                page_size=page_size, registry=registry,
+                                prefix_cache_pages=prefix_pages)
         self.admission = AdmissionController(
-            self.pool, self.window, max_queue=max_queue,
+            self.pool,
+            self.window if self.prefill_chunk_tokens == 0 else None,
+            max_queue=max_queue,
             queue_pages_budget=queue_pages_budget, registry=registry)
         self._g_active = registry.gauge(
             "ff_serving_slots_active", "Decode slots holding a live request",
             labels=("pool",))
         self._g_active.set(0, pool=self.pool.label)
         self._h_ttft = registry.histogram(
-            "ff_serving_ttft_ms", "Submit-to-first-token latency")
+            "ff_serving_ttft_ms",
+            "Submit-to-first-token latency, split by prefix-cache outcome",
+            labels=("cache",))
         self._h_itl = registry.histogram(
             "ff_serving_itl_ms", "Inter-token latency during decode")
         self._c_requests = registry.counter(
@@ -238,6 +329,7 @@ class ContinuousBatcher:
 
         self._build_fns()
         self._caches = self._zero_caches()
+        self._band = self._zero_band()
         self._rid = itertools.count()
         self._queue: List[GenRequest] = []
         self._slots: List[Optional[_Slot]] = [None] * self.num_slots
@@ -259,6 +351,46 @@ class ContinuousBatcher:
                     (self.num_slots, self.max_len, heads, kdim), cdt),
                 "v_cache": jnp.zeros(
                     (self.num_slots, self.max_len, heads, vdim), cdt),
+            }
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
+        }
+
+    def _zero_band(self):
+        """The prefix cache's device-side page store: slot-shaped rows
+        SEPARATE from the decode caches, so decode dispatches never carry
+        (or attend over) the band. None when prefix reuse is off."""
+        import jax.numpy as jnp
+
+        band_slots = self.pool.band_slots
+        if band_slots == 0:
+            return None
+        return {
+            name: {
+                "k_cache": jnp.zeros(
+                    (band_slots, self.max_len, heads, kdim), cdt),
+                "v_cache": jnp.zeros(
+                    (band_slots, self.max_len, heads, vdim), cdt),
+            }
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
+        }
+
+    def _zero_small(self):
+        """Fresh batch-1 caches for one chunked prefill: chunks attend and
+        write here (positions [0, filled)), and the finish step scatters
+        the first max_len rows into the sequence's pool slot in one
+        update. The extra chunk-1 SLACK rows absorb the final chunk's
+        fixed-width padded write: the last chunk always dispatches at full
+        chunk width starting as late as position plen-1 <= max_len-1, and
+        without the slack `dynamic_update_slice` would CLAMP that write at
+        the array edge, silently shifting real prompt K/V rows (pinned by
+        tests/test_prefix_cache.py::test_chunked_prefill_last_chunk_never_clamps)."""
+        import jax.numpy as jnp
+
+        rows = self.max_len + max(0, self.prefill_chunk_tokens - 1)
+        return {
+            name: {
+                "k_cache": jnp.zeros((1, rows, heads, kdim), cdt),
+                "v_cache": jnp.zeros((1, rows, heads, vdim), cdt),
             }
             for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
         }
@@ -304,30 +436,21 @@ class ContinuousBatcher:
         def prefill_one(params, state, caches, tokens, slot, plen, key):
             """Prefill ONE request (tokens: (1, window), prompt in the
             first plen positions) into pool slot `slot`: run the batch-1
-            forward with fresh batch-1 caches, scatter the filled rows
-            into the slot-dense pool caches, and pick the first token from
-            the last real prompt position."""
+            forward with fresh batch-1 caches, then scatter the filled
+            rows into the slot-dense pool caches and pick the first token
+            (the same _scatter_and_pick the fused chunked finish uses)."""
             st = {**state, **small_caches(caches)}
             values, new_state, _ = executor.forward_values(
                 params, st, {input_name: tokens}, None,
                 CompMode.COMP_MODE_INFERENCE, fill_kv_cache=True)
             probs = values[final_guid]  # (1, window, V)
-            new_caches = {}
-            for name in attn_names:
-                kc = caches[name]["k_cache"]
-                vc = caches[name]["v_cache"]
-                new_caches[name] = {
-                    "k_cache": jax.lax.dynamic_update_slice(
-                        kc, new_state[name]["k_cache"].astype(kc.dtype),
-                        (slot, 0, 0, 0)),
-                    "v_cache": jax.lax.dynamic_update_slice(
-                        vc, new_state[name]["v_cache"].astype(vc.dtype),
-                        (slot, 0, 0, 0)),
-                }
-            row = jax.lax.dynamic_slice_in_dim(
-                probs, plen - 1, 1, axis=1)[0, 0]  # (V,)
-            tok = pick_row(row, plen - 1, key)
-            return tok, new_caches
+            small = {
+                name: {"k_cache": new_state[name]["k_cache"],
+                       "v_cache": new_state[name]["v_cache"]}
+                for name in attn_names
+            }
+            return _scatter_and_pick(caches, small, slot, probs, plen - 1,
+                                     plen - 1, key)
 
         def decode_all(params, state, caches, toks, pos, keys):
             """One decode iteration over EVERY slot: toks (S,) last tokens,
@@ -350,10 +473,125 @@ class ContinuousBatcher:
             }
             return next_tok, new_caches
 
+        def prefill_chunk(params, state, small, tokens, off):
+            """One chunked-prefill step for ONE request: tokens (1, C) at
+            prompt offset `off`, run through the chunk-offset decode entry
+            (ops/attention.py _decode_step, scalar pos, C queries) against
+            the request's batch-1 caches. Returns the chunk's (1, C, V)
+            probs and the updated caches. Padded tail positions of the
+            last chunk write garbage rows at positions >= plen — harmless,
+            because decode overwrites row p before any query can attend
+            it."""
+            st = {**state, **small}
+            values, new_state, _ = executor.forward_values(
+                params, st, {input_name: tokens}, None,
+                CompMode.COMP_MODE_INFERENCE, decode_pos=off)
+            probs = values[final_guid]  # (1, C, V)
+            new_small = {
+                name: {"k_cache": new_state[name]["k_cache"],
+                       "v_cache": new_state[name]["v_cache"]}
+                for name in attn_names
+            }
+            return probs, new_small
+
+        def _scatter_and_pick(caches, small, slot, probs, idx, pos, key):
+            # [:max_len]: the batch-1 caches carry chunk-1 slack rows (see
+            # _zero_small) that must not spill into the pool slot
+            new_caches = {}
+            for name in attn_names:
+                kc = caches[name]["k_cache"]
+                vc = caches[name]["v_cache"]
+                new_caches[name] = {
+                    "k_cache": jax.lax.dynamic_update_slice(
+                        kc,
+                        small[name]["k_cache"][:, :max_len].astype(kc.dtype),
+                        (slot, 0, 0, 0)),
+                    "v_cache": jax.lax.dynamic_update_slice(
+                        vc,
+                        small[name]["v_cache"][:, :max_len].astype(vc.dtype),
+                        (slot, 0, 0, 0)),
+                }
+            row = jax.lax.dynamic_slice(
+                probs, (0, idx, 0), (1, 1, probs.shape[2]))[0, 0]  # (V,)
+            tok = pick_row(row, pos, key)
+            return tok, new_caches
+
+        def prefill_last_chunk(params, state, caches, small, tokens, off,
+                               slot, idx, pos, key):
+            """The FUSED final prefill step: run the last chunk, scatter
+            the request's whole batch-1 cache span into its pool slot,
+            and pick the first output token — one dispatch, so a prompt
+            that fits a single chunk prefills as cheaply as the one-shot
+            path did."""
+            st = {**state, **small}
+            values, new_state, _ = executor.forward_values(
+                params, st, {input_name: tokens}, None,
+                CompMode.COMP_MODE_INFERENCE, decode_pos=off)
+            probs = values[final_guid]  # (1, C, V)
+            new_small = {
+                name: {"k_cache": new_state[name]["k_cache"],
+                       "v_cache": new_state[name]["v_cache"]}
+                for name in attn_names
+            }
+            return _scatter_and_pick(caches, new_small, slot, probs, idx,
+                                     pos, key)
+
+        def install_prefix(small, band, src_slot, src_row, n_rows):
+            """Prefix-cache HIT: gather the matched band pages' K/V rows
+            (src_slot/src_row: (max_len,) per-destination-row coordinates,
+            real for rows < n_rows) into the leading rows of a fresh
+            batch-1 prefill cache — the device-side copy that replaces
+            recomputing the prefix."""
+            keep = (jnp.arange(max_len) < n_rows)[:, None, None]
+            out = {}
+            for name in attn_names:
+                gk = band[name]["k_cache"][src_slot, src_row]  # (M, h, d)
+                gv = band[name]["v_cache"][src_slot, src_row]
+                sk = small[name]["k_cache"]  # (1, max_len + slack, h, d)
+                sv = small[name]["v_cache"]
+                out[name] = {
+                    # update the first max_len rows; the slack tail (see
+                    # _zero_small) passes through untouched
+                    "k_cache": jax.lax.dynamic_update_slice(
+                        sk, jnp.where(keep, gk, sk[0, :max_len])[None],
+                        (0, 0, 0, 0)),
+                    "v_cache": jax.lax.dynamic_update_slice(
+                        sv, jnp.where(keep, gv, sv[0, :max_len])[None],
+                        (0, 0, 0, 0)),
+                }
+            return out
+
+        def insert_pages(band, caches, slot, src_rows, dst_slots, dst_rows):
+            """Prefix-cache INSERT: copy every new page of a finished
+            prefill from its pool slot into band pages in ONE dispatch.
+            The coordinate arrays have a FIXED shape (full_pages_per_slot
+            * page_size rows — the caller pads by repeating the last real
+            page, an idempotent scatter) so the function compiles exactly
+            once. Band pages are written exactly once, before their
+            entries become matchable — the immutability half of CoW."""
+            new_band = {}
+            for name in attn_names:
+                rows_k = caches[name]["k_cache"][slot, src_rows]
+                rows_v = caches[name]["v_cache"][slot, src_rows]
+                new_band[name] = {
+                    "k_cache": band[name]["k_cache"].at[
+                        dst_slots, dst_rows].set(rows_k),
+                    "v_cache": band[name]["v_cache"].at[
+                        dst_slots, dst_rows].set(rows_v),
+                }
+            return new_band
+
         # donate the pool caches: the scheduler always threads the newest
         # ones through, so XLA updates them in place
         self._prefill_fn = jax.jit(prefill_one, donate_argnums=(2,))
         self._decode_fn = jax.jit(decode_all, donate_argnums=(2,))
+        self._chunk_fn = jax.jit(prefill_chunk, donate_argnums=(2,))
+        # (donating `small` here too would warn: the fused output has no
+        # batch-1 cache to reuse the buffers for — they just die)
+        self._last_chunk_fn = jax.jit(prefill_last_chunk,
+                                      donate_argnums=(2,))
+        self._install_fn = jax.jit(install_prefix, donate_argnums=(0,))
+        self._insert_fn = jax.jit(insert_pages, donate_argnums=(0,))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -413,11 +651,19 @@ class ContinuousBatcher:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens={max_new_tokens}: need >= 1")
         rid = next(self._rid)
+        # expected prefix sharing, credited against the admission backlog
+        # budget (a probe, not a pin — the real match happens at schedule
+        # time; the budget is a throttle, so a stale probe is harmless)
+        shared_pages = 0
+        if self.pool.prefix is not None:
+            matched, _ = self.pool.prefix.match(prompt)
+            shared_pages = min(matched, prompt.size - 1) // self.pool.page_size
         with self._cv:
             if not self._running:
                 raise BatcherStopped("batcher is not running")
             with get_tracer().span("serve.admit", request=rid):
-                self.admission.admit(rid, prompt.size, max_new_tokens)
+                self.admission.admit(rid, prompt.size, max_new_tokens,
+                                     shared_pages=shared_pages)
             req = GenRequest(rid, prompt, max_new_tokens, eos_id, seed)
             self._queue.append(req)
             self._cv.notify_all()
@@ -449,6 +695,7 @@ class ContinuousBatcher:
             "slots_active": active,
             "completed": self._completed,
             "failed": self._failed,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "pool": self.pool.stats(),
             "admission": self.admission.stats(),
         }
@@ -472,19 +719,39 @@ class ContinuousBatcher:
                         break
                     running = self._running
 
-                # 1) fill free slots from the queue (skipped once stopping:
-                #    queued requests fail fast in stop())
+                # 1) move queued requests into free slots (skipped once
+                #    stopping: queued requests fail fast in stop()). In
+                #    one-shot mode this runs the whole prefill; in chunked
+                #    mode it only installs any cached prefix and arms the
+                #    resumable PREFILL state.
                 if running:
-                    self._schedule_prefills(params, state, tracer)
+                    self._admit_new(params, state, tracer)
 
-                # 2) one decode iteration over all active slots
-                active = [s for s in self._slots if s is not None]
+                # 2) one prefill chunk per PREFILLING slot — interleaved
+                #    with decode so a long prompt costs in-flight decodes
+                #    one chunk of latency per iteration, not its whole
+                #    prefill
+                self._step_prefills(params, state, tracer)
+
+                # 3) one decode iteration over all DECODING slots
+                active = [s for s in self._slots if s is not None
+                          and s.req.state is RequestState.DECODE]
                 if not active:
                     continue
                 toks = np.zeros(self.num_slots, np.int32)
                 pos = np.zeros(self.num_slots, np.int32)
                 keys = np.zeros((self.num_slots, 2), np.uint32)
                 for s in active:
+                    if s.shared and s.pos < s.shared:
+                        # copy-on-write break: this decode writes inside
+                        # pages the sequence still shares. Its slot rows
+                        # are already the private copy, so only the share
+                        # is severed — unreachable with page-aligned
+                        # matching (decode writes at pos >= plen >=
+                        # shared), but enforced, not assumed.
+                        self.pool.prefix.cow_break(s.req.id, s.pos)
+                        s.shared = (s.pos // self.pool.page_size
+                                    ) * self.pool.page_size
                     toks[s.slot] = s.last_tok
                     pos[s.slot] = s.pos
                     keys[s.slot] = s.key
@@ -505,7 +772,12 @@ class ContinuousBatcher:
         finally:
             self._g_active.set(0, pool=self.pool.label)
 
-    def _schedule_prefills(self, params, state, tracer) -> None:
+    def _admit_new(self, params, state, tracer) -> None:
+        """Move queued requests into free slots. One-shot mode runs the
+        whole prefill here (the pre-chunking behavior); chunked mode pins +
+        installs any cached prefix and leaves the slot in the resumable
+        PREFILL state for `_step_prefills`."""
+        import jax
         import jax.numpy as jnp
 
         while True:
@@ -517,25 +789,138 @@ class ContinuousBatcher:
             req.queue_wait_s = self.admission.on_scheduled(req.id)
             plen = req.prompt.size
             slot_idx = self.pool.alloc(req.id, plen)
-            padded = np.zeros((1, self.window), np.int32)
-            padded[0, :plen] = req.prompt
-            import jax
-
             key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-            with tracer.span("serve.prefill", request=req.id, tokens=plen):
-                tok, self._caches = self._prefill_fn(
-                    params, state, self._caches, jnp.asarray(padded),
-                    slot_idx, plen, jnp.asarray(key))
-                tok = int(tok)
             s = _Slot(req, slot_idx, key)
-            s.pos = plen
-            s.last_tok = tok
+            s.plen = plen
             self._slots[slot_idx] = s
-            req.state = RequestState.DECODE
-            req.t_first_token = time.monotonic()
-            self._h_ttft.observe((req.t_first_token - req.t_submit) * 1e3)
             self._sync_active_gauge()
-            self._emit_token(s, tok)
+
+            if self.prefill_chunk_tokens == 0:
+                padded = np.zeros((1, self.window), np.int32)
+                padded[0, :plen] = req.prompt
+                with tracer.span("serve.prefill", request=req.id,
+                                 tokens=plen):
+                    tok, self._caches = self._prefill_fn(
+                        params, state, self._caches, jnp.asarray(padded),
+                        slot_idx, plen, jnp.asarray(key))
+                    tok = int(tok)
+                s.pos = plen
+                s.last_tok = tok
+                self._first_token(s, tok)
+                continue
+
+            s.small = self._zero_small()
+            prefix = self.pool.prefix
+            if prefix is not None:
+                # leave >= 1 suffix token: the first output token's logits
+                # come from the last prompt position, so the final position
+                # always runs through a chunk
+                max_pages = (plen - 1) // self.pool.page_size
+                matched, entries = prefix.acquire(req.id, req.prompt,
+                                                  max_pages=max_pages)
+                if entries:
+                    ps = self.pool.page_size
+                    src_slot = np.zeros(self.max_len, np.int32)
+                    src_row = np.zeros(self.max_len, np.int32)
+                    for b, e in enumerate(entries):
+                        bslot, roff = self.pool.band_coords(e.page)
+                        src_slot[b * ps:(b + 1) * ps] = bslot
+                        src_row[b * ps:(b + 1) * ps] = (
+                            roff + np.arange(ps))
+                    with tracer.span("serve.prefix_install",
+                                     request=req.id, tokens=matched):
+                        s.small = self._install_fn(
+                            s.small, self._band, jnp.asarray(src_slot),
+                            jnp.asarray(src_row),
+                            jnp.asarray(matched, jnp.int32))
+                    s.filled = s.shared = matched
+                    req.prefix_tokens = matched
+                    req.cache_hit = True
+
+    def _step_prefills(self, params, state, tracer) -> None:
+        """One prefill chunk for every slot in the PREFILL state; a slot
+        whose prompt completes scatters its cache span into the pool,
+        emits its first token, and joins this iteration's decode."""
+        import jax.numpy as jnp
+
+        chunk = self.prefill_chunk_tokens
+        for s in [x for x in self._slots
+                  if x is not None and x.req.state is RequestState.PREFILL]:
+            off = s.filled
+            n = min(chunk, s.plen - off)
+            tokens = np.zeros((1, chunk), np.int32)
+            tokens[0, :n] = s.req.prompt[off:off + n]
+            last = off + n >= s.plen
+            with tracer.span("serve.prefill", request=s.req.id,
+                             offset=off, tokens=n):
+                if not last:
+                    probs, s.small = self._chunk_fn(
+                        params, state, s.small, jnp.asarray(tokens),
+                        jnp.asarray(off, jnp.int32))
+                    s.filled = off + n
+                    continue
+                # final chunk: fused chunk + cache-span scatter + first
+                # token — a prompt that fits one chunk costs ONE dispatch,
+                # like the one-shot path did
+                tok, self._caches = self._last_chunk_fn(
+                    params, state, self._caches, s.small,
+                    jnp.asarray(tokens), jnp.asarray(off, jnp.int32),
+                    s.slot, jnp.asarray(s.plen - 1 - off, jnp.int32),
+                    jnp.asarray(s.plen - 1, jnp.int32),
+                    jnp.asarray(s.key))
+                tok = int(tok)
+            s.small = None
+            s.filled = s.pos = s.plen
+            s.last_tok = tok
+            self._insert_prefix(s, tracer)
+            self._first_token(s, tok)
+
+    def _insert_prefix(self, s: _Slot, tracer) -> None:
+        """Register the finished prefill's full prefix pages in the cache
+        — ONE device copy for all new pages; already-cached blocks just
+        refresh their LRU tick."""
+        prefix = self.pool.prefix
+        if prefix is None:
+            return
+        import jax.numpy as jnp
+
+        ps = self.pool.page_size
+
+        def copy_pages(pairs) -> None:
+            # fixed-shape coordinate arrays (one jit compile): pad by
+            # repeating the last real page — a duplicate scatter writes
+            # the same rows the same values, so padding is idempotent
+            cap = self.pool.full_pages_per_slot
+            padded = pairs + [pairs[-1]] * (cap - len(pairs))
+            n = cap * ps
+            src = np.empty(n, np.int32)
+            dst_slot = np.empty(n, np.int32)
+            dst_row = np.empty(n, np.int32)
+            for i, (block, page) in enumerate(padded):
+                bslot, roff = self.pool.band_coords(page)
+                rows = slice(i * ps, (i + 1) * ps)
+                src[rows] = block * ps + np.arange(ps)
+                dst_slot[rows] = bslot
+                dst_row[rows] = roff + np.arange(ps)
+            self._band = self._insert_fn(
+                self._band, self._caches, jnp.asarray(s.slot, jnp.int32),
+                jnp.asarray(src), jnp.asarray(dst_slot),
+                jnp.asarray(dst_row))
+
+        with tracer.span("serve.prefix_insert", request=s.req.id):
+            prefix.insert(s.req.prompt, s.plen, copy_pages)
+
+    def _first_token(self, s: _Slot, tok: int) -> None:
+        """Prefill complete: the request starts decoding and its TTFT is
+        recorded, split by prefix-cache outcome."""
+        req = s.req
+        req.state = RequestState.DECODE
+        req.t_first_token = time.monotonic()
+        self._h_ttft.observe(
+            (req.t_first_token - req.t_submit) * 1e3,
+            cache="hit" if req.cache_hit else "miss")
+        self._sync_active_gauge()
+        self._emit_token(s, tok)
 
     def _emit_token(self, s: _Slot, tok: int) -> None:
         """Deliver one generated token; retire the request when it hits

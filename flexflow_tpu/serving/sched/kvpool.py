@@ -22,6 +22,15 @@ of arXiv:2112.01075 applies unchanged if the elastic coordinator re-plans
 the serving mesh — pool pages are named independently of devices, so
 resharding is a page-table rewrite plus an array reshard.
 
+Multi-tenant prefix reuse (`PrefixCache`) builds on exactly that naming:
+cached prefix pages live in a device-side *band* of extra slot-shaped
+rows, addressed by rolling hash of page-aligned token blocks and
+refcounted by the live sequences sharing them. A new sequence whose
+prompt matches a cached prefix gets those rows installed into its slot by
+a device-side copy (the copy-on-write materialization the slot-dense
+kernel requires) and prefills only the suffix — the win is prefill
+compute and TTFT, tracked by `ff_kvpool_pages_saved`.
+
 Capacity comes from the machine spec's HBM through the SAME memory model
 the plan sanitizer gates compiles with (`analysis.plan_memory_bytes`):
 HBM minus the model's inference footprint, divided by KV bytes per token
@@ -29,10 +38,13 @@ times ``max_len`` per slot (`derive_num_slots`).
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ...ffconst import OpType
 
@@ -46,6 +58,294 @@ class PoolExhausted(RuntimeError):
     bypassed the controller's page reservation."""
 
 
+def _chain_key(parent: bytes, block: np.ndarray) -> bytes:
+    """Rolling hash over page-aligned token blocks: the key of block i is
+    blake2b(key of block i-1, tokens of block i), so a prefix chain is
+    addressable by its last block's key and two prompts share exactly the
+    entries of their common page-aligned prefix. Content is re-verified
+    against the stored tokens on lookup, so a hash collision degrades to a
+    miss, never to wrong KV."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent)
+    h.update(np.ascontiguousarray(block, dtype=np.int64).tobytes())
+    return h.digest()
+
+
+class _PrefixEntry:
+    """One immutable, refcounted cached prefix page: the K/V rows of one
+    page-aligned token block, resident in a band page. `refcount` counts
+    live sequences currently sharing the entry (copy-on-write readers plus
+    in-flight installs); only refcount-0 entries are evictable."""
+
+    __slots__ = ("key", "parent", "tokens", "page", "refcount", "tick",
+                 "hits")
+
+    def __init__(self, key: bytes, parent: bytes, tokens: np.ndarray,
+                 page: int):
+        self.key = key
+        self.parent = parent
+        self.tokens = tokens
+        self.page = page
+        self.refcount = 0
+        self.tick = 0
+        self.hits = 0
+
+
+class PrefixCache:
+    """Hash-addressed store of immutable, refcounted prefix pages.
+
+    At millions-of-users scale most traffic shares a system prompt or
+    few-shot preamble; this cache lets the continuous batcher prefill each
+    distinct prefix ONCE. Entries are page-aligned token blocks keyed by
+    rolling hash (`_chain_key`), each owning one page in a device-side
+    *band* — extra cache rows the batcher allocates next to the decode
+    slots (continuous.py owns the arrays; the cache only hands out band
+    page ids). On schedule, the longest cached prefix of the new prompt is
+    matched and its rows are installed into the sequence's slot by a
+    device-side copy (cheaper than recomputing the prefill), and only the
+    suffix is prefilled.
+
+    Copy-on-write semantics: a sequence that matches shares the entries
+    (refcount++) for its lifetime; its own slot rows are the eagerly
+    materialized private copy the attention kernel reads (the kernel is
+    slot-dense, so sharing is by page table + copy, not aliasing), which
+    is why a diverging writer can never mutate a page another sequence
+    still reads — band pages are written exactly once at insert and are
+    only reused after eviction, which refcount>0 blocks. `cow_break`
+    severs a sequence's share from a given position onward (the defensive
+    path for a write that would land inside a shared block; unreachable
+    with page-aligned matching, but the contract is enforced, not
+    assumed). Eviction is LRU over refcount-0 entries under the
+    `capacity_pages` budget.
+    """
+
+    def __init__(self, capacity_pages: int, page_size: int,
+                 registry=None, label: Optional[str] = None):
+        if capacity_pages < 1:
+            raise ValueError(
+                f"capacity_pages={capacity_pages}: need >= 1 (omit the"
+                " cache entirely to disable prefix reuse)")
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size}: need >= 1")
+        self.capacity = int(capacity_pages)
+        self.page_size = int(page_size)
+        self.label = label or f"pool{next(_POOL_IDS)}"
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, _PrefixEntry] = {}
+        self._free_pages: List[int] = list(range(self.capacity))[::-1]
+        self._pins: Dict[object, List[_PrefixEntry]] = {}
+        self._ticks = itertools.count(1)
+        self._pages_saved = 0
+        self._inserts = 0
+        self._evictions = 0
+        if registry is None:
+            from ...obs.registry import REGISTRY as registry  # noqa: N813
+        self._c_hits = registry.counter(
+            "ff_prefix_cache_hits_total",
+            "Scheduled requests that installed >=1 cached prefix page",
+            labels=("pool",))
+        self._c_misses = registry.counter(
+            "ff_prefix_cache_misses_total",
+            "Scheduled requests with no cached prefix", labels=("pool",))
+        self._c_evictions = registry.counter(
+            "ff_prefix_cache_evictions_total",
+            "Prefix pages evicted (LRU, refcount-0)", labels=("pool",))
+        self._g_pages = registry.gauge(
+            "ff_prefix_cache_pages",
+            "Band pages holding cached prefix KV", labels=("pool",))
+        self._g_saved = registry.gauge(
+            "ff_kvpool_pages_saved",
+            "Cumulative prefill pages skipped via prefix reuse",
+            labels=("pool",))
+        self._c_hits.inc(0, pool=self.label)
+        self._c_misses.inc(0, pool=self.label)
+        self._g_pages.set(0, pool=self.label)
+        self._g_saved.set(0, pool=self.label)
+
+    # -- lookup ------------------------------------------------------------
+    def _walk(self, tokens: np.ndarray) -> List[_PrefixEntry]:
+        """Longest cached chain over the prompt's full page-aligned blocks
+        (lock held). Content-verified: a hash collision or an evicted
+        parent stops the walk."""
+        tokens = np.asarray(tokens)
+        out: List[_PrefixEntry] = []
+        parent = b""
+        for b in range(int(tokens.size) // self.page_size):
+            blk = tokens[b * self.page_size:(b + 1) * self.page_size]
+            key = _chain_key(parent, blk)
+            e = self._entries.get(key)
+            if e is None or not np.array_equal(e.tokens, blk):
+                break
+            out.append(e)
+            parent = key
+        return out
+
+    def match(self, tokens) -> Tuple[int, List[_PrefixEntry]]:
+        """Probe only (no pin, no hit/miss accounting): the longest cached
+        prefix as (matched tokens, entries). Admission uses this to credit
+        expected sharing against its page budget."""
+        with self._lock:
+            entries = self._walk(tokens)
+            return len(entries) * self.page_size, list(entries)
+
+    def acquire(self, seq_id, tokens,
+                max_pages: Optional[int] = None) -> Tuple[int, List[_PrefixEntry]]:
+        """Pin the longest cached prefix for a sequence being scheduled:
+        each matched entry's refcount rises for the sequence's lifetime
+        (released by `release`, normally via PagedKVPool.free). Returns
+        (matched tokens, entries) — the caller installs the entries' band
+        pages into the sequence's slot. max_pages caps the match (the
+        scheduler always leaves >= 1 suffix token to prefill, since the
+        first output token's logits come from the last prompt position)."""
+        with self._lock:
+            if seq_id in self._pins:
+                raise ValueError(f"sequence {seq_id!r} already holds pins")
+            entries = self._walk(tokens)
+            if max_pages is not None:
+                entries = entries[:max(0, int(max_pages))]
+            tick = next(self._ticks)
+            for e in entries:
+                e.refcount += 1
+                e.tick = tick
+                e.hits += 1
+            if entries:
+                self._pins[seq_id] = entries
+                self._pages_saved += len(entries)
+                self._c_hits.inc(pool=self.label)
+                self._g_saved.set(self._pages_saved, pool=self.label)
+            else:
+                self._c_misses.inc(pool=self.label)
+            return len(entries) * self.page_size, list(entries)
+
+    def release(self, seq_id) -> None:
+        """Drop a sequence's pins (idempotent): entries become evictable
+        once no other reader shares them."""
+        with self._lock:
+            for e in self._pins.pop(seq_id, ()):
+                e.refcount -= 1
+
+    def cow_break(self, seq_id, pos: int) -> int:
+        """Copy-on-write break: the sequence is about to write at token
+        position `pos`, which may fall inside pages it still shares.
+        Releases its pins from the containing block onward (the sequence's
+        slot rows are already its private copy, so the break is pure
+        unsharing — the cached pages themselves are never touched).
+        Returns the number of entries unshared."""
+        with self._lock:
+            pins = self._pins.get(seq_id)
+            if not pins:
+                return 0
+            keep = max(0, int(pos)) // self.page_size
+            broken = pins[keep:]
+            del pins[keep:]
+            for e in broken:
+                e.refcount -= 1
+            if not pins:
+                self._pins.pop(seq_id, None)
+            return len(broken)
+
+    def shared_tokens(self, seq_id) -> int:
+        """Tokens of the sequence's prompt currently backed by shared
+        (pinned) prefix pages."""
+        with self._lock:
+            return len(self._pins.get(seq_id, ())) * self.page_size
+
+    # -- insert / evict ----------------------------------------------------
+    def insert(self, tokens, n_tokens: int, copy_pages) -> int:
+        """Register every full page of tokens[:n_tokens] not already
+        cached, extending the existing chain. `copy_pages(pairs)` — with
+        `pairs` a list of (block_index, band_page) — performs the
+        device-side copy of ALL new blocks' K/V rows into their band
+        pages in one call, before the entries become matchable. Stops
+        claiming pages when the budget is exhausted and nothing is
+        evictable — a full cache under load degrades to fewer inserts,
+        never to an error. Returns the number of pages inserted."""
+        tokens = np.asarray(tokens)
+        n_full = max(0, int(n_tokens)) // self.page_size
+        with self._lock:
+            parent = b""
+            tick = next(self._ticks)
+            fresh: List[tuple] = []  # (block, page, key, parent, tokens)
+            for b in range(n_full):
+                blk = tokens[b * self.page_size:(b + 1) * self.page_size]
+                key = _chain_key(parent, blk)
+                e = self._entries.get(key)
+                if e is not None and np.array_equal(e.tokens, blk):
+                    e.tick = tick  # re-validated: keep the chain hot
+                    parent = key
+                    continue
+                if e is not None:
+                    # true hash collision: keep the resident entry
+                    break
+                page = self._claim_page()
+                if page is None:
+                    break  # budget exhausted, nothing evictable
+                fresh.append((b, page, key, parent,
+                              np.array(blk, copy=True)))
+                parent = key
+            if not fresh:
+                return 0
+            copy_pages([(b, page) for b, page, _, _, _ in fresh])
+            for b, page, key, par, blk in fresh:
+                e = _PrefixEntry(key, par, blk, page)
+                e.tick = tick
+                self._entries[key] = e
+            self._inserts += len(fresh)
+            self._g_pages.set(self.capacity - len(self._free_pages),
+                              pool=self.label)
+        return len(fresh)
+
+    def _claim_page(self) -> Optional[int]:
+        """A free band page, evicting the LRU refcount-0 entry if none
+        (lock held). Entries another sequence still reads (refcount > 0)
+        are never reclaimed — that is the write-isolation guarantee."""
+        if self._free_pages:
+            return self._free_pages.pop()
+        victim = None
+        for e in self._entries.values():
+            if e.refcount == 0 and (victim is None or e.tick < victim.tick):
+                victim = e
+        if victim is None:
+            return None
+        del self._entries[victim.key]
+        self._evictions += 1
+        self._c_evictions.inc(pool=self.label)
+        return victim.page
+
+    # -- accounting --------------------------------------------------------
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free_pages)
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def refcount_of(self, tokens) -> List[int]:
+        """Refcounts along the cached chain for `tokens` (test/debug)."""
+        with self._lock:
+            return [e.refcount for e in self._walk(tokens)]
+
+    def pages_saved(self) -> int:
+        with self._lock:
+            return self._pages_saved
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            hits = self._c_hits.value(pool=self.label)
+            misses = self._c_misses.value(pool=self.label)
+            return {
+                "capacity_pages": self.capacity,
+                "pages_in_use": self.capacity - len(self._free_pages),
+                "entries": len(self._entries),
+                "hits": int(hits),
+                "misses": int(misses),
+                "inserts": self._inserts,
+                "evictions": self._evictions,
+                "pages_saved": self._pages_saved,
+            }
+
+
 class PagedKVPool:
     """Page allocator + accounting over the slot-dense KV cache arrays.
 
@@ -56,7 +356,8 @@ class PagedKVPool:
     """
 
     def __init__(self, num_slots: int, max_len: int, page_size: int = 16,
-                 registry=None, label: Optional[str] = None):
+                 registry=None, label: Optional[str] = None,
+                 prefix_cache_pages: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots={num_slots}: need at least one")
         if page_size < 1:
@@ -70,6 +371,17 @@ class PagedKVPool:
         # one process (a multi-model server) must not clobber each other's
         # set()-style gauges
         self.label = label or f"pool{next(_POOL_IDS)}"
+        # hash-addressed prefix reuse (0 pages = disabled): the cache's
+        # pages live in a device-side BAND next to the decode slots —
+        # `band_slots` extra cache rows the batcher allocates, addressed
+        # through `band_coords`. A slot shorter than one page can't hold
+        # any full band page (and no prompt could have a cacheable full
+        # block anyway), so the cache is off.
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(prefix_cache_pages, self.page_size,
+                        registry=registry, label=self.label)
+            if prefix_cache_pages and self.max_len >= self.page_size
+            else None)
         self._lock = threading.Lock()
         self._free_slots: List[int] = list(range(self.num_slots))[::-1]
         # seq_id -> (slot, [page ids]) ; pages are consecutive blocks of
@@ -92,6 +404,31 @@ class PagedKVPool:
         """Pages a sequence of n_tokens occupies (>= 1: even an empty
         reservation pins its first page so admission stays conservative)."""
         return max(1, math.ceil(n_tokens / self.page_size))
+
+    @property
+    def full_pages_per_slot(self) -> int:
+        """Full page_size-row pages one slot's rows can hold. Distinct
+        from `pages_per_slot` (ceil — a sequence's PARTIAL last page still
+        occupies a page of budget): the band below packs only FULL pages,
+        because a band page must hold page_size real rows — packing one
+        into a slot's partial tail would clamp the device copy at the
+        array edge and corrupt the neighboring page."""
+        return self.max_len // self.page_size
+
+    @property
+    def band_slots(self) -> int:
+        """Extra slot-shaped cache rows the prefix cache's band needs on
+        the device arrays (0 when prefix reuse is disabled)."""
+        if self.prefix is None:
+            return 0
+        return math.ceil(self.prefix.capacity / self.full_pages_per_slot)
+
+    def band_coords(self, page: int) -> Tuple[int, int]:
+        """(band slot index, row offset) of a prefix-cache band page —
+        band slot 0 is the first slot AFTER the decode slots in the
+        batcher's device arrays."""
+        full = self.full_pages_per_slot
+        return page // full, (page % full) * self.page_size
 
     # -- allocation --------------------------------------------------------
     def alloc(self, seq_id, n_tokens: int) -> int:
@@ -136,8 +473,11 @@ class PagedKVPool:
         self._sync_gauges()
 
     def free(self, seq_id) -> None:
-        """Release a sequence's slot and pages (idempotent: freeing an
-        unknown id is a no-op so failure paths can always clean up)."""
+        """Release a sequence's slot and pages, and drop any prefix-cache
+        pins it holds (idempotent: freeing an unknown id is a no-op so
+        failure paths can always clean up)."""
+        if self.prefix is not None:
+            self.prefix.release(seq_id)
         with self._lock:
             ent = self._table.pop(seq_id, None)
             self._tokens.pop(seq_id, None)
@@ -174,7 +514,7 @@ class PagedKVPool:
         return self.pages_used() / self.total_pages
 
     def stats(self) -> Dict[str, float]:
-        return {
+        out = {
             "slots": self.num_slots,
             "slots_free": self.free_slot_count(),
             "pages_used": self.pages_used(),
@@ -182,6 +522,9 @@ class PagedKVPool:
             "page_size": self.page_size,
             "utilization": round(self.utilization(), 4),
         }
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        return out
 
     def _sync_gauges(self) -> None:
         self._g_used.set(self.pages_used(), pool=self.label)

@@ -251,9 +251,13 @@ class InferenceServer:
         POST /v2/models/<name>/generate: requests stream through the
         iteration-level scheduler instead of serializing on a per-session
         lock, and AdmissionError rejections surface as HTTP 429/400
-        backpressure. The batcher's decode policy (temperature/top_k) is
-        fixed at construction — same compile-DoS rule as
-        register_generative."""
+        backpressure. Prompts from many clients that share a system-prompt
+        prefix are prefilled once (the batcher's prefix cache installs
+        cached KV by device copy; streaming responses report `cache_hit`
+        and `prefix_tokens` in the done trailer), and long prompts are
+        chunk-prefilled without stalling other clients' decodes. The
+        batcher's decode policy (temperature/top_k) is fixed at
+        construction — same compile-DoS rule as register_generative."""
         if name in self._generative:
             raise ValueError(
                 f"{name!r} already has a lockstep generative session;"
@@ -482,7 +486,16 @@ class InferenceServer:
                         self.wfile.write(
                             (json.dumps({"token": tok}) + "\n").encode())
                         self.wfile.flush()
-                    trailer = {"done": True, "tokens": toks}
+                    # cache_hit/prefix_tokens: the prefix-cache outcome
+                    # (serving/sched/kvpool.py) — lets clients see why
+                    # their TTFT was what it was
+                    trailer = {
+                        "done": True, "tokens": toks,
+                        "cache_hit": bool(gen.cache_hit),
+                        "prefix_tokens": int(gen.prefix_tokens),
+                        "ttft_ms": (round(gen.ttft_s * 1e3, 3)
+                                    if gen.ttft_s is not None else None),
+                    }
                 except OSError:  # client disconnected mid-stream
                     return
                 except Exception as e:  # headers already sent: error trailer

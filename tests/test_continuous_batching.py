@@ -236,7 +236,10 @@ def test_continuous_sampling_deterministic_and_traffic_independent(lm):
 def test_continuous_admission_rejections(lm):
     with ContinuousBatcher(lm, max_len=12, num_slots=1, page_size=4,
                            max_queue=2) as cb:
-        with pytest.raises(RequestTooLarge, match="prefill window"):
+        # chunked prefill (the default) removed the prompt <= window cap:
+        # a 13-token prompt against the 12-token window is only rejected
+        # because prompt + max_new exceeds the per-slot cache span
+        with pytest.raises(RequestTooLarge, match="cache capacity"):
             cb.submit(np.ones(13, np.int32), 2)
         with pytest.raises(RequestTooLarge, match="cache capacity"):
             cb.submit(np.ones(8, np.int32), 8)
@@ -249,6 +252,12 @@ def test_continuous_admission_rejections(lm):
         rej = REGISTRY.counter("ff_serving_rejections_total",
                                labels=("reason",))
         assert rej.value(reason="too_large") == 2
+    # the one-shot path keeps the window cap (it pads the prompt to the
+    # model's declared input length)
+    with ContinuousBatcher(lm, max_len=16, num_slots=1, page_size=4,
+                           max_queue=2, prefill_chunk_tokens=0) as cb:
+        with pytest.raises(RequestTooLarge, match="prefill window"):
+            cb.submit(np.ones(13, np.int32), 2)
 
 
 def test_continuous_stop_fails_queued_typed(lm):

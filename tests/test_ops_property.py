@@ -6,9 +6,12 @@ All properties run the REAL op lowerings through a jitted forward on the CPU
 backend with mixed precision off (exact f32).
 """
 import numpy as np
-from hypothesis import assume, given, settings, strategies as st
+import pytest
 
-import flexflow_tpu as ff
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+from hypothesis import assume, given, settings, strategies as st  # noqa: E402
+
+import flexflow_tpu as ff  # noqa: E402
 
 SETTINGS = dict(max_examples=20, deadline=None)
 

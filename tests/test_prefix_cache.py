@@ -1,0 +1,312 @@
+"""Multi-tenant KV reuse (ISSUE 6): the hash-addressed prefix cache,
+copy-on-write page semantics, chunked prefill, and admission's sharing
+credit.
+
+The decisive properties:
+ - chunked prefill is TOKEN-IDENTICAL to the one-shot path (and to the
+   lockstep GenerativeSession) for the same prompt, at any chunk size;
+ - a prefix-cache HIT decodes token-identically to a cold run — shared
+   pages are immutable, so no amount of divergent co-traffic can leak
+   into another request's tokens;
+ - refcounts block eviction while any live sequence shares an entry, and
+   LRU reclaims only refcount-0 pages.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu.serving.generate import GenerativeSession
+from flexflow_tpu.serving.sched import (AdmissionController,
+                                        ContinuousBatcher, PagedKVPool,
+                                        PrefixCache, RequestTooLarge)
+from tests.test_generate import _build_lm
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One compiled LM shared by the module (b=2, window=12)."""
+    return _build_lm(2, 12)
+
+
+def _prompts(lens, seed=0, vocab=50):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(n,)).astype(np.int32) for n in lens]
+
+
+# ---------------------------------------------------------------------
+# PrefixCache units: match/insert/refcount/eviction
+# ---------------------------------------------------------------------
+def test_prefix_cache_insert_and_longest_match():
+    c = PrefixCache(capacity_pages=8, page_size=4)
+    toks = np.arange(1, 15, dtype=np.int32)  # 14 tokens = 3 full pages
+    copies = []
+    assert c.insert(toks, 14, copies.extend) == 3
+    assert [b for b, _ in copies] == [0, 1, 2]
+    assert c.pages_in_use() == 3 and c.entry_count() == 3
+    # longest match walks the chain; a diverging block stops it
+    assert c.match(toks)[0] == 12
+    assert c.match(toks[:9])[0] == 8
+    other = toks.copy()
+    other[5] = 99  # diverges inside block 1
+    assert c.match(other)[0] == 4
+    # re-insert is idempotent (no new pages, ticks refreshed)
+    assert c.insert(toks, 14, copies.extend) == 0
+    assert len(copies) == 3
+
+
+def test_prefix_cache_refcounts_pin_and_release():
+    c = PrefixCache(capacity_pages=8, page_size=4)
+    toks = np.arange(1, 14, dtype=np.int32)
+    c.insert(toks, 13, lambda pairs: None)
+    n, entries = c.acquire("s1", toks)
+    assert n == 12 and [e.refcount for e in entries] == [1, 1, 1]
+    # max_pages caps the match (the scheduler leaves >= 1 suffix token)
+    n2, _ = c.acquire("s2", toks, max_pages=2)
+    assert n2 == 8 and c.refcount_of(toks) == [2, 2, 1]
+    with pytest.raises(ValueError, match="already holds pins"):
+        c.acquire("s1", toks)
+    c.release("s1")
+    c.release("s1")  # idempotent
+    assert c.refcount_of(toks) == [1, 1, 0]
+    c.release("s2")
+    assert c.refcount_of(toks) == [0, 0, 0]
+    assert c.stats()["hits"] == 2 and c.stats()["pages_saved"] == 5
+
+
+def test_prefix_cache_lru_evicts_only_refcount_zero():
+    c = PrefixCache(capacity_pages=2, page_size=4)
+    a = np.arange(1, 5, dtype=np.int32)
+    b = np.arange(11, 15, dtype=np.int32)
+    c.insert(a, 4, lambda *_: None)
+    c.insert(b, 4, lambda *_: None)
+    assert c.pages_in_use() == 2
+    # 'a' is pinned by a live sequence; 'b' is LRU but unpinned
+    c.acquire("s", a)
+    d = np.arange(21, 25, dtype=np.int32)
+    assert c.insert(d, 4, lambda *_: None) == 1  # evicted 'b'
+    assert c.match(b)[0] == 0 and c.match(a)[0] == 4
+    assert c.stats()["evictions"] == 1
+    # everything pinned -> nothing evictable -> insert degrades to no-op
+    c.acquire("s2", d)
+    e = np.arange(31, 35, dtype=np.int32)
+    assert c.insert(e, 4, lambda *_: None) == 0
+    assert c.match(a)[0] == 4 and c.match(d)[0] == 4
+
+
+def test_prefix_cache_cow_break_unshares_without_mutating():
+    """A writer diverging inside shared pages severs ITS share from the
+    containing block onward; the cached pages (and other readers) are
+    untouched — the copy-on-write contract."""
+    c = PrefixCache(capacity_pages=8, page_size=4)
+    toks = np.arange(1, 14, dtype=np.int32)
+    c.insert(toks, 13, lambda pairs: None)
+    c.acquire("w", toks)   # the writer
+    c.acquire("r", toks)   # an innocent reader
+    assert c.shared_tokens("w") == 12
+    assert c.cow_break("w", 6) == 2  # writes at pos 6 -> blocks 1,2 unshared
+    assert c.shared_tokens("w") == 4
+    assert c.refcount_of(toks) == [2, 1, 1]
+    # the reader still matches the full chain: content never mutated
+    assert c.match(toks)[0] == 12
+    c.release("w")
+    c.release("r")
+    assert c.refcount_of(toks) == [0, 0, 0]
+
+
+def test_pool_band_geometry_uses_full_pages_only():
+    """Band pages must hold page_size REAL rows: a slot's partial tail
+    page is unusable (packing it would clamp the device copy and corrupt
+    the neighboring page — the bug this test pins)."""
+    pool = PagedKVPool(2, 30, page_size=8, prefix_cache_pages=7)
+    assert pool.pages_per_slot == 4       # sequences: ceil(30/8)
+    assert pool.full_pages_per_slot == 3  # band packing: floor(30/8)
+    assert pool.band_slots == 3           # ceil(7/3)
+    seen = set()
+    for p in range(7):
+        slot, row = pool.band_coords(p)
+        assert row + pool.page_size <= pool.max_len, (p, slot, row)
+        seen.add((slot, row))
+    assert len(seen) == 7  # no two pages alias
+    # a pool whose slots can't hold one full page disables the cache
+    assert PagedKVPool(1, 6, page_size=8, prefix_cache_pages=4).prefix is None
+
+
+def test_pool_free_releases_prefix_pins():
+    pool = PagedKVPool(2, 32, page_size=8, prefix_cache_pages=4)
+    toks = np.arange(1, 20, dtype=np.int32)
+    pool.prefix.insert(toks, 19, lambda pairs: None)
+    pool.alloc("s", 19)
+    pool.prefix.acquire("s", toks)
+    assert pool.prefix.refcount_of(toks) == [1, 1]
+    pool.free("s")
+    assert pool.prefix.refcount_of(toks) == [0, 0]
+    assert "prefix" in pool.stats()
+
+
+# ---------------------------------------------------------------------
+# Admission: sharing credit + windowless (chunked) mode
+# ---------------------------------------------------------------------
+def test_admission_credits_expected_sharing():
+    pool = PagedKVPool(num_slots=1, max_len=32, page_size=4)
+    adm = AdmissionController(pool, window=None, max_queue=8,
+                              queue_pages_budget=6)
+    # 24 worst-case tokens = 6 pages: fills the budget exactly when cold
+    adm.admit("cold", 16, 8)
+    with pytest.raises(Exception):
+        adm.admit("cold2", 16, 8)
+    adm.release("cold")
+    # the same request with 4 expected shared pages costs only 2
+    adm.admit("warm", 16, 8, shared_pages=4)
+    assert adm.backlog_pages() == 2
+    adm.admit("warm2", 16, 8, shared_pages=4)
+    adm.release("warm")
+    adm.release("warm2")
+    # the credit never touches the static per-slot capacity check
+    with pytest.raises(RequestTooLarge, match="cache capacity"):
+        adm.admit("huge", 30, 8, shared_pages=100)
+
+
+def test_admission_windowless_admits_long_prompts():
+    pool = PagedKVPool(num_slots=1, max_len=64, page_size=4)
+    adm = AdmissionController(pool, window=None, max_queue=4)
+    adm.admit("long", 40, 8)  # longer than any typical model window
+    adm.release("long")
+    capped = AdmissionController(pool, window=12, max_queue=4)
+    with pytest.raises(RequestTooLarge, match="prefill window"):
+        capped.admit("long", 40, 8)
+
+
+# ---------------------------------------------------------------------
+# Chunked prefill: token parity + window-free prompts
+# ---------------------------------------------------------------------
+def test_chunked_prefill_token_parity_with_one_shot_and_lockstep(lm):
+    """The same prompts through lockstep, one-shot continuous, and
+    chunked continuous (awkward chunk size on purpose): identical greedy
+    tokens everywhere."""
+    prompts = _prompts([4, 7, 3], seed=0)
+    session = GenerativeSession(lm, max_len=12)
+    refs = [session.generate(p[None, :], 5)[0] for p in prompts]
+    kw = dict(max_len=12, num_slots=2, page_size=4, max_queue=8,
+              prefix_cache_pages=0)
+    with ContinuousBatcher(lm, prefill_chunk_tokens=0, **kw) as cb:
+        oneshot = [cb.submit(p, 5).result(timeout=300) for p in prompts]
+    with ContinuousBatcher(lm, prefill_chunk_tokens=3, **kw) as cb:
+        chunked = [cb.submit(p, 5).result(timeout=300) for p in prompts]
+    for ref, a, b in zip(refs, oneshot, chunked):
+        np.testing.assert_array_equal(a, np.asarray(ref))
+        np.testing.assert_array_equal(b, np.asarray(ref))
+
+
+def test_chunked_prefill_last_chunk_never_clamps(lm):
+    """The final chunk always dispatches at FULL chunk width, so with a
+    prompt ending near max_len its cache write would run past the array
+    edge — and dynamic_update_slice silently CLAMPS the start index,
+    shifting real prompt K/V rows (the bug this pins: chunk=7 on
+    15-token prompts in a max_len=20 cache diverged from chunk=5 on 1 of
+    4 prompts before the slack-row fix in _zero_small)."""
+    prompts = _prompts([15, 15, 15, 15], seed=13)
+    outs = {}
+    for chunk in (5, 7):
+        with ContinuousBatcher(lm, max_len=20, num_slots=2, page_size=4,
+                               prefill_chunk_tokens=chunk,
+                               prefix_cache_pages=0, max_queue=8) as cb:
+            outs[chunk] = [cb.submit(p, 4).result(timeout=300)
+                           for p in prompts]
+    for a, b in zip(outs[5], outs[7]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_chunked_prefill_admits_prompt_longer_than_window(lm):
+    """The model window is 12; a 15-token prompt one-shot would be a 400.
+    Chunked mode admits it and chunk size does not change the tokens
+    (chunking invariance is the only available reference: no other path
+    can run this prompt)."""
+    [p] = _prompts([15], seed=2)
+    outs = {}
+    for chunk in (4, 7):
+        with ContinuousBatcher(lm, max_len=20, num_slots=2, page_size=4,
+                               prefill_chunk_tokens=chunk,
+                               prefix_cache_pages=0, max_queue=4) as cb:
+            outs[chunk] = cb.submit(p, 4).result(timeout=300)
+    np.testing.assert_array_equal(outs[4], outs[7])
+    assert len(outs[4]) == 4
+    with ContinuousBatcher(lm, max_len=20, num_slots=2, page_size=4,
+                           prefill_chunk_tokens=0, max_queue=4) as cb:
+        with pytest.raises(RequestTooLarge, match="prefill window"):
+            cb.submit(p, 4)
+
+
+# ---------------------------------------------------------------------
+# Prefix-cache hits: parity, CoW isolation, accounting
+# ---------------------------------------------------------------------
+def test_prefix_hit_token_parity_and_divergence_isolation(lm):
+    """Shared prefix, divergent suffixes, interleaved: every request's
+    greedy tokens are identical to a cold lockstep run of its own prompt,
+    and a request that diverges after the shared prefix cannot perturb a
+    later request that reuses it (the shared pages are immutable)."""
+    rng = np.random.RandomState(7)
+    pre = rng.randint(1, 50, size=(8,)).astype(np.int32)  # 2 full pages
+    mk = lambda n: np.concatenate(  # noqa: E731
+        [pre, rng.randint(1, 50, size=(n,)).astype(np.int32)])
+    a, b, c = mk(3), mk(2), mk(4)
+    session = GenerativeSession(lm, max_len=20)
+    refs = [session.generate(x[None, :], 5)[0] for x in (a, b, c)]
+    with ContinuousBatcher(lm, max_len=20, num_slots=2, page_size=4,
+                           max_queue=8) as cb:
+        ra = cb.submit(a, 5)
+        np.testing.assert_array_equal(ra.result(timeout=300),
+                                      np.asarray(refs[0]))
+        assert not ra.cache_hit  # cold leader
+        # b and c share the prefix, diverge after it, run interleaved
+        rb, rc = cb.submit(b, 5), cb.submit(c, 5)
+        np.testing.assert_array_equal(rb.result(timeout=300),
+                                      np.asarray(refs[1]))
+        np.testing.assert_array_equal(rc.result(timeout=300),
+                                      np.asarray(refs[2]))
+        assert rb.cache_hit and rc.cache_hit
+        assert rb.prefix_tokens == 8 and rc.prefix_tokens == 8
+        # a fresh reuse AFTER the divergent traffic finished still decodes
+        # identically: nothing leaked into the shared pages
+        rd = cb.submit(a, 5)
+        np.testing.assert_array_equal(rd.result(timeout=300),
+                                      np.asarray(refs[0]))
+        assert rd.cache_hit
+        st = cb.stats()["pool"]["prefix"]
+        assert st["hits"] == 3 and st["pages_saved"] == 6
+        assert st["pages_in_use"] > 0
+    # all pins released at retire
+    assert cb.pool.prefix.refcount_of(a) == [0, 0]
+
+
+def test_prefix_cache_ttft_histogram_split_by_outcome(lm):
+    from flexflow_tpu.obs import REGISTRY
+
+    [p] = _prompts([9], seed=9)
+    with ContinuousBatcher(lm, max_len=16, num_slots=2, page_size=4,
+                           max_queue=8) as cb:
+        cb.submit(p, 3).result(timeout=300)
+        cb.submit(p[:9], 3).result(timeout=300)
+    h = REGISTRY.histogram("ff_serving_ttft_ms", labels=("cache",))
+    assert h.count(cache="miss") == 1
+    assert h.count(cache="hit") == 1
+    g = REGISTRY.gauge("ff_kvpool_pages_saved", labels=("pool",))
+    assert g.value(pool=cb.pool.label) == 2
+
+
+def test_prefix_cache_survives_slot_churn(lm):
+    """One slot, many sequenced requests sharing a prefix: every request
+    reuses the slot the previous one released, and hits stay exact (the
+    band is independent of slot reuse)."""
+    rng = np.random.RandomState(3)
+    pre = rng.randint(1, 50, size=(4,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [pre, rng.randint(1, 50, size=(3,)).astype(np.int32)])
+        for _ in range(3)]
+    session = GenerativeSession(lm, max_len=16)
+    refs = [session.generate(p[None, :], 4)[0] for p in prompts]
+    with ContinuousBatcher(lm, max_len=16, num_slots=1, page_size=4,
+                           max_queue=8, queue_pages_budget=64) as cb:
+        for p, ref in zip(prompts, refs):
+            np.testing.assert_array_equal(
+                cb.submit(p, 4).result(timeout=300), np.asarray(ref))
+    st = cb.stats()["pool"]["prefix"]
+    assert st["hits"] == 2 and st["misses"] == 1
