@@ -153,6 +153,12 @@ class FFConfig:
     simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
+    # Fitted machine profile (obs/refit.py): measured coefficient overlay
+    # (effective flop rate per dtype, link bandwidth, latency terms) loaded
+    # by make_machine_model over the hand-set ChipSpec constants, so every
+    # search/simulation prices with measured reality. Written by
+    # `python -m flexflow_tpu profile --refit`.
+    fitted_profile_file: Optional[str] = None
     print_freq: int = 10
     iteration_config: FFIterationConfig = dataclasses.field(
         default_factory=FFIterationConfig
@@ -276,6 +282,8 @@ class FFConfig:
                 self.machine_model_version = int(take())
             elif a == "--machine-model-file":
                 self.machine_model_file = take()
+            elif a == "--fitted-profile":
+                self.fitted_profile_file = take()
             elif a == "--simulator-workspace-size":
                 self.simulator_work_space_size = int(take())
             elif a == "--print-freq":

@@ -41,17 +41,19 @@ import json
 import os
 import random
 import tempfile
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.registry import REGISTRY
 from ..obs.tracing import get_tracer
 from ..runtime.checkpoint import CheckpointError
 from ..runtime.durability import DurableCheckpointer
 from .detector import FailureDetector
-from .events import (CHECKPOINT, PLAN_ANALYSIS, RECOVERY_DONE,
-                     RECOVERY_RESTORE, RECOVERY_SEARCH, RECOVERY_START,
-                     EventLog)
+from .events import (CHECKPOINT, DRIFT_BREACH, DRIFT_REFIT, DRIFT_REPLAN,
+                     PLAN_ANALYSIS, RECOVERY_DONE, RECOVERY_RESTORE,
+                     RECOVERY_SEARCH, RECOVERY_START, EventLog)
 from .faults import FaultInjector, FaultPlan, TopologyLoss
 from .retry import RetryPolicy
 from .watchdog import OK, ROLLBACK, SKIP, TrainingWatchdog
@@ -113,8 +115,21 @@ class ElasticCoordinator:
                  max_recoveries: int = 2,
                  keep_checkpoints: int = 3,
                  watchdog="auto",
-                 max_rollbacks: int = 4):
+                 max_rollbacks: int = 4,
+                 drift_detector=None,
+                 drift_refit=None):
         self.model_builder = model_builder
+        # calibration-drift feedback loop (obs/refit.py): `drift_detector`
+        # (an obs.DriftDetector) watches committed step wall times; when
+        # it fires (within ITS re-plan budget), the coordinator runs
+        # `drift_refit(model, measured_step_us) -> fitted-profile path`
+        # (when given) and re-plans through the same
+        # rebuild->analyze->restore->resume pipeline recovery uses — the
+        # re-search pricing with the freshly fitted profile
+        self.drift_detector = drift_detector
+        self.drift_refit = drift_refit
+        self._fitted_profile_path: Optional[str] = None
+        self._drift_replans = 0
         self.events = events if events is not None else EventLog()
         self.checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
             prefix="ff_elastic_")
@@ -187,6 +202,11 @@ class ElasticCoordinator:
             elastic_step_wrapper=self.detector.wrap)
         if machine_model_file is not None:
             cfg.machine_model_file = machine_model_file
+        if self._fitted_profile_path is not None:
+            # every build after a refit prices with the fitted overlay —
+            # including recovery re-plans on a shrunken mesh (the profile
+            # is keyed by chip+backend, not mesh size)
+            cfg.fitted_profile_file = self._fitted_profile_path
         return cfg
 
     # -- checkpointing -----------------------------------------------------
@@ -213,6 +233,57 @@ class ElasticCoordinator:
             raise RecoveryFailed(
                 f"no restorable checkpoint in {self.checkpoint_dir!r}: "
                 f"{ce}") from cause
+
+    def _record_plan_analysis(self, model, step: int) -> None:
+        """Plan-sanitizer verdict on a rebuilt model for the event
+        stream: reuse compile()'s gate run when it happened, run the
+        pipeline fresh only when the gate was off. Shared by chip-loss
+        recovery and drift re-planning."""
+        report = getattr(model, "_analysis_report", None)
+        if report is None:
+            report = model.analyze_plan()
+        self.events.record(
+            PLAN_ANALYSIS, step=step,
+            errors=len(report.errors()), warnings=len(report.warnings()),
+            counts=report.counts())
+
+    def _restore_validated(self, model, cause: Exception) -> tuple:
+        """Restore the newest verified checkpoint into a freshly REBUILT
+        `model`: validate the restored parameter tree against the rebuilt
+        architecture (a non-deterministic builder must fail typed, not
+        mis-train), then reshard onto the model's mesh. Returns
+        (ckpt_step, path). The shared restore core of chip-loss recovery
+        and drift re-planning — one pipeline, one set of guarantees."""
+        expected = {name: set(ws) for name, ws in model.params.items()}
+        with get_tracer().span("elastic.restore"):
+            ckpt_step, path = self._restore_latest_verified(model, cause)
+        got = {name: set(ws) for name, ws in model.params.items()}
+        if expected != got:
+            missing = set(expected) - set(got)
+            extra = set(got) - set(expected)
+            raise RecoveryFailed(
+                "checkpoint does not match the rebuilt model's parameter "
+                f"tree (missing ops: {sorted(missing)}, unexpected ops: "
+                f"{sorted(extra)}) — the builder must produce the same "
+                "architecture across rebuilds") from cause
+        reshard_params(model)
+        return ckpt_step, path
+
+    def _rearm_drift(self, model) -> Optional[float]:
+        """Re-anchor the drift detector (when one is armed) to `model`'s
+        freshly priced prediction — after ANY re-plan (chip-loss shrink or
+        drift refit), the old prediction is stale and replayed steps would
+        read as calibration drift against it."""
+        if self.drift_detector is None:
+            return None
+        from ..obs.calibration import predicted_step_us
+
+        # predicted_step_us already prefers the search's own number and
+        # falls back to an analytic re-simulation — one selection rule
+        new_pred = predicted_step_us(model)
+        if new_pred:
+            self.drift_detector.rearm(new_pred)
+        return new_pred
 
     def _rollback(self) -> int:
         """Watchdog-triggered rollback: reload the last-good (verified)
@@ -294,44 +365,74 @@ class ElasticCoordinator:
             RECOVERY_SEARCH, step=self.detector.current_step,
             n_devices=len(survivors), axes=dict(model.parallel_axes),
             cost_us=(sr.cost_us if sr is not None else None))
-        # plan-sanitizer verdict on the re-planned model for the RECOVERY
-        # event stream: reuse compile()'s gate run when it happened, run
-        # the pipeline fresh only when the gate was off
-        report = getattr(model, "_analysis_report", None)
-        if report is None:
-            report = model.analyze_plan()
-        self.events.record(
-            PLAN_ANALYSIS, step=self.detector.current_step,
-            errors=len(report.errors()), warnings=len(report.warnings()),
-            counts=report.counts())
+        self._record_plan_analysis(model, self.detector.current_step)
         # 3. restore the newest VERIFIED checkpoint into the new model,
-        # resharded — a torn/corrupt latest file falls back to an older
-        # verified one instead of killing the recovery
+        # tree-validated and resharded — a torn/corrupt latest file falls
+        # back to an older verified one instead of killing the recovery;
+        # only a VALIDATED restore reports success, so a mismatched tree
+        # never leaves a recovery.restore event behind
         if self._last_ckpt is None:
             raise RecoveryFailed("no checkpoint to restore from") from exc
-        expected = {name: set(ws) for name, ws in model.params.items()}
-        with get_tracer().span("elastic.restore"):
-            ckpt_step, path = self._restore_latest_verified(model, exc)
-        got = {name: set(ws) for name, ws in model.params.items()}
-        if expected != got:
-            missing = set(expected) - set(got)
-            extra = set(got) - set(expected)
-            raise RecoveryFailed(
-                "checkpoint does not match the rebuilt model's parameter "
-                f"tree (missing ops: {sorted(missing)}, unexpected ops: "
-                f"{sorted(extra)}) — the builder must produce the same "
-                "architecture across rebuilds") from exc
-        # only a VALIDATED restore reshards and reports success — a
-        # mismatched tree must not leave a recovery.restore event behind
-        reshard_params(model)
+        ckpt_step, path = self._restore_validated(model, exc)
         self.events.record(RECOVERY_RESTORE, step=ckpt_step, path=path)
         # 4. swap in the recovered model and resume
         self.model = model
         self.device_ids = survivors
         self.detector.reset_latency()  # the rebuild's compile is not a
         #                                slow link; re-enter EWMA warmup
+        # the shrunken mesh has a NEW predicted step cost — without a
+        # rearm, replayed steps (legitimately slower per chip, plus the
+        # recompile spike) would read as calibration drift against the
+        # stale pre-loss prediction and burn the re-plan budget on a
+        # healthy plan
+        self._rearm_drift(model)
         self.events.record(RECOVERY_DONE, step=ckpt_step,
                            n_devices=len(survivors))
+        return ckpt_step
+
+    # -- drift-triggered re-plan -------------------------------------------
+    def _replan_for_drift(self, step: int) -> int:
+        """Budgeted calibration-drift re-plan (the drift detector already
+        enforces its own budget before firing): refit the machine-model
+        coefficients from measured reality (when a `drift_refit` hook is
+        given), re-search on the SAME mesh with the fitted profile
+        overlaid, restore the newest verified checkpoint into the
+        re-planned model, and resume from its step. The mesh is intact —
+        only the cost model's beliefs changed — so this is recovery's
+        re-plan pipeline minus the shrink, gated by the same analysis
+        pass."""
+        self._drift_replans += 1
+        det = self.drift_detector
+        measured = det.measured_step_us if det is not None else None
+        if det is not None:
+            det.note_replan()  # the budget is consumed HERE, where the
+            #                    re-plan actually happens — observe() only
+            #                    verdicts
+        with get_tracer().span("refit.replan", step=step,
+                               replan=self._drift_replans) as sp:
+            if self.drift_refit is not None and measured:
+                self._fitted_profile_path = self.drift_refit(
+                    self.model, measured)
+                self.events.record(DRIFT_REFIT, step=step,
+                                   profile=self._fitted_profile_path)
+            spec_path = self._write_spec(
+                f"replan_{self._drift_replans}.json")
+            model = self.model_builder(self._config_for(self.device_ids,
+                                                        spec_path))
+            # same plan-sanitizer gate + tree-validated restore pipeline
+            # recovery re-plans get
+            self._record_plan_analysis(model, step)
+            ckpt_step, path = self._restore_validated(
+                model, RuntimeError("drift replan"))
+            self.model = model
+            new_pred = self._rearm_drift(model)
+            self.events.record(
+                DRIFT_REPLAN, step=step, resume_step=ckpt_step,
+                predicted_step_us=new_pred, path=path)
+            sp.set(resume_step=ckpt_step, predicted_step_us=new_pred)
+        REGISTRY.counter(
+            "ff_replan_total",
+            "Calibration-drift-triggered budgeted re-plans").inc()
         return ckpt_step
 
     # -- training ----------------------------------------------------------
@@ -363,6 +464,7 @@ class ElasticCoordinator:
             it = step % spe
             lo, hi = it * bs, (it + 1) * bs
             inputs, label = model._prep_step_batch(x, y, lo, hi)
+            t_step0 = time.perf_counter()
             try:
                 # results land in temporaries: the elastic step wrapper
                 # disables buffer donation, so the pre-step state survives
@@ -380,6 +482,10 @@ class ElasticCoordinator:
                 step = resume
                 continue
             rec = {k: float(v) for k, v in mvals.items()}
+            # the float() conversions force device sync, so this wall time
+            # covers the whole step — what the drift detector compares
+            # against the plan's predicted step cost
+            step_wall_us = (time.perf_counter() - t_step0) * 1e6
             injector = self.detector.injector
             if injector is not None and injector.take_nan_step(step):
                 # a blown-up gradient surfaces in the step's outputs, not
@@ -410,6 +516,20 @@ class ElasticCoordinator:
             step += 1
             if step % self.checkpoint_every == 0 and step < total:
                 self._save(step)
+            if (self.drift_detector is not None and step < total
+                    and self.drift_detector.observe(step_wall_us)):
+                # step < total: a breach on the FINAL step has nothing
+                # left to re-plan for — re-searching and replaying
+                # already-committed steps would change nothing
+                # sustained calibration drift within the re-plan budget:
+                # refit + re-search, resume from the newest checkpoint
+                # (steps after it replay, as after any recovery)
+                det = self.drift_detector
+                self.events.record(DRIFT_BREACH, step=step,
+                                   drift=det.drift,
+                                   measured_step_us=det.measured_step_us)
+                step = self._replan_for_drift(step)
+                continue
         history = [committed[i] for i in sorted(committed) if i < total]
         return history
 

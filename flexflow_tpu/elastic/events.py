@@ -42,6 +42,10 @@ WATCHDOG_ROLLBACK = "watchdog.rollback"
 # injected durability faults (elastic/faults.py)
 FAULT_NAN_STEP = "fault.nan_step"
 FAULT_CORRUPT_CKPT = "fault.corrupt_checkpoint"
+# calibration-drift feedback loop (obs/refit.py + coordinator)
+DRIFT_BREACH = "drift.breach"
+DRIFT_REFIT = "drift.refit"
+DRIFT_REPLAN = "drift.replan"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1088,6 +1088,7 @@ class FFModel:
         steps_per_execution: int = 1,
         verbose: bool = False,
         watchdog=None,
+        drift_detector=None,
     ) -> List[Dict[str, float]]:
         """accum_steps > 1: gradient accumulation — each optimizer update
         averages the gradients of `accum_steps` consecutive microbatches of
@@ -1112,7 +1113,15 @@ class FFModel:
         in a row fit raises the typed NumericBlowup. This plain loop
         CANNOT skip or roll back a bad update — its jitted step donates
         the previous params, and there are no checkpoints here; train
-        under an ElasticCoordinator for skip-and-rollback recovery."""
+        under an ElasticCoordinator for skip-and-rollback recovery.
+
+        drift_detector: an optional obs.DriftDetector. Every committed
+        step's wall time feeds its measured-vs-predicted EMA
+        (`ff_calibration_drift` gauge / `ff_drift_breaches_total`
+        counter); a breach here only marks an `obs.drift` trace instant —
+        this plain loop cannot re-plan (same contract as the no-rollback
+        watchdog guard). Train under an ElasticCoordinator with a drift
+        detector for the budgeted refit + re-search path."""
         import jax
 
         assert self._compiled, "call compile() first"
@@ -1169,6 +1178,19 @@ class FFModel:
             # NumericBlowup after max_consecutive_bad bad steps
             if watchdog is not None and "loss" in mv:
                 watchdog.guard(self._step_count, mv["loss"])
+
+        def _drift_guard(rec: Dict[str, float]) -> None:
+            # feed the committed step's wall time to the drift detector;
+            # a breach verdict here can only be MARKED (trace instant +
+            # gauge/counter, done inside observe) — re-planning needs the
+            # ElasticCoordinator's loop
+            if drift_detector is None or rec.get("step_ms", 0) <= 0:
+                return
+            if drift_detector.observe(rec["step_ms"] * 1e3):
+                from .obs.tracing import get_tracer
+
+                get_tracer().instant("obs.drift", step=self._step_count,
+                                     drift=drift_detector.drift)
 
         history = []
         # per-step observability: every committed optimizer step (or
@@ -1237,7 +1259,9 @@ class FFModel:
                     self.perf_metrics.update(K * bs, mv)
                     # one record per K-step dispatch; StepStats divides the
                     # interval by K for the per-optimizer-step wall time
-                    stats.record_step(K * bs, loss=mv.get("loss"), steps=K)
+                    _drift_guard(
+                        stats.record_step(K * bs, loss=mv.get("loss"),
+                                          steps=K))
                     _wd_guard(mv)  # per-chunk: the K-step mean loss
                     return mv
 
@@ -1280,7 +1304,8 @@ class FFModel:
                         label, self._next_rng())
                     mvals = {k2: float(v) for k2, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
-                    stats.record_step(bs, loss=mvals.get("loss"))
+                    _drift_guard(
+                        stats.record_step(bs, loss=mvals.get("loss")))
                     _wd_guard(mvals)
                 dt = time.time() - t0
                 summ = self.perf_metrics.summary()
@@ -1323,8 +1348,9 @@ class FFModel:
                     mvals = {k2: float(v) / accum_steps
                              for k2, v in mvals.items()}
                     self.perf_metrics.update(accum_steps * bs, mvals)
-                    stats.record_step(accum_steps * bs,
-                                      loss=mvals.get("loss"))
+                    _drift_guard(
+                        stats.record_step(accum_steps * bs,
+                                          loss=mvals.get("loss")))
                     _wd_guard(mvals)
                 else:
                     self.params, self.opt_state, self.state, mvals = self._train_step(
@@ -1333,7 +1359,8 @@ class FFModel:
                     )
                     mvals = {k: float(v) for k, v in mvals.items()}
                     self.perf_metrics.update(bs, mvals)
-                    stats.record_step(bs, loss=mvals.get("loss"))
+                    _drift_guard(
+                        stats.record_step(bs, loss=mvals.get("loss")))
                     _wd_guard(mvals)
             dt = time.time() - t0
             summ = self.perf_metrics.summary()

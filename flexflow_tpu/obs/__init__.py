@@ -13,9 +13,15 @@ Three primitives, one catalogue (docs/observability.md):
 
 Plus `calibrate()` (calibration.py): the simulator's predicted step/op
 costs against measured reality — surfaced by
-`python -m flexflow_tpu profile`.
+`python -m flexflow_tpu profile` — and the feedback loop that closes on
+it (refit.py): `refit()` fits the machine-model coefficients from
+calibration data into a persisted `FittedProfile` overlay, and
+`DriftDetector` watches live step times for calibration drift, firing a
+budgeted re-plan through the ElasticCoordinator.
 """
 from .calibration import CalibrationReport, OpCalibration, calibrate
+from .refit import (DriftDetector, FittedCoefficients, FittedProfile,
+                    FittedProfileError, FittedProfileMismatch, refit)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                        get_registry, iter_samples, parse_exposition,
                        validate_exposition)
@@ -37,6 +43,8 @@ def reset_all() -> None:
 
 __all__ = [
     "CalibrationReport", "OpCalibration", "calibrate",
+    "DriftDetector", "FittedCoefficients", "FittedProfile",
+    "FittedProfileError", "FittedProfileMismatch", "refit",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "get_registry", "iter_samples", "parse_exposition",
     "validate_exposition",

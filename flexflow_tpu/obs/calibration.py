@@ -36,10 +36,19 @@ class OpCalibration:
     predicted_us: float
     measured_us: float  # NaN when the op is unmeasurable in isolation
     error: Optional[str] = None
+    # compute-dtype class ("bf16"/"f32") the prediction priced against —
+    # the refit layer fits a separate effective flop rate per class
+    dtype: str = ""
 
     @property
     def ratio(self) -> float:
-        if not (self.predicted_us > 0) or not math.isfinite(self.measured_us):
+        """measured/predicted, or NaN whenever either side is degenerate
+        (non-positive or non-finite) — a zero/negative measured time
+        (clock resolution on trivially small ops) must never produce a 0,
+        negative, or inf ratio in a report."""
+        if not (self.predicted_us > 0 and math.isfinite(self.predicted_us)
+                and self.measured_us > 0
+                and math.isfinite(self.measured_us)):
             return float("nan")
         return self.measured_us / self.predicted_us
 
@@ -59,9 +68,16 @@ class CalibrationReport:
 
     @property
     def step_ratio(self) -> float:
-        if not self.predicted_step_us or not self.measured_step_us:
+        """measured/predicted step cost; NaN (an 'uncalibrated' record)
+        when either side is missing, non-positive, or non-finite — a run
+        whose steps were too fast for the clock, or a model compiled
+        without any cost prediction, yields a clean n/a, never a
+        div-by-zero or an inf."""
+        p, m = self.predicted_step_us, self.measured_step_us
+        if (p is None or m is None or not math.isfinite(p)
+                or not math.isfinite(m) or p <= 0 or m <= 0):
             return float("nan")
-        return self.measured_step_us / self.predicted_step_us
+        return m / p
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -157,14 +173,17 @@ def calibrate(model, warmup: int = 1, repeats: int = 3,
         s = strategies.get(op.guid, default)
         sdesc = f"dp={s.dp},tp={s.tp}" + (f",sp={s.sp}" if s.sp > 1 else "")
         pred = cost.forward_time_us(op, s)
+        dtype = "bf16" if cost.op_dtype_bytes(op) <= 2 else "f32"
         try:
             meas = cache.measure_forward_us(op, s)
             rows.append(OpCalibration(op.name, op.op_type.value, sdesc,
-                                      float(pred), float(meas)))
+                                      float(pred), float(meas),
+                                      dtype=dtype))
         except Exception as e:  # unmeasurable ops (multi-output glue etc.)
             rows.append(OpCalibration(
                 op.name, op.op_type.value, sdesc, float(pred),
-                float("nan"), error=f"{type(e).__name__}: {e}"))
+                float("nan"), error=f"{type(e).__name__}: {e}",
+                dtype=dtype))
 
     stats = getattr(model, "step_stats", None)
     measured_step = None
@@ -174,6 +193,11 @@ def calibrate(model, warmup: int = 1, repeats: int = 3,
         # compile and would swamp short calibration runs
         measured_step = stats.summary()["p50_step_ms"] * 1e3
         n_steps = len(stats)
+        if not (measured_step > 0 and math.isfinite(measured_step)):
+            # steps faster than the clock's resolution (trivial models on
+            # CPU CI): an uncalibrated record, not a 0 that would blow up
+            # downstream ratios
+            measured_step = None
     report = CalibrationReport(
         backend=jax.default_backend(),
         predicted_step_us=predicted_step_us(model),

@@ -51,14 +51,53 @@ CHIP_SPECS = {
 
 
 class MachineModel:
-    """Abstract cost oracle (reference: simulator.h:212)."""
+    """Abstract cost oracle (reference: simulator.h:212).
+
+    The latency constants that used to be `+ 1.0` literals are now named
+    COEFFICIENTS (`dispatch_overhead_us`, `collective_latency_us`,
+    `step_time_scale`) so a fitted profile (obs/refit.py) can overlay
+    measured values over the hand-set defaults — see `apply_overlay`."""
 
     def __init__(self, num_chips: int, chip: ChipSpec):
         self.num_chips = num_chips
         self.chip = chip
+        # fit-able coefficients, defaulting to the historical constants
+        self.dispatch_overhead_us = 1.0   # per-op dispatch/launch latency
+        self.collective_latency_us = 1.0  # per-collective base latency
+        # whole-step multiplier for systematic bias no per-op/per-link term
+        # can attribute (fusion wins, host dispatch, bwd-factor error).
+        # Uniform across candidate plans, so it never changes a ranking —
+        # only Simulator.simulate applies it, never per-op costs.
+        self.step_time_scale = 1.0
 
     def version(self) -> int:
         return 0
+
+    def apply_overlay(self, coeffs) -> None:
+        """Overlay fitted coefficients (obs/refit.FittedCoefficients or any
+        object with the same fields) over the hand-set machine constants:
+        per-dtype effective flop rates, HBM/ICI bandwidth scales, and the
+        latency/step terms. The ChipSpec is replaced (dataclasses.replace),
+        never mutated — CHIP_SPECS entries are shared."""
+        cs = dict(getattr(coeffs, "compute_scale", {}) or {})
+        self.chip = dataclasses.replace(
+            self.chip,
+            peak_bf16_tflops=self.chip.peak_bf16_tflops
+            * float(cs.get("bf16", 1.0)),
+            peak_f32_tflops=self.chip.peak_f32_tflops
+            * float(cs.get("f32", 1.0)),
+            hbm_bw_gbps=self.chip.hbm_bw_gbps
+            * float(getattr(coeffs, "hbm_scale", 1.0)),
+            ici_link_gbps=self.chip.ici_link_gbps
+            * float(getattr(coeffs, "link_bw_scale", 1.0)),
+        )
+        self.dispatch_overhead_us = float(
+            getattr(coeffs, "dispatch_latency_us", self.dispatch_overhead_us))
+        self.collective_latency_us = float(
+            getattr(coeffs, "collective_latency_us",
+                    self.collective_latency_us))
+        self.step_time_scale = float(
+            getattr(coeffs, "step_scale", self.step_time_scale))
 
     # -- compute ----------------------------------------------------------
     def compute_time_us(self, flops: float, bytes_accessed: float,
@@ -70,7 +109,7 @@ class MachineModel:
         ) * 1e12
         t_flops = flops / peak
         t_mem = bytes_accessed / (self.chip.hbm_bw_gbps * 1e9)
-        return max(t_flops, t_mem) * 1e6 + 1.0  # +1us dispatch overhead
+        return max(t_flops, t_mem) * 1e6 + self.dispatch_overhead_us
 
     # -- communication ----------------------------------------------------
     def link_bw(self, n_participants: int) -> float:
@@ -80,29 +119,34 @@ class MachineModel:
         if n <= 1:
             return 0.0
         bw = self.link_bw(n)
-        return 2.0 * (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+        return (2.0 * (n - 1) / n * bytes_ / bw * 1e6
+                + self.collective_latency_us)
 
     def allgather_time_us(self, bytes_per_shard: float, n: int) -> float:
         if n <= 1:
             return 0.0
         bw = self.link_bw(n)
-        return (n - 1) * bytes_per_shard / bw * 1e6 + 1.0
+        return ((n - 1) * bytes_per_shard / bw * 1e6
+                + self.collective_latency_us)
 
     def reduce_scatter_time_us(self, bytes_: float, n: int) -> float:
         if n <= 1:
             return 0.0
         bw = self.link_bw(n)
-        return (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+        return ((n - 1) / n * bytes_ / bw * 1e6
+                + self.collective_latency_us)
 
     def all_to_all_time_us(self, bytes_: float, n: int) -> float:
         if n <= 1:
             return 0.0
         # each chip sends (n-1)/n of its bytes; torus bisection limits this
         bw = self.link_bw(n)
-        return (n - 1) / n * bytes_ / bw * 1e6 + 1.0
+        return ((n - 1) / n * bytes_ / bw * 1e6
+                + self.collective_latency_us)
 
     def p2p_time_us(self, bytes_: float) -> float:
-        return bytes_ / (self.chip.ici_link_gbps * 1e9) * 1e6 + 1.0
+        return (bytes_ / (self.chip.ici_link_gbps * 1e9) * 1e6
+                + self.collective_latency_us)
 
     def p2p_single_path_time_us(self, bytes_: float) -> float:
         """p2p over ONE path/direction — for patterns where every chip
@@ -307,13 +351,20 @@ class NetworkedMachineModel(MachineModel):
             return 1.0
         return float(min(self._min_degree(), 4))
 
+    def apply_overlay(self, coeffs) -> None:
+        # the explicit-topology model prices links off its OWN link_gbps,
+        # not the chip spec's — scale both so link_bw/p2p agree
+        super().apply_overlay(coeffs)
+        self.link_gbps *= float(getattr(coeffs, "link_bw_scale", 1.0))
+
     def _p2p_time(self, bytes_: float, diversity: float) -> float:
         bw = self.link_gbps * 1e9 * diversity
         seg = min(self.segment_bytes, max(bytes_, 1.0))
         h = self.avg_hops()
         # pipelined store-and-forward: the head segment pays every hop,
         # the rest stream behind it at line rate
-        return (bytes_ + (h - 1.0) * seg) / bw * 1e6 + 1.0
+        return ((bytes_ + (h - 1.0) * seg) / bw * 1e6
+                + self.collective_latency_us)
 
     def p2p_time_us(self, bytes_: float) -> float:
         return self._p2p_time(bytes_, self.path_diversity())
@@ -329,10 +380,26 @@ class NetworkedMachineModel(MachineModel):
 
 
 def make_machine_model(config, num_chips: int) -> MachineModel:
-    """Factory keyed off FFConfig (reference: --machine-model-version/-file)."""
+    """Factory keyed off FFConfig (reference: --machine-model-version/-file).
+
+    When `config.fitted_profile_file` names a fitted profile
+    (obs/refit.py — measured coefficients from accumulated calibration
+    data), it is loaded as an overlay over the hand-set constants, so
+    EVERY consumer of this factory (Unity search, simulator, calibration,
+    MFU accounting, KV-pool sizing) prices with measured reality. A
+    profile fitted for a different chip/backend refuses to load (typed
+    FittedProfileMismatch) rather than silently mis-pricing."""
     chip = CHIP_SPECS.get("tpu-v5e")
     if config.machine_model_file:
-        return NetworkedMachineModel.from_json(config.machine_model_file, chip)
-    if config.machine_model_version >= 1:
-        return TpuPodModel(num_chips, chip)
-    return SimpleMachineModel(num_chips, chip)
+        m = NetworkedMachineModel.from_json(config.machine_model_file, chip)
+    elif config.machine_model_version >= 1:
+        m = TpuPodModel(num_chips, chip)
+    else:
+        m = SimpleMachineModel(num_chips, chip)
+    profile_path = getattr(config, "fitted_profile_file", None)
+    if profile_path:
+        from ..obs.refit import FittedProfile  # lazy: no import cycle
+
+        FittedProfile.load(profile_path,
+                           expect_chip=m.chip.name).apply_to(m)
+    return m

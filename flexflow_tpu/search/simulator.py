@@ -946,7 +946,11 @@ class Simulator:
             update_ready = max(update_ready,
                                run_comm_group(gs, fin, gs_chans))
 
-        return max(t_compute, update_ready)
+        # step_time_scale: fitted whole-step bias multiplier (1.0 unless a
+        # fitted profile overlays it). Applied HERE only — per-op costs stay
+        # unscaled, and being uniform it cannot change a plan ranking.
+        return (max(t_compute, update_ready)
+                * getattr(self.machine, "step_time_scale", 1.0))
 
     def memory_bytes(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         default = OpStrategy()

@@ -957,6 +957,24 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
             if applied:
                 result.log.append(f"substitutions: {applied}")
             result.predicted_step_us = result.cost_us
+            # the native core prices from the chip scalars alone — the
+            # fitted latency/step-scale coefficients a profile overlay
+            # sets (obs/refit.py) don't cross the line protocol. When any
+            # is active, re-price the CHOSEN plan with the fully-overlaid
+            # Python simulator so predicted_step_us (what calibration and
+            # the drift detector compare against) reflects the fit; the
+            # native ranking stands (the extra terms are uniform enough
+            # across candidates not to re-rank them)
+            if (getattr(machine, "step_time_scale", 1.0) != 1.0
+                    or getattr(machine, "dispatch_overhead_us", 1.0) != 1.0
+                    or getattr(machine, "collective_latency_us", 1.0)
+                    != 1.0):
+                repriced = Simulator(machine, config).simulate(
+                    graph, result.strategies)
+                result.log.append(
+                    f"fitted-profile reprice: native {result.cost_us:.1f}"
+                    f"us -> {repriced:.1f}us predicted")
+                result.predicted_step_us = repriced
             return result
     helper = GraphSearchHelper(graph, config, machine, simulator)
     budget = None

@@ -1,0 +1,363 @@
+"""Calibration-driven refit loop (obs/refit.py): fitted-profile
+round-trip + typed mismatch errors, the robust coefficient fit, drift
+detection, the hardened calibration ratios, and the coordinator's
+drift-triggered budgeted re-plan."""
+import dataclasses
+import json
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import obs
+from flexflow_tpu.obs.calibration import CalibrationReport, OpCalibration
+from flexflow_tpu.obs.refit import (DriftDetector, FittedCoefficients,
+                                    FittedProfile, FittedProfileError,
+                                    FittedProfileMismatch,
+                                    fit_compute_coefficients, refit,
+                                    usable_rows)
+from flexflow_tpu.search.machine_model import (CHIP_SPECS,
+                                               SimpleMachineModel,
+                                               make_machine_model)
+
+COEFFS = FittedCoefficients(
+    compute_scale={"bf16": 0.125, "f32": 0.5}, hbm_scale=0.75,
+    link_bw_scale=2.0, dispatch_latency_us=42.5,
+    collective_latency_us=3.25, step_scale=11.0)
+
+
+def _profile(tmp_path, chip="tpu-v5e", backend="cpu", name="p.json",
+             coeffs=COEFFS, **kw):
+    prof = FittedProfile(chip=chip, backend=backend, coefficients=coeffs,
+                         **kw)
+    return prof, prof.save(os.path.join(str(tmp_path), name))
+
+
+# -- fitted-profile persistence -------------------------------------------
+
+def test_profile_round_trip_is_exact(tmp_path):
+    prof, path = _profile(tmp_path, fitted_steps=7, fitted_ops=4, rounds=2,
+                          step_ratio=1.01, num_chips=8)
+    loaded = FittedProfile.load(path, expect_chip="tpu-v5e",
+                                expect_backend="cpu")
+    assert loaded.coefficients == prof.coefficients  # exact, no rounding
+    assert loaded.spec_hash == prof.spec_hash
+    assert (loaded.fitted_ops, loaded.rounds, loaded.num_chips) == (4, 2, 8)
+
+
+def test_profile_overlay_reproduces_coefficients_exactly(tmp_path):
+    _, path = _profile(tmp_path)
+    m = SimpleMachineModel(8, CHIP_SPECS["tpu-v5e"])
+    base = m.chip
+    FittedProfile.load(path, expect_chip="tpu-v5e",
+                       expect_backend="cpu").apply_to(m)
+    assert m.chip.peak_bf16_tflops == base.peak_bf16_tflops * 0.125
+    assert m.chip.peak_f32_tflops == base.peak_f32_tflops * 0.5
+    assert m.chip.hbm_bw_gbps == base.hbm_bw_gbps * 0.75
+    assert m.chip.ici_link_gbps == base.ici_link_gbps * 2.0
+    assert m.dispatch_overhead_us == 42.5
+    assert m.collective_latency_us == 3.25
+    assert m.step_time_scale == 11.0
+    assert CHIP_SPECS["tpu-v5e"].peak_bf16_tflops == base.peak_bf16_tflops
+
+
+def test_make_machine_model_applies_profile(tmp_path):
+    _, path = _profile(tmp_path)
+    cfg = ff.FFConfig()
+    cfg.fitted_profile_file = path
+    m = make_machine_model(cfg, 8)
+    assert m.chip.peak_bf16_tflops == pytest.approx(
+        CHIP_SPECS["tpu-v5e"].peak_bf16_tflops * 0.125)
+    assert m.step_time_scale == 11.0
+
+
+def test_profile_chip_mismatch_is_typed(tmp_path):
+    _, path = _profile(tmp_path, chip="tpu-v4")
+    with pytest.raises(FittedProfileMismatch, match="tpu-v4"):
+        FittedProfile.load(path, expect_chip="tpu-v5e")
+
+
+def test_profile_backend_mismatch_is_typed(tmp_path):
+    _, path = _profile(tmp_path, backend="tpu")
+    with pytest.raises(FittedProfileMismatch, match="backend"):
+        FittedProfile.load(path, expect_chip="tpu-v5e",
+                           expect_backend="cpu")
+
+
+def test_profile_stale_hash_refuses_to_load(tmp_path):
+    _, path = _profile(tmp_path)
+    with open(path) as f:
+        d = json.load(f)
+    d["chip"] = "tpu-v4"  # spec edited without re-fitting: hash now stale
+    with open(path, "w") as f:
+        json.dump(d, f)
+    with pytest.raises(FittedProfileMismatch, match="stale or tampered"):
+        FittedProfile.load(path)
+
+
+def test_profile_future_format_version_refused(tmp_path):
+    _, path = _profile(tmp_path)
+    with open(path) as f:
+        d = json.load(f)
+    d["version"] = 99
+    with open(path, "w") as f:
+        json.dump(d, f)
+    with pytest.raises(FittedProfileError, match="format v99"):
+        FittedProfile.load(path)
+
+
+def test_profile_unreadable_and_malformed_are_typed(tmp_path):
+    with pytest.raises(FittedProfileError, match="unreadable"):
+        FittedProfile.load(os.path.join(str(tmp_path), "missing.json"))
+    bad = os.path.join(str(tmp_path), "bad.json")
+    with open(bad, "w") as f:
+        f.write('{"version": 1}')
+    with pytest.raises(FittedProfileError, match="malformed"):
+        FittedProfile.load(bad)
+
+
+# -- the coefficient fit ---------------------------------------------------
+
+def _rows(pred_meas, dtype="f32"):
+    return [OpCalibration(f"op{i}", "linear", "dp=1", p, m, dtype=dtype)
+            for i, (p, m) in enumerate(pred_meas)]
+
+
+def test_fit_recovers_known_slope_and_latency():
+    # measured = 3 * roofline + 5: the fit must divide the effective flop
+    # rate by ~3 and land the dispatch latency near 5us
+    machine = SimpleMachineModel(1, CHIP_SPECS["tpu-v5e"])
+    rows = _rows([(p + 1.0, 3.0 * p + 5.0)
+                  for p in (10.0, 40.0, 160.0, 640.0, 2560.0)])
+    out = fit_compute_coefficients(rows, FittedCoefficients(), machine)
+    assert out.compute_scale["f32"] == pytest.approx(1 / 3.0, rel=0.05)
+    assert out.dispatch_latency_us == pytest.approx(5.0, rel=0.2)
+    assert out.compute_scale["bf16"] == 1.0  # no bf16 rows: untouched
+
+
+def test_fit_is_robust_to_one_outlier():
+    machine = SimpleMachineModel(1, CHIP_SPECS["tpu-v5e"])
+    pts = [(p + 1.0, 2.0 * p) for p in (10.0, 20.0, 40.0, 80.0, 160.0,
+                                        320.0, 640.0, 1280.0, 2560.0)]
+    pts.append((5121.0, 2.0 * 5120.0 * 50))  # one 50x-poisoned point
+    out = fit_compute_coefficients(_rows(pts), FittedCoefficients(),
+                                   machine)
+    assert out.compute_scale["f32"] == pytest.approx(0.5, rel=0.1)
+
+
+def test_usable_rows_drops_degenerate_measurements():
+    rows = _rows([(10.0, 20.0), (10.0, 0.0), (10.0, -5.0),
+                  (10.0, float("nan")), (0.0, 20.0),
+                  (10.0, float("inf"))])
+    assert [r.op for r in usable_rows(rows)] == ["op0"]
+
+
+# -- hardened calibration ratios ------------------------------------------
+
+@pytest.mark.parametrize("pred,meas", [
+    (10.0, 0.0), (10.0, -3.0), (0.0, 10.0), (-1.0, 10.0),
+    (10.0, float("nan")), (float("inf"), 10.0)])
+def test_op_ratio_degenerate_inputs_are_nan(pred, meas):
+    r = OpCalibration("o", "linear", "dp=1", pred, meas)
+    assert math.isnan(r.ratio)
+
+
+@pytest.mark.parametrize("pred,meas", [
+    (None, 100.0), (100.0, None), (0.0, 100.0), (100.0, 0.0),
+    (-5.0, 100.0), (100.0, -5.0), (float("nan"), 100.0)])
+def test_step_ratio_degenerate_inputs_are_uncalibrated(pred, meas):
+    rep = CalibrationReport(backend="cpu", predicted_step_us=pred,
+                            measured_step_us=meas, measured_steps=3,
+                            ops=[])
+    assert math.isnan(rep.step_ratio)
+    assert "n/a" in rep.format()  # renders cleanly, no div-by-zero
+    json.loads(rep.to_json())  # and serializes
+
+
+def test_refit_refuses_unmeasured_step():
+    with pytest.raises(FittedProfileError, match="measured_step_us"):
+        refit(object.__new__(type("M", (), {"graph": object()})),
+              0.0, [])
+
+
+# -- drift detection -------------------------------------------------------
+
+def test_drift_detector_warmup_budget_and_rearm():
+    det = DriftDetector(predicted_step_us=100.0, threshold=0.5,
+                        warmup_steps=2, patience=2, max_replans=1)
+    assert det.observe(1e6) is False  # warmup 1 (jit step)
+    assert det.observe(1e6) is False  # warmup 2
+    assert det.observe(1e6) is False  # breach 1 of patience 2
+    assert det.observe(1e6) is True   # sustained: fire (budget available)
+    # observing never consumes the budget — a caller that cannot re-plan
+    # (plain fit) leaves it intact, so the verdict re-fires every
+    # patience window
+    assert det.replans == 0
+    assert det.observe(1e6) is False  # fresh patience window
+    assert det.observe(1e6) is True
+    det.note_replan()                 # the re-planner consumed the budget
+    assert det.replans == 1
+    assert det.observe(1e6) is False  # budget spent: never fires again
+    assert det.observe(1e6) is False
+    assert det.drift > 0.5
+    det.rearm(1e6)  # re-anchored to the re-planned prediction
+    assert det.measured_step_us is None and det.drift == 0.0
+    for _ in range(10):
+        assert det.observe(1.05e6) is False  # 5% off: calibrated now
+
+
+def test_drift_detector_ignores_degenerate_and_calibrated_steps():
+    det = DriftDetector(predicted_step_us=100.0, threshold=0.5,
+                        warmup_steps=0, patience=1, max_replans=5)
+    assert det.observe(0.0) is False          # clock-resolution zero
+    assert det.observe(float("nan")) is False
+    for _ in range(5):
+        assert det.observe(110.0) is False    # within threshold
+    assert det.drift == pytest.approx(0.1, abs=0.01)
+    assert obs.REGISTRY.gauge(
+        "ff_calibration_drift", "").value() == pytest.approx(det.drift)
+
+
+def test_drift_detector_requires_positive_prediction():
+    with pytest.raises(ValueError):
+        DriftDetector(predicted_step_us=0.0)
+
+
+# -- end-to-end: refit converges, drift fires one budgeted re-plan ---------
+
+def _tiny_builder(cfg):
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([cfg.batch_size, 32])
+    t = m.dense(t, 64, ff.ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 10)
+    m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.05),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[ff.MetricsType.METRICS_ACCURACY])
+    return m
+
+
+def _tiny_data(bs, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(bs * 4, 32).astype(np.float32)
+    y = rng.randint(0, 10, size=(bs * 4, 1)).astype(np.int32)
+    return x, y
+
+
+def test_refit_converges_from_miscalibrated_spec(tmp_path):
+    """The acceptance drill's core, in-process: 2x overstated flop rate +
+    0.5x understated ICI bandwidth must converge predicted-vs-measured to
+    within +-15% in <= 3 rounds."""
+    prior = FittedCoefficients(compute_scale={"bf16": 2.0, "f32": 2.0},
+                               link_bw_scale=0.5)
+    cfg = ff.FFConfig()
+    cfg.batch_size = 16
+    cfg.fitted_profile_file = FittedProfile(
+        chip="tpu-v5e", backend="cpu", coefficients=prior,
+    ).save(os.path.join(str(tmp_path), "miscal.json"))
+    model = _tiny_builder(cfg)
+    x, y = _tiny_data(cfg.batch_size)
+    model.fit(x, y, epochs=2)
+    rep = obs.calibrate(model, max_ops=2)
+    assert rep.measured_step_us and rep.measured_step_us > 0
+    profile, history = refit(model, rep.measured_step_us, rep.ops,
+                             prior=prior, rounds=3, tol=0.15)
+    assert len(history) <= 4  # <= 3 fitting rounds + the final verdict
+    assert abs(history[-1].ratio - 1.0) <= 0.15
+    # the persisted profile reproduces the converged prediction when
+    # loaded as a make_machine_model overlay
+    path = profile.save(os.path.join(str(tmp_path), "fitted.json"))
+    cfg2 = dataclasses.replace(cfg, fitted_profile_file=path)
+    from flexflow_tpu.search.simulator import Simulator
+
+    sim = Simulator(make_machine_model(cfg2, cfg2.total_devices), cfg2)
+    repriced = sim.simulate(model.graph, model._op_strategies or {})
+    assert repriced == pytest.approx(history[-1].predicted_step_us,
+                                     rel=1e-6)
+
+
+def test_coordinator_drift_fires_exactly_one_budgeted_replan(tmp_path):
+    from flexflow_tpu.elastic.coordinator import ElasticCoordinator
+
+    obs.enable_tracing().clear()
+    try:
+        cfg = ff.FFConfig()
+        cfg.batch_size = 16
+        cfg.device_ids = list(range(4))
+        x, y = _tiny_data(cfg.batch_size)
+        refits = []
+
+        def refit_hook(model, measured_us):
+            rep = obs.calibrate(model, max_ops=1)
+            prof, hist = refit(model, measured_us, rep.ops, rounds=3,
+                               tol=0.15)
+            refits.append(hist)
+            return prof.save(os.path.join(str(tmp_path), "fitted.json"))
+
+        coord = ElasticCoordinator(
+            _tiny_builder, cfg,
+            checkpoint_dir=tempfile.mkdtemp(prefix="ff_refit_t_"),
+            checkpoint_every=2)
+        # armed against an absurdly fast prediction: drift is immediate
+        det = DriftDetector(predicted_step_us=1.0, threshold=0.5,
+                            warmup_steps=1, patience=1, max_replans=1)
+        coord.drift_detector = det
+        coord.drift_refit = refit_hook
+        history = coord.fit(x, y, steps=8)
+        assert len(history) == 8  # training completed through the re-plan
+        assert det.replans == 1
+        assert len(refits) == 1
+        assert abs(refits[0][-1].ratio - 1.0) <= 0.15
+        assert obs.REGISTRY.counter("ff_replan_total", "").value() == 1
+        counts = coord.events.counts()
+        assert counts.get("drift.replan") == 1
+        assert counts.get("drift.refit") == 1
+        spans = obs.get_tracer().span_names()
+        assert "refit.replan" in spans and "refit.fit" in spans
+        # the re-built model priced with the fitted profile
+        assert coord.model.config.fitted_profile_file == os.path.join(
+            str(tmp_path), "fitted.json")
+        # budget spent: the detector never fires again even if drift stays
+        assert det.observe(1e9) is False
+    finally:
+        obs.disable_tracing()
+
+
+def test_chip_loss_recovery_rearms_drift_detector(tmp_path):
+    """A chip-loss recovery re-prices the plan for the shrunken mesh; the
+    drift detector must be re-anchored to the NEW prediction (with fresh
+    warmup), or the replayed steps would read as calibration drift and
+    burn the re-plan budget on a healthy plan."""
+    from flexflow_tpu.elastic.coordinator import ElasticCoordinator
+    from flexflow_tpu.elastic.faults import FaultPlan
+
+    cfg = ff.FFConfig()
+    cfg.batch_size = 12  # divisible by 4 pre-loss and 3 post-loss
+    cfg.device_ids = list(range(4))
+    x, y = _tiny_data(cfg.batch_size)
+    coord = ElasticCoordinator(
+        _tiny_builder, cfg,
+        fault_plan=FaultPlan().add_chip_loss(at_step=4, chips=[3]),
+        checkpoint_dir=tempfile.mkdtemp(prefix="ff_refit_rc_"),
+        checkpoint_every=2)
+    from flexflow_tpu.obs.calibration import predicted_step_us
+
+    # a sentinel prediction no re-price would reproduce, so the rearm is
+    # unambiguous; warmup never ends, isolating the rearm path from
+    # actual drift detection
+    sentinel = 123456.0
+    det = DriftDetector(predicted_step_us=sentinel, threshold=10.0,
+                        warmup_steps=10 ** 6, max_replans=1)
+    coord.drift_detector = det
+    history = coord.fit(x, y, steps=8)
+    assert len(history) == 8
+    assert len(coord.device_ids) == 3  # the recovery actually happened
+    # rearmed: anchored to the survivors' re-planned prediction, budget
+    # untouched
+    assert det.predicted_step_us != sentinel
+    assert det.predicted_step_us == pytest.approx(
+        predicted_step_us(coord.model))
+    assert det.replans == 0 and det.measured_step_us is None
