@@ -12,15 +12,20 @@ Public surface:
    serving /metrics endpoint;
  - plan_memory_bytes — the memory model behind the FFTA010/011 fit gate,
    also used to size the serving KV-cache pool against HBM
-   (serving/sched/kvpool.py).
+   (serving/sched/kvpool.py);
+ - check_redistribution / redistribution_diagnostics /
+   survivor_diagnostics — the FFTA06x gate over live-resharding
+   schedules (resharding/) and the shard-coverage check the elastic
+   coordinator consults before a zero-disk recovery.
 """
 from .diagnostics import (CODE_CATALOG, Diagnostic, DiagnosticReport,
                           PlanAnalysisError, Severity, diagnostic_counters,
                           make_diag, record_report, reset_counters)
 from .passes import (AnalysisContext, default_strategies_for,
-                     factorization_diagnostics, plan_memory_bytes)
+                     factorization_diagnostics, plan_memory_bytes,
+                     redistribution_diagnostics, survivor_diagnostics)
 from .pipeline import (ALL_PASSES, CHEAP_PASSES, PASS_REGISTRY,
-                       analyze_plan, check_plan)
+                       analyze_plan, check_plan, check_redistribution)
 
 __all__ = [
     "ALL_PASSES",
@@ -34,11 +39,14 @@ __all__ = [
     "Severity",
     "analyze_plan",
     "check_plan",
+    "check_redistribution",
     "default_strategies_for",
     "diagnostic_counters",
     "factorization_diagnostics",
     "make_diag",
     "plan_memory_bytes",
     "record_report",
+    "redistribution_diagnostics",
     "reset_counters",
+    "survivor_diagnostics",
 ]

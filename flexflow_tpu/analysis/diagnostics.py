@@ -65,6 +65,16 @@ CODE_CATALOG: Dict[str, tuple] = {
     # -- strategy files (FFTA05x) --
     "FFTA050": (Severity.ERROR, "malformed strategy-file entry"),
     "FFTA051": (Severity.WARNING, "strategy entry matches no op"),
+    # -- live resharding (FFTA06x, resharding/) --
+    "FFTA060": (Severity.ERROR,
+                "redistribution collective illegal on the target mesh"),
+    "FFTA061": (Severity.ERROR,
+                "redistribution peak scratch exceeds per-chip HBM or the"
+                " requested bound"),
+    "FFTA062": (Severity.WARNING,
+                "redistribution peak scratch above 85% of per-chip HBM"),
+    "FFTA063": (Severity.ERROR,
+                "live shards unrecoverable from the surviving devices"),
 }
 
 

@@ -77,3 +77,24 @@ def check_plan(graph: Graph, record: bool = True,
     if report.errors():
         raise PlanAnalysisError(report)
     return report
+
+
+def check_redistribution(schedule, machine=None,
+                         record: bool = True) -> DiagnosticReport:
+    """The FFTA06x gate for live-resharding schedules
+    (resharding/plan.py): redistribution_diagnostics with check_plan's
+    gate semantics — warnings logged and counted, errors raise
+    PlanAnalysisError carrying the report. Every schedule the elastic
+    coordinator or the serving resize path is about to execute goes
+    through here first."""
+    from .passes import redistribution_diagnostics
+
+    report = DiagnosticReport(passes_run=["redistribution"])
+    report.extend(redistribution_diagnostics(schedule, machine=machine))
+    if record:
+        record_report(report)
+    for d in report.warnings():
+        _log.warning("%s", d.format())
+    if report.errors():
+        raise PlanAnalysisError(report)
+    return report

@@ -18,11 +18,22 @@ Recovery state machine (docs/elastic.md has the full diagram):
            MachineModel, so the parallel strategy is re-derived, not
            merely truncated (the re-derivation argument of
            "Synthesizing Optimal Parallelism Placement..." 2110.10548);
-        3. restore: load the latest checkpoint (runtime/checkpoint.py)
-           into the new model and reshard every parameter onto the new
-           mesh;
-        4. resume: continue the SAME fit() call from the checkpointed
-           step.
+        3. restore — LIVE when possible, disk otherwise:
+           a. live (resharding/, arXiv:2112.01075): when the survivors
+              still hold every shard of the pre-loss state (FFTA063
+              coverage check over the old plan) AND the live tree
+              verifies clean, `redistribute` moves the arrays directly
+              from the old layout to the re-planned one — bounded-memory
+              collectives, ZERO disk I/O, and resume from the FAILING
+              step (no replay of committed work);
+           b. disk: otherwise restore the latest verified checkpoint
+              (runtime/checkpoint.py) into the new model and reshard
+              every parameter onto the new mesh, resuming from the
+              checkpointed step.
+           Both paths label the `elastic.recover>restore` span and the
+           ff_recovery_restore_total counter with source=live|disk, so
+           the killed checkpoint round-trip is directly measurable;
+        4. resume: continue the SAME fit() call.
 
 The training loop here is deliberately the plain single-step path (one
 jitted dispatch per optimizer step) — each dispatch is a clean retry/
@@ -52,8 +63,9 @@ from ..runtime.checkpoint import CheckpointError
 from ..runtime.durability import DurableCheckpointer
 from .detector import FailureDetector
 from .events import (CHECKPOINT, DRIFT_BREACH, DRIFT_REFIT, DRIFT_REPLAN,
-                     PLAN_ANALYSIS, RECOVERY_DONE, RECOVERY_RESTORE,
-                     RECOVERY_SEARCH, RECOVERY_START, EventLog)
+                     PLAN_ANALYSIS, RECOVERY_DONE, RECOVERY_LIVE_FALLBACK,
+                     RECOVERY_RESTORE, RECOVERY_SEARCH, RECOVERY_START,
+                     EventLog)
 from .faults import FaultInjector, FaultPlan, TopologyLoss
 from .retry import RetryPolicy
 from .watchdog import OK, ROLLBACK, SKIP, TrainingWatchdog
@@ -117,8 +129,18 @@ class ElasticCoordinator:
                  watchdog="auto",
                  max_rollbacks: int = 4,
                  drift_detector=None,
-                 drift_refit=None):
+                 drift_refit=None,
+                 live_resharding: bool = True,
+                 reshard_peak_bytes: Optional[int] = None):
         self.model_builder = model_builder
+        # zero-disk recovery (resharding/): when the survivors still hold
+        # verified live state, recover by redistributing the live arrays
+        # onto the re-planned mesh instead of reading a checkpoint.
+        # reshard_peak_bytes bounds the per-chip scratch of that move
+        # (default: a quarter of the chip's HBM — leaves room for the
+        # params themselves plus the landing buffers)
+        self.live_resharding = bool(live_resharding)
+        self.reshard_peak_bytes = reshard_peak_bytes
         # calibration-drift feedback loop (obs/refit.py): `drift_detector`
         # (an obs.DriftDetector) watches committed step wall times; when
         # it fires (within ITS re-plan budget), the coordinator runs
@@ -152,6 +174,8 @@ class ElasticCoordinator:
         if injector is not None:
             # corrupt_checkpoint faults tear the newest file in OUR dir
             injector.checkpoint_dir = self.checkpoint_dir
+            # poison_live_state faults rot the live tree we own
+            injector.poison_hook = self._poison_live_state
         # retry jitter draws from a per-run seeded stream, not the global
         # random module — drill timelines replay exactly
         self.detector = FailureDetector(
@@ -247,27 +271,152 @@ class ElasticCoordinator:
             errors=len(report.errors()), warnings=len(report.warnings()),
             counts=report.counts())
 
-    def _restore_validated(self, model, cause: Exception) -> tuple:
-        """Restore the newest verified checkpoint into a freshly REBUILT
-        `model`: validate the restored parameter tree against the rebuilt
-        architecture (a non-deterministic builder must fail typed, not
-        mis-train), then reshard onto the model's mesh. Returns
-        (ckpt_step, path). The shared restore core of chip-loss recovery
-        and drift re-planning — one pipeline, one set of guarantees."""
-        expected = {name: set(ws) for name, ws in model.params.items()}
-        with get_tracer().span("elastic.restore"):
-            ckpt_step, path = self._restore_latest_verified(model, cause)
-        got = {name: set(ws) for name, ws in model.params.items()}
+    def _restore_counter(self):
+        return REGISTRY.counter(
+            "ff_recovery_restore_total",
+            "Recovery restores by source (live = zero-disk resharding,"
+            " disk = checkpoint)", labels=("source",))
+
+    @staticmethod
+    def _validate_tree_match(expected: Dict, got: Dict, what: str,
+                             cause: Exception) -> None:
+        """The restored/live parameter tree must match the rebuilt
+        model's architecture exactly (a non-deterministic builder must
+        fail typed, not mis-train) — shared by the disk and live restore
+        paths so the rule can never drift between them."""
         if expected != got:
             missing = set(expected) - set(got)
             extra = set(got) - set(expected)
             raise RecoveryFailed(
-                "checkpoint does not match the rebuilt model's parameter "
-                f"tree (missing ops: {sorted(missing)}, unexpected ops: "
-                f"{sorted(extra)}) — the builder must produce the same "
-                "architecture across rebuilds") from cause
-        reshard_params(model)
-        return ckpt_step, path
+                f"{what} does not match the rebuilt model's parameter"
+                f" tree (missing ops: {sorted(missing)}, unexpected ops:"
+                f" {sorted(extra)}) — the builder must produce the same"
+                " architecture across rebuilds") from cause
+
+    def _restore_validated(self, model, cause: Exception) -> tuple:
+        """Restore the newest verified checkpoint into a freshly REBUILT
+        `model`: validate the restored parameter tree against the rebuilt
+        architecture, then reshard onto the model's mesh. Returns
+        (ckpt_step, path, restore_ms). The DISK restore core of chip-loss
+        recovery and drift re-planning — one pipeline, one set of
+        guarantees; the zero-disk alternative is `_restore_live`."""
+        expected = {name: set(ws) for name, ws in model.params.items()}
+        t0 = time.perf_counter()
+        with get_tracer().span("elastic.restore", source="disk"):
+            ckpt_step, path = self._restore_latest_verified(model, cause)
+            self._validate_tree_match(
+                expected, {name: set(ws)
+                           for name, ws in model.params.items()},
+                "checkpoint", cause)
+            reshard_params(model)
+        self._restore_counter().inc(source="disk")
+        return ckpt_step, path, (time.perf_counter() - t0) * 1e3
+
+    # -- zero-disk (live-resharding) restore -------------------------------
+    def _poison_live_state(self) -> None:
+        """The poison_live_state fault's hook: NaN-rot the live training
+        state in place — silent corruption of survivor-resident memory,
+        the failure mode the zero-disk path's verify_live_tree must catch
+        (on real hardware: a shard checksum mismatch). The poison lands
+        in the optimizer's lr so the running step pipeline keeps
+        dispatching (loss is computed from pre-update params) while every
+        SUBSEQUENT update is garbage; models without an lr scalar get
+        their first parameter leaf poisoned instead. Mutates IN PLACE: a
+        commit of the in-flight step must not launder the rot away, the
+        same way real memory corruption survives a step boundary."""
+        import jax.numpy as jnp
+
+        m = self.model
+        if isinstance(m.opt_state, dict) and "lr" in m.opt_state:
+            m.opt_state["lr"] = jnp.asarray(float("nan"), jnp.float32)
+            return
+        for entry in (m.params or {}).values():
+            if isinstance(entry, dict):
+                for wname, arr in entry.items():
+                    entry[wname] = arr * float("nan")
+                    return
+
+    def _live_tree(self, model) -> Dict:
+        return {"params": model.params or {},
+                "opt_state": model.opt_state or {},
+                "state": model.state or {}}
+
+    def _live_candidate(self, lost_positions: Sequence[int]):
+        """Decide whether a ZERO-DISK recovery is possible: the old
+        plan's placement must leave every shard of the live tree covered
+        by survivors (FFTA063), and the live tree must verify clean.
+        Returns (old_model, old_plan) or None (with the routing reason
+        recorded) — decided BEFORE the rebuild, while the old model still
+        owns the state."""
+        from ..analysis import record_report, survivor_diagnostics
+        from ..analysis.diagnostics import DiagnosticReport
+        from ..resharding import flatten_tree, plan_of, verify_live_tree
+
+        if not self.live_resharding:
+            return None
+        old_model = self.model
+        if old_model is None or old_model.params is None:
+            return None
+        old_plan = plan_of(old_model)
+        tree = self._live_tree(old_model)
+        leaves = {path: np.ndim(leaf)
+                  for path, leaf in flatten_tree(tree).items()}
+        diags = survivor_diagnostics(old_plan, leaves, lost_positions)
+        if diags:
+            record_report(DiagnosticReport(diags, ["survivor_coverage"]))
+            self.events.record(
+                RECOVERY_LIVE_FALLBACK, step=self.detector.current_step,
+                reason="coverage",
+                uncovered=[d.message.split(":")[0] for d in diags[:3]],
+                n_uncovered=len(diags))
+            return None
+        bad = verify_live_tree(tree)
+        if bad is not None:
+            self.events.record(
+                RECOVERY_LIVE_FALLBACK, step=self.detector.current_step,
+                reason="verify", detail=bad)
+            return None
+        return old_model, old_plan
+
+    def _restore_live(self, old_model, old_plan, model,
+                      cause: Exception) -> float:
+        """Zero-disk restore: redistribute the old model's live tree onto
+        the re-planned model's layout (resharding.redistribute — the
+        FFTA06x-gated, peak-bounded collective schedule) and install it.
+        Returns the restore wall ms; raises RecoveryFailed (caller falls
+        back to disk) on any validation failure."""
+        from ..resharding import plan_of, redistribute
+        from ..search.machine_model import make_machine_model
+
+        t0 = time.perf_counter()
+        with get_tracer().span("elastic.restore", source="live") as sp:
+            self._validate_tree_match(
+                {name: set(ws) for name, ws in model.params.items()},
+                {name: set(ws)
+                 for name, ws in (old_model.params or {}).items()},
+                "live tree", cause)
+            n_dev = (len(model.config.device_ids)
+                     if model.config.device_ids
+                     else max(1, model.config.total_devices))
+            machine = make_machine_model(model.config, n_dev)
+            peak = self.reshard_peak_bytes or int(
+                0.25 * machine.memory_budget_bytes())
+            result = redistribute(
+                self._live_tree(old_model), old_plan, plan_of(model),
+                peak_bytes=peak, machine=machine)
+            model.params = result.tree.get("params", model.params)
+            if result.tree.get("opt_state"):
+                model.opt_state = result.tree["opt_state"]
+            if result.tree.get("state"):
+                model.state = result.tree["state"]
+            model._step_count = old_model._step_count
+            sp.set(moves=len(result.schedule.moves),
+                   bytes_moved=result.bytes_moved,
+                   peak_scratch_bytes=result.observed_peak_bytes,
+                   rounds=result.allgather_rounds
+                   + result.transfer_rounds)
+        self._restore_counter().inc(source="live")
+        return (time.perf_counter() - t0) * 1e3
 
     def _rearm_drift(self, model) -> Optional[float]:
         """Re-anchor the drift detector (when one is armed) to `model`'s
@@ -352,6 +501,9 @@ class ElasticCoordinator:
         # 1. shrink the topology spec (positions follow device_ids order)
         lost_positions = [i for i, d in enumerate(self.device_ids)
                           if d in lost]
+        # zero-disk candidacy is decided NOW, against the pre-shrink plan
+        # and the old model's live tree (FFTA063 coverage + verification)
+        live = self._live_candidate(lost_positions)
         self._topo_spec = shrink_topology_spec(self._topo_spec,
                                                lost_positions)
         spec_path = self._write_spec(f"survivors_{self._recoveries}.json")
@@ -366,15 +518,44 @@ class ElasticCoordinator:
             n_devices=len(survivors), axes=dict(model.parallel_axes),
             cost_us=(sr.cost_us if sr is not None else None))
         self._record_plan_analysis(model, self.detector.current_step)
-        # 3. restore the newest VERIFIED checkpoint into the new model,
-        # tree-validated and resharded — a torn/corrupt latest file falls
-        # back to an older verified one instead of killing the recovery;
-        # only a VALIDATED restore reports success, so a mismatched tree
-        # never leaves a recovery.restore event behind
-        if self._last_ckpt is None:
-            raise RecoveryFailed("no checkpoint to restore from") from exc
-        ckpt_step, path = self._restore_validated(model, exc)
-        self.events.record(RECOVERY_RESTORE, step=ckpt_step, path=path)
+        # 3. restore — live when the survivors hold verified state (zero
+        # disk I/O, resume from the FAILING step), disk otherwise: the
+        # newest VERIFIED checkpoint, tree-validated and resharded, with
+        # torn/corrupt files falling back to older verified ones. Only a
+        # VALIDATED restore reports success either way, so a mismatched
+        # tree never leaves a recovery.restore event behind.
+        resume_step = None
+        if live is not None:
+            old_model, old_plan = live
+            try:
+                restore_ms = self._restore_live(old_model, old_plan,
+                                                model, exc)
+                resume_step = self.detector.current_step
+                self.events.record(RECOVERY_RESTORE, step=resume_step,
+                                   source="live", path=None,
+                                   restore_ms=round(restore_ms, 3))
+            except Exception as le:  # noqa: BLE001 — availability first:
+                # ANY live-path failure (typed validation, planner shape
+                # mismatch, a JAX runtime error reading shards that lived
+                # on the lost chips) must degrade to the disk restore a
+                # verified checkpoint still guarantees — dying here would
+                # turn a recoverable loss into a job kill. The full error
+                # is recorded, never swallowed silently.
+                self.events.record(
+                    RECOVERY_LIVE_FALLBACK,
+                    step=self.detector.current_step, reason="restore",
+                    error=type(le).__name__,
+                    detail=str(le).splitlines()[0] if str(le) else "")
+        if resume_step is None:
+            if self._last_ckpt is None:
+                raise RecoveryFailed(
+                    "no checkpoint to restore from") from exc
+            ckpt_step, path, restore_ms = self._restore_validated(model,
+                                                                  exc)
+            self.events.record(RECOVERY_RESTORE, step=ckpt_step,
+                               source="disk", path=path,
+                               restore_ms=round(restore_ms, 3))
+            resume_step = ckpt_step
         # 4. swap in the recovered model and resume
         self.model = model
         self.device_ids = survivors
@@ -386,9 +567,9 @@ class ElasticCoordinator:
         # stale pre-loss prediction and burn the re-plan budget on a
         # healthy plan
         self._rearm_drift(model)
-        self.events.record(RECOVERY_DONE, step=ckpt_step,
+        self.events.record(RECOVERY_DONE, step=resume_step,
                            n_devices=len(survivors))
-        return ckpt_step
+        return resume_step
 
     # -- drift-triggered re-plan -------------------------------------------
     def _replan_for_drift(self, step: int) -> int:
@@ -422,7 +603,7 @@ class ElasticCoordinator:
             # same plan-sanitizer gate + tree-validated restore pipeline
             # recovery re-plans get
             self._record_plan_analysis(model, step)
-            ckpt_step, path = self._restore_validated(
+            ckpt_step, path, _restore_ms = self._restore_validated(
                 model, RuntimeError("drift replan"))
             self.model = model
             new_pred = self._rearm_drift(model)

@@ -8,8 +8,9 @@ reference run of the same seed and data.
     python -m flexflow_tpu elastic-drill --devices 8 --kill 2 --at-step 5
     python -m flexflow_tpu elastic-drill --scenario nan-step
     python -m flexflow_tpu elastic-drill --scenario corrupt-checkpoint
+    python -m flexflow_tpu elastic-drill --scenario live-reshard
 
-Scenarios (--scenario, docs/durability.md):
+Scenarios (--scenario, docs/durability.md + docs/resharding.md):
   default            a transient hiccup (retry absorbs it) + a K-chip kill
                      (re-plan on the survivors, restore, resume)
   nan-step           consecutive blown-up steps: the watchdog skips the
@@ -18,6 +19,15 @@ Scenarios (--scenario, docs/durability.md):
   corrupt-checkpoint the newest checkpoint file is torn on disk, THEN
                      chips die: the recovery restore must fall back to the
                      previous verified checkpoint instead of crashing
+                     (live resharding is disabled here — the scenario
+                     exists to prove the disk path's verified fallback)
+  live-reshard       two runs (ISSUE 8): (a) a clean chip kill recovers
+                     by redistributing the survivors' LIVE state onto the
+                     re-planned mesh — asserts ZERO checkpoint-file reads,
+                     resume from the failing step, and a restore no slower
+                     than the disk run's; (b) the live state is silently
+                     poisoned before the kill — asserts the verification
+                     catches it and the recovery falls back to disk
 
 Exit code 0 iff the run finished, the scenario's recovery machinery
 actually engaged, and the final loss landed within tolerance of the
@@ -44,7 +54,7 @@ def _take(argv: List[str], flag: str, default, cast=int):
     return _take_flag(argv, flag, default, cast=cast)
 
 
-SCENARIOS = ("default", "nan-step", "corrupt-checkpoint")
+SCENARIOS = ("default", "nan-step", "corrupt-checkpoint", "live-reshard")
 
 
 def run_drill(argv: Optional[List[str]] = None) -> int:
@@ -136,6 +146,13 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
                   metrics=[ff.MetricsType.METRICS_ACCURACY])
         return m
 
+    if scenario == "live-reshard":
+        return _live_reshard_drill(builder, make_config, x, y,
+                                   devices=devices, kill=kill,
+                                   at_step=at_step, steps=steps,
+                                   tolerance=tolerance,
+                                   trace_out=trace_out)
+
     # scripted adversity per scenario
     if scenario == "nan-step":
         plan = FaultPlan()
@@ -159,7 +176,10 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
         builder, make_config(), fault_plan=plan,
         checkpoint_dir=tempfile.mkdtemp(prefix="ff_drill_"),
         checkpoint_every=2, events=events,
-        retry_policy=RetryPolicy(max_retries=3, base_delay_s=0.01))
+        retry_policy=RetryPolicy(max_retries=3, base_delay_s=0.01),
+        # corrupt-checkpoint proves the DISK path's verified fallback;
+        # a clean live tree would sidestep the torn file entirely
+        live_resharding=(scenario != "corrupt-checkpoint"))
     history = coord.fit(x, y, steps=steps, verbose=True)
 
     # uninterrupted reference: same data, seed, and step count on the full
@@ -232,6 +252,144 @@ def run_drill(argv: Optional[List[str]] = None) -> int:
         "final_axes": dict(coord.model.parallel_axes),
         "events": counts,
         "metrics": metrics_lines,
+    }
+    if trace_out:
+        from ..obs.tracing import get_tracer
+
+        summary["trace"] = get_tracer().export_chrome_trace(trace_out)
+        summary["trace_spans"] = get_tracer().span_names()
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+def _live_reshard_drill(builder, make_config, x, y, *, devices, kill,
+                        at_step, steps, tolerance, trace_out) -> int:
+    """The ISSUE 8 acceptance drill: run (a) proves the zero-disk path —
+    a chip kill recovered by redistributing live state, with ZERO
+    checkpoint-file reads, resume at the failing step, and a restore at
+    least as fast as run (b)'s disk restore; run (b) poisons the live
+    state first, proving verification routes the same kill to the
+    checkpoint fallback. Both runs must land within tolerance of an
+    uninterrupted reference."""
+    from ..obs.registry import REGISTRY
+    from .coordinator import ElasticCoordinator
+    from .events import EventLog
+    from .faults import FaultPlan
+    from .retry import RetryPolicy
+
+    chips = list(range(devices - kill, devices))
+
+    def restore_totals():
+        c = REGISTRY.counter("ff_recovery_restore_total",
+                             "Recovery restores by source",
+                             labels=("source",))
+        return {"live": int(c.value(source="live")),
+                "disk": int(c.value(source="disk"))}
+
+    def ckpt_reads():
+        from ..runtime.durability import checkpoint_counters
+
+        counts = checkpoint_counters()
+        # every path that touches a checkpoint FILE during a restore:
+        # the restore itself plus the verification reads preceding it
+        return (counts.get("restored", 0) + counts.get("verified", 0)
+                + counts.get("corrupt", 0))
+
+    def run(plan, tag):
+        events = EventLog()
+        coord = ElasticCoordinator(
+            builder, make_config(), fault_plan=plan,
+            checkpoint_dir=tempfile.mkdtemp(prefix=f"ff_drill_{tag}_"),
+            checkpoint_every=2, events=events,
+            retry_policy=RetryPolicy(max_retries=3, base_delay_s=0.01))
+        history = coord.fit(x, y, steps=steps, verbose=True)
+        return coord, events, history
+
+    # (a) clean kill -> zero-disk live recovery
+    before_totals, before_reads = restore_totals(), ckpt_reads()
+    plan_a = FaultPlan().add_chip_loss(at_step, chips=chips)
+    coord_a, events_a, hist_a = run(plan_a, "live")
+    live_restores = restore_totals()["live"] - before_totals["live"]
+    live_disk_reads = ckpt_reads() - before_reads
+    restores_a = events_a.events("recovery.restore")
+    live_ms = (restores_a[0].details.get("restore_ms")
+               if restores_a else None)
+    resumed_at_fault = bool(restores_a
+                            and restores_a[0].step == at_step)
+
+    # (b) poisoned live state -> verification catches it -> disk fallback.
+    # Both faults fire in the SAME dispatch (poison is non-raising and
+    # listed first): the rot exists at recovery time and no checkpoint
+    # can land in between
+    plan_b = (FaultPlan()
+              .add_poison_live(at_step)
+              .add_chip_loss(at_step, chips=chips))
+    before_totals = restore_totals()
+    coord_b, events_b, hist_b = run(plan_b, "disk")
+    disk_restores = restore_totals()["disk"] - before_totals["disk"]
+    fallbacks = events_b.events("recovery.live_fallback")
+    restores_b = events_b.events("recovery.restore")
+    disk_ms = (restores_b[0].details.get("restore_ms")
+               if restores_b else None)
+
+    # uninterrupted reference
+    ref = ElasticCoordinator(builder, make_config(), fault_plan=None,
+                             checkpoint_dir=tempfile.mkdtemp(
+                                 prefix="ff_drill_ref_"),
+                             checkpoint_every=10 ** 9)
+    ref_hist = ref.fit(x, y, steps=steps)
+
+    from ..runtime.profiling import print_event_log
+
+    print("[drill] run (a): clean kill, live recovery")
+    print_event_log(events_a)
+    print("[drill] run (b): poisoned state, disk fallback")
+    print_event_log(events_b)
+
+    final_a, final_b = hist_a[-1]["loss"], hist_b[-1]["loss"]
+    ref_final = ref_hist[-1]["loss"]
+
+    def within(v):
+        return (np.isfinite(v)
+                and abs(v - ref_final) <= tolerance * max(1.0,
+                                                          abs(ref_final)))
+
+    checks = {
+        # (a): the live machinery engaged with zero checkpoint-file reads
+        "live_recovery": live_restores == 1,
+        "zero_checkpoint_reads": live_disk_reads == 0,
+        "resumed_at_failing_step": resumed_at_fault,
+        "no_replay": [h["step"] for h in hist_a] == list(range(steps)),
+        # (b): poison detected, routed to disk
+        "poison_detected": any(
+            e.details.get("reason") == "verify" for e in fallbacks),
+        "disk_fallback": disk_restores == 1,
+        # the measurable win: the live restore beats the disk restore by
+        # the file-read + verify + reshard term
+        "live_restore_not_slower": (live_ms is not None
+                                    and disk_ms is not None
+                                    and live_ms <= disk_ms),
+        "loss_within_tolerance": within(final_a) and within(final_b),
+    }
+    ok = all(checks.values())
+    summary = {
+        "ok": ok,
+        "scenario": "live-reshard",
+        "devices": devices,
+        "killed": kill,
+        "steps": steps,
+        "checks": checks,
+        "live_restore_ms": live_ms,
+        "disk_restore_ms": disk_ms,
+        "live_restores": live_restores,
+        "disk_restores": disk_restores,
+        "checkpoint_file_reads_live_run": live_disk_reads,
+        "final_loss_live": round(float(final_a), 6),
+        "final_loss_disk": round(float(final_b), 6),
+        "reference_loss": round(float(ref_final), 6),
+        "final_axes_live": dict(coord_a.model.parallel_axes),
+        "events_live": events_a.counts(),
+        "events_disk": events_b.counts(),
     }
     if trace_out:
         from ..obs.tracing import get_tracer

@@ -42,6 +42,9 @@ WATCHDOG_ROLLBACK = "watchdog.rollback"
 # injected durability faults (elastic/faults.py)
 FAULT_NAN_STEP = "fault.nan_step"
 FAULT_CORRUPT_CKPT = "fault.corrupt_checkpoint"
+# live-resharding faults + recovery-path routing (resharding/)
+FAULT_POISON_LIVE = "fault.poison_live_state"
+RECOVERY_LIVE_FALLBACK = "recovery.live_fallback"
 # calibration-drift feedback loop (obs/refit.py + coordinator)
 DRIFT_BREACH = "drift.breach"
 DRIFT_REFIT = "drift.refit"

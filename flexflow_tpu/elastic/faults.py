@@ -21,6 +21,10 @@ Five fault classes, mirroring what a TPU runbook distinguishes:
 - corrupt checkpoint (torn write): silent on-disk rot of the newest
   checkpoint file; discovered only when a restore verifies checksums
   (runtime/durability.py falls back to an older verified checkpoint).
+- poison live state (silent in-memory rot): the survivors' live training
+  state is corrupted without any error surfacing; discovered only when
+  the zero-disk recovery path verifies the tree (resharding/executor.py
+  verify_live_tree), which must then fall back to the checkpoint restore.
 
 `classify_error` maps REAL runtime exceptions onto the same taxonomy, so
 the detector treats an injected fault and a live XlaRuntimeError uniformly.
@@ -33,7 +37,8 @@ import time
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 from .events import (FAULT_CHIP_LOSS, FAULT_CORRUPT_CKPT, FAULT_NAN_STEP,
-                     FAULT_SLOW_LINK, FAULT_TRANSIENT, EventLog)
+                     FAULT_POISON_LIVE, FAULT_SLOW_LINK, FAULT_TRANSIENT,
+                     EventLog)
 
 # fault kinds (FaultPlan entries)
 TRANSIENT = "transient"
@@ -45,6 +50,12 @@ CHIP_LOSS = "chip_loss"
 # checkpoint (a torn write), exercising the verified-fallback restore.
 NAN_STEP = "nan_step"
 CORRUPT_CKPT = "corrupt_checkpoint"
+# live-resharding fault (ISSUE 8): silent corruption of survivor-resident
+# training state — the poison lands in live device arrays (not on disk),
+# so the zero-disk recovery path's verification must catch it and fall
+# back to the checkpoint restore. Non-raising; applied via the injector's
+# poison_hook (the ElasticCoordinator owns the state being poisoned).
+POISON_LIVE = "poison_live_state"
 
 # error classes (classify_error results)
 CLASS_TRANSIENT = "transient"
@@ -82,7 +93,7 @@ class Fault:
 
     def __post_init__(self):
         if self.kind not in (TRANSIENT, SLOW_LINK, CHIP_LOSS, NAN_STEP,
-                             CORRUPT_CKPT):
+                             CORRUPT_CKPT, POISON_LIVE):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == CHIP_LOSS and not self.chips:
             raise ValueError("chip_loss fault needs a non-empty chips list")
@@ -124,6 +135,10 @@ class FaultPlan:
         self.faults.append(Fault(CORRUPT_CKPT, at_step))
         return self
 
+    def add_poison_live(self, at_step: int) -> "FaultPlan":
+        self.faults.append(Fault(POISON_LIVE, at_step))
+        return self
+
     def take(self, step: int) -> List[Fault]:
         """The next armed fault for `step`, charged one firing, as a 0/1-
         element list. One at a time: a fault that raises must leave later
@@ -151,6 +166,9 @@ class FaultInjector:
         # set by the ElasticCoordinator so corrupt_checkpoint faults know
         # which directory's newest checkpoint to tear
         self.checkpoint_dir: Optional[str] = None
+        # set by the ElasticCoordinator: poison_live_state faults call
+        # this to NaN-poison the live training state in place
+        self.poison_hook = None
 
     def take_nan_step(self, step: int) -> bool:
         """Consume an armed nan_step fault for `step`, if any. Called by
@@ -199,6 +217,12 @@ class FaultInjector:
                 # non-raising side effect: the dispatch proceeds, the rot
                 # is only discovered when a restore verifies checksums
                 self._corrupt_newest_checkpoint(step)
+            elif f.kind == POISON_LIVE:
+                # non-raising: silent live-state rot, discovered only when
+                # a zero-disk recovery verifies the survivors' tree
+                self.events.record(FAULT_POISON_LIVE, step=step)
+                if self.poison_hook is not None:
+                    self.poison_hook()
             elif f.kind == SLOW_LINK:
                 self.events.record(FAULT_SLOW_LINK, step=step,
                                    stall_s=f.stall_s)

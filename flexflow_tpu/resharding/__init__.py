@@ -1,0 +1,30 @@
+"""Live resharding: in-place redistribution of device arrays between two
+searched plans' layouts (arXiv:2112.01075).
+
+The subsystem in one sentence: `redistribute(tree, old_plan, new_plan,
+peak_bytes=...)` plans a minimal all-gather / dynamic-slice / ppermute
+schedule under a per-chip scratch bound, proves it legal through the
+analysis gate's FFTA06x family, and applies it on device with zero host
+or disk round-trips — the primitive behind zero-disk elastic recovery
+(elastic/coordinator.py) and the serving mesh resize
+(serving/sched/continuous.py). docs/resharding.md has the full story.
+"""
+from .cost import schedule_cost_us, step_cost_us
+from .executor import (ReshardResult, apply_schedule, redistribute,
+                       verify_live_tree)
+from .plan import (ALLGATHER, PERMUTE, SLICE, TRANSFER, ArrayMove,
+                   ArraySpec, MeshSpec, ReshardPlanError, ReshardSchedule,
+                   ReshardStep, ShardingPlan, flatten_tree, leaf_itemsize,
+                   plan_move, plan_of, plan_redistribution,
+                   plan_slot_migration, uncovered_arrays, unflatten_tree)
+
+__all__ = [
+    "ALLGATHER", "PERMUTE", "SLICE", "TRANSFER",
+    "ArrayMove", "ArraySpec", "MeshSpec", "ReshardPlanError",
+    "ReshardResult", "ReshardSchedule", "ReshardStep", "ShardingPlan",
+    "apply_schedule", "flatten_tree", "leaf_itemsize", "plan_move",
+    "plan_of",
+    "plan_redistribution", "plan_slot_migration", "redistribute",
+    "schedule_cost_us", "step_cost_us", "uncovered_arrays",
+    "unflatten_tree", "verify_live_tree",
+]

@@ -1,0 +1,42 @@
+"""Price a ReshardSchedule with the machine model's collective terms.
+
+The hook the simulator (search/simulator.py::reshard_cost_us) and the
+serving resize path use to put a microsecond figure on a redistribution
+BEFORE running it — so an elastic recovery can be compared against the
+disk restore it replaces, and a mesh resize against the decode
+iterations it displaces. Pricing reuses the SAME MachineModel collective
+formulas the Unity search costs plans with (allgather_time_us /
+p2p_time_us), so a resize is priced in the same currency as the plans it
+moves between.
+"""
+from __future__ import annotations
+
+from .plan import ALLGATHER, PERMUTE, SLICE, TRANSFER, ReshardSchedule
+
+
+def step_cost_us(step, machine) -> float:
+    if step.kind == ALLGATHER:
+        n = max(2, step.participants)
+        # allgather_time_us takes per-shard bytes; the step records the
+        # total a chip receives ((n-1) shards)
+        return machine.allgather_time_us(
+            step.bytes_per_chip / max(1, n - 1), n)
+    if step.kind in (TRANSFER, PERMUTE):
+        return machine.p2p_time_us(step.bytes_per_chip)
+    if step.kind == SLICE:
+        # local carve-out: HBM-bound read+write of the kept shard, which
+        # the scratch model sizes as both sides of the round in flight
+        return machine.compute_time_us(0.0, step.scratch_bytes)
+    raise ValueError(f"unknown reshard step kind {step.kind!r}")
+
+
+def schedule_cost_us(schedule: ReshardSchedule, machine) -> float:
+    """Total predicted wall time of the schedule in microseconds: moves
+    run serially, each round re-issuing its step sequence."""
+    total = 0.0
+    for move in schedule.moves:
+        if move.noop:
+            continue
+        per_round = sum(step_cost_us(s, machine) for s in move.steps)
+        total += move.rounds * per_round
+    return total

@@ -958,3 +958,15 @@ class Simulator:
             self.cost.op_memory_bytes(op, strategies.get(op.guid, default))
             for op in graph.ops.values()
         )
+
+
+def reshard_cost_us(schedule, machine) -> float:
+    """Price a live-resharding schedule (resharding/plan.py) with the
+    SAME machine-model collective terms the simulator prices plans with —
+    so an elastic recovery's redistribute step and a serving mesh resize
+    are costed in the same currency as the plans they move between. Thin
+    hook over resharding.cost.schedule_cost_us, exposed here so search-
+    side callers need not import the resharding package directly."""
+    from ..resharding.cost import schedule_cost_us
+
+    return schedule_cost_us(schedule, machine)

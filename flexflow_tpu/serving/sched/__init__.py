@@ -19,7 +19,10 @@ one-batch-at-a-time `GenerativeSession.generate`:
    immediately, queued requests prefill into freed slots while the rest
    keep decoding, and prefills run in fixed-size CHUNKS interleaved with
    decode (the chunk-offset scalar-decode_pos path) so long prompts never
-   stall in-flight decodes.
+   stall in-flight decodes. `request_resize` shrinks/grows the decode
+   mesh capacity under load — live sequences' OWNED cache rows migrate
+   into the new arrays between iterations (resharding/, FFTA06x-gated)
+   and in-flight requests keep decoding token-identically.
  - `AdmissionController` (admission.py): bounded queue + admit-time page
    budget (crediting expected prefix sharing) so every accepted request
    can finish; typed backpressure the HTTP endpoint maps to 429.
@@ -30,14 +33,14 @@ one-batch-at-a-time `GenerativeSession.generate`:
 from .admission import (AdmissionController, AdmissionError, QueueFull,
                         PoolSaturated, RequestTooLarge)
 from .continuous import (BatcherStopped, ContinuousBatcher, GenRequest,
-                         RequestCancelled, RequestState)
+                         RequestCancelled, RequestState, ResizeTicket)
 from .kvpool import (PagedKVPool, PoolExhausted, PrefixCache,
                      derive_num_slots, kv_bytes_per_token, kv_cache_spec)
 
 __all__ = [
     "AdmissionController", "AdmissionError", "QueueFull", "PoolSaturated",
     "RequestTooLarge", "BatcherStopped", "ContinuousBatcher", "GenRequest",
-    "RequestCancelled", "RequestState", "PagedKVPool", "PoolExhausted",
-    "PrefixCache", "derive_num_slots", "kv_bytes_per_token",
-    "kv_cache_spec",
+    "RequestCancelled", "RequestState", "ResizeTicket", "PagedKVPool",
+    "PoolExhausted", "PrefixCache", "derive_num_slots",
+    "kv_bytes_per_token", "kv_cache_spec",
 ]

@@ -20,6 +20,12 @@ and drives one of three workloads (``--workload``):
    the in-flight decoders' p99 inter-token latency during the long
    prefill is at least ``--itl-ratio`` (default 3x) lower chunked than
    the one-shot stall — the no-full-prompt-stall acceptance bound.
+ - ``mesh-resize`` (ISSUE 8): the serving mesh shrinks to ``--shrink-to``
+   slots MID-DECODE and grows back, migrating live sequences' owned KV
+   pages through the resharding path (docs/resharding.md). HARD-ASSERTS
+   zero dropped requests, both resizes applied with >=1 in-flight
+   sequence migrated, and every request's greedy tokens identical to a
+   no-resize reference run.
 
 Hard checks for every workload (exit 1 on violation), which is what the
 CI `serving-load` job runs:
@@ -376,12 +382,98 @@ def run_long_prefill(model, max_len: int, slots: int, page_size: int,
     }
 
 
+def run_mesh_resize(model, workload, max_len: int, slots: int,
+                    page_size: int, shrink_to: int,
+                    deadline_s: float) -> Dict:
+    """Drive the mesh-resize scenario: submit the workload, and once
+    tokens are flowing shrink the mesh to `shrink_to` slots (the resize
+    defers until live sequences fit — nothing is dropped), then grow it
+    back. Every request's tokens are compared against a no-resize
+    reference run of the SAME workload — greedy decode must be
+    token-identical across a topology change."""
+    from .continuous import ContinuousBatcher
+
+    from .admission import PoolSaturated, QueueFull
+
+    def drive(batcher, resize: bool) -> Dict:
+        handles = []
+        resizes = []
+        with batcher:
+            warm = np.zeros(
+                max(1, min(batcher.pool.page_size * 2 + 1, max_len - 2)),
+                np.int32)
+            batcher.submit(warm, 2).result(timeout=600.0)
+            t0 = time.monotonic()
+            for w in workload:
+                # a well-behaved client: 429-class rejections retry with
+                # backoff (same contract as run_continuous)
+                while True:
+                    try:
+                        handles.append(
+                            batcher.submit(w["prompt"], w["max_new"]))
+                        break
+                    except (QueueFull, PoolSaturated):
+                        if time.monotonic() - t0 > deadline_s:
+                            raise
+                        time.sleep(0.02)
+            if resize:
+                # wait until decode is genuinely in flight, then resize
+                # under load: shrink (defers until live fits), grow back
+                deadline = time.monotonic() + deadline_s
+                while not any(h.tokens for h in handles):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("no tokens before resize")
+                    time.sleep(0.005)
+                resizes.append(
+                    batcher.request_resize(shrink_to).wait(
+                        timeout=deadline_s))
+                resizes.append(
+                    batcher.request_resize(slots).wait(
+                        timeout=deadline_s))
+            results = [h.result(timeout=600.0) for h in handles]
+            wall = time.monotonic() - t0
+        tokens = sum(len(r) for r in results)
+        return {
+            "wall_s": round(wall, 3),
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+            "dropped": sum(
+                1 for h, w in zip(handles, workload)
+                if h.error is not None or len(h.tokens) != w["max_new"]),
+            "token_lists": [[int(t) for t in h.tokens] for h in handles],
+            "resizes": resizes,
+        }
+
+    def make_batcher():
+        return ContinuousBatcher(
+            model, max_len=max_len, num_slots=slots, page_size=page_size,
+            prefix_cache_pages=0, max_queue=max(len(workload), 1))
+
+    ref = drive(make_batcher(), resize=False)
+    res = drive(make_batcher(), resize=True)
+    parity_bad = sum(1 for a, b in zip(res["token_lists"],
+                                       ref["token_lists"]) if a != b)
+    out = {k: v for k, v in res.items() if k != "token_lists"}
+    out.update({
+        "requests": len(workload),
+        "parity_mismatches": parity_bad,
+        "reference_tokens_per_s": ref["tokens_per_s"],
+        "reference_dropped": ref["dropped"],
+        "migrated_in_flight": min(
+            (r.get("in_flight", 0) for r in res["resizes"]), default=0),
+        "predicted_resize_us": [r.get("predicted_us")
+                                for r in res["resizes"]],
+    })
+    return out
+
+
 def run_bench(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="flexflow_tpu serve-bench",
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
-                    choices=("mixed", "shared-prefix", "long-prefill"))
+                    choices=("mixed", "shared-prefix", "long-prefill",
+                             "mesh-resize"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -427,12 +519,18 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--itl-ratio", type=float, default=3.0,
                     help="require one-shot stall max / chunked stall p99"
                          " >= this (long-prefill)")
+    # mesh-resize workload
+    ap.add_argument("--shrink-to", type=int, default=None,
+                    help="mid-decode shrink target in slots"
+                         " (mesh-resize; default slots // 2)")
     args = ap.parse_args(argv)
 
     if args.workload == "shared-prefix":
         return _run_shared_prefix_cli(args)
     if args.workload == "long-prefill":
         return _run_long_prefill_cli(args)
+    if args.workload == "mesh-resize":
+        return _run_mesh_resize_cli(args)
 
     window = args.prompt_max
     max_len = args.prompt_max + args.out_max
@@ -522,6 +620,65 @@ def _finish(args, report: Dict, failures: List[str]) -> int:
         return 1
     print("[serve-bench] OK")
     return 0
+
+
+def _run_mesh_resize_cli(args) -> int:
+    """Serving mesh resize under load (ISSUE 8 acceptance: the mesh
+    shrinks and grows back mid-decode with zero dropped requests and
+    token-identical outputs vs a no-resize reference run)."""
+    shrink_to = args.shrink_to if args.shrink_to is not None \
+        else max(1, args.slots // 2)
+    if not 1 <= shrink_to < args.slots:
+        raise SystemExit(
+            f"--shrink-to {shrink_to} must be in [1, --slots {args.slots})")
+    window = args.prompt_max
+    max_len = args.prompt_max + args.out_max
+    print(f"[serve-bench] mesh-resize: {args.requests} requests on"
+          f" {args.slots} slots, shrink to {shrink_to} mid-decode and"
+          f" grow back (outputs {args.out_min}-{args.out_max})")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    workload = make_workload(args.requests, args.prompt_min,
+                             args.prompt_max, args.out_min, args.out_max,
+                             args.vocab, args.seed)
+    res = run_mesh_resize(model, workload, max_len, args.slots,
+                          args.page_size, shrink_to, args.deadline)
+    print(f"[serve-bench] {res['tokens']} tokens in {res['wall_s']}s ="
+          f" {res['tokens_per_s']} tok/s (no-resize reference"
+          f" {res['reference_tokens_per_s']} tok/s) | dropped"
+          f" {res['dropped']} | parity mismatches"
+          f" {res['parity_mismatches']}")
+    for r in res["resizes"]:
+        print(f"[serve-bench] resize {r['from']}->{r['to']}"
+              f" ({r['direction']}): migrated {r['migrated_rows']} rows,"
+              f" {r['in_flight']} in-flight, predicted"
+              f" {r['predicted_us']} us, wall {r['wall_ms']} ms")
+
+    failures = []
+    if res["dropped"] or res["reference_dropped"]:
+        failures.append(
+            f"dropped/short requests: resize run {res['dropped']},"
+            f" reference {res['reference_dropped']}")
+    if res["parity_mismatches"]:
+        failures.append(
+            f"{res['parity_mismatches']} requests' greedy tokens changed"
+            " across the resize")
+    if len(res["resizes"]) != 2:
+        failures.append(
+            f"expected shrink + grow, applied {len(res['resizes'])}")
+    elif res["resizes"][0]["to"] != shrink_to:
+        failures.append(
+            f"shrink landed on {res['resizes'][0]['to']} slots, wanted"
+            f" {shrink_to}")
+    if res["migrated_in_flight"] < 1:
+        failures.append(
+            "no in-flight sequence was migrated — the resize never"
+            " happened under load (raise --out-max)")
+    _check_exposition(failures,
+                      extra_required=("ff_serving_resizes_total",))
+    return _finish(args, {"config": vars(args), "mesh_resize": res},
+                   failures)
 
 
 def _run_shared_prefix_cli(args) -> int:

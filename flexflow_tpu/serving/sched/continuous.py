@@ -75,6 +75,39 @@ class RequestCancelled(RuntimeError):
     .cancel) before it reached a slot."""
 
 
+class ResizeTicket:
+    """Handle for one requested mesh resize (ContinuousBatcher
+    .request_resize). The scheduler applies the resize between
+    iterations — once live sequences fit the target — and resolves the
+    ticket with the migration stats; `wait()` blocks until then."""
+
+    def __init__(self, target_slots: int):
+        self.target_slots = int(target_slots)
+        self.result: Optional[Dict] = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> Dict:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"resize to {self.target_slots} slots not applied within"
+                f" {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _finish(self, result: Dict) -> None:
+        self.result = result
+        self._done.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        self._done.set()
+
+
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
@@ -338,6 +371,13 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._completed = 0
         self._failed = 0
+        # mesh resize (docs/resharding.md): one pending ticket at a time,
+        # applied by the scheduler thread between iterations
+        self._pending_resize: Optional[ResizeTicket] = None
+        self._resizes: List[Dict] = []
+        self._c_resizes = registry.counter(
+            "ff_serving_resizes_total",
+            "Applied serving mesh resizes", labels=("direction",))
 
     # -- jitted device functions ------------------------------------------
     def _zero_caches(self):
@@ -624,6 +664,7 @@ class ContinuousBatcher:
             # else: keep the handle — start() must refuse to spawn a
             # second loop over the same (donated) cache arrays
         self._drain_queue(BatcherStopped("batcher stopped"))
+        self._fail_pending_resize(BatcherStopped("batcher stopped"))
 
     def __enter__(self):
         self.start()
@@ -686,6 +727,37 @@ class ContinuousBatcher:
         req._fail(RequestCancelled(f"request {req.id} cancelled"))
         return True
 
+    def request_resize(self, num_slots: Optional[int] = None,
+                       machine=None) -> ResizeTicket:
+        """Resize the serving mesh capacity under load: give an explicit
+        slot target OR a machine spec (the grown/shrunk mesh's chip),
+        from which the target is derived through the same HBM model that
+        sized the pool (`derive_num_slots`). The scheduler applies the
+        resize between iterations — a shrink waits until live sequences
+        fit the target (new admissions are held, nothing is dropped) —
+        migrating every live sequence's OWNED cache rows into the new
+        arrays, so in-flight requests keep decoding token-identically.
+        Returns a ResizeTicket; `.wait()` blocks until applied."""
+        if num_slots is None and machine is None:
+            raise ValueError("give num_slots or a machine spec")
+        if num_slots is None:
+            num_slots = max(1, derive_num_slots(self.model, self.max_len,
+                                                machine=machine)
+                            - self.pool.band_slots)
+        target = int(num_slots)
+        if target < 1:
+            raise ValueError(f"num_slots={target}: need >= 1")
+        ticket = ResizeTicket(target)
+        with self._cv:
+            if not self._running:
+                raise BatcherStopped("batcher is not running")
+            if (self._pending_resize is not None
+                    and not self._pending_resize.done()):
+                raise RuntimeError("a resize is already pending")
+            self._pending_resize = ticket
+            self._cv.notify_all()
+        return ticket
+
     def stats(self) -> Dict[str, object]:
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
@@ -696,6 +768,8 @@ class ContinuousBatcher:
             "completed": self._completed,
             "failed": self._failed,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "num_slots": self.num_slots,
+            "resizes": list(self._resizes),
             "pool": self.pool.stats(),
             "admission": self.admission.stats(),
         }
@@ -713,11 +787,17 @@ class ContinuousBatcher:
             while True:
                 with self._cv:
                     while (self._running and not self._queue
-                           and not any(self._slots)):
+                           and not any(self._slots)
+                           and self._pending_resize is None):
                         self._cv.wait(timeout=0.1)
                     if not self._running and not any(self._slots):
                         break
                     running = self._running
+
+                # 0) apply a pending mesh resize (a shrink defers until
+                #    live sequences fit; admissions are held meanwhile)
+                if self._pending_resize is not None:
+                    self._maybe_resize(tracer)
 
                 # 1) move queued requests into free slots (skipped once
                 #    stopping: queued requests fail fast in stop()). In
@@ -772,6 +852,132 @@ class ContinuousBatcher:
         finally:
             self._g_active.set(0, pool=self.pool.label)
 
+    def _maybe_resize(self, tracer) -> None:
+        """Apply the pending resize (scheduler thread only). The
+        migration is itself a resharding schedule: gated by the FFTA06x
+        analysis family (old + new arrays coexist during the copy, so
+        scratch = the new arrays' bytes vs HBM) and priced with the
+        machine model's collective terms BEFORE any device work. Only
+        rows the page tables still OWN are copied (`owned_view`) — a
+        freed sequence's stale rows can never ship into the new arrays
+        (asserted, and pinned by tests/test_mesh_resize.py)."""
+        import jax.numpy as jnp
+
+        ticket = self._pending_resize
+        if ticket is None:
+            return
+        target = ticket.target_slots
+        if target == self.num_slots:
+            with self._cv:
+                self._pending_resize = None
+            ticket._finish({"from": target, "to": target,
+                            "direction": "noop", "migrated_rows": 0,
+                            "in_flight": 0, "predicted_us": 0.0,
+                            "wall_ms": 0.0, "noop": True})
+            return
+        if self.pool.live_sequences() > target:
+            return  # shrink defers until enough sequences finish
+        direction = "shrink" if target < self.num_slots else "grow"
+        t0 = time.monotonic()
+        with tracer.span("serve.resize", slots_from=self.num_slots,
+                         slots_to=target) as sp:
+            from ...analysis import PlanAnalysisError, check_redistribution
+            from ...resharding import plan_slot_migration, schedule_cost_us
+            from ...resharding.plan import leaf_itemsize
+            from ...search.machine_model import make_machine_model
+            from .kvpool import PoolExhausted
+
+            kv_shapes = {
+                f"kv/{name}/{part}": (tuple(int(d) for d in arr.shape),
+                                      leaf_itemsize(arr.dtype))
+                for name, pair in self._caches.items()
+                for part, arr in pair.items()
+            }
+            live = [s for s in self._slots if s is not None]
+            n_rows = sum(hi - lo
+                         for s in live
+                         for _, lo, hi in self.pool.owned_view(s.req.id))
+            machine = make_machine_model(
+                self.model.config, max(1, self.model.config.total_devices))
+            schedule = plan_slot_migration(kv_shapes, self.num_slots,
+                                           target, n_rows)
+            try:
+                check_redistribution(schedule, machine=machine)
+            except PlanAnalysisError as err:
+                with self._cv:
+                    self._pending_resize = None
+                ticket._fail(err)
+                return
+            predicted_us = schedule_cost_us(schedule, machine)
+            try:
+                moves = self.pool.resize(target)
+            except PoolExhausted:
+                return  # a request landed since the check: defer again
+            # row coordinates, built ONLY from what the page tables own
+            src_sl: List[np.ndarray] = []
+            src_rw: List[np.ndarray] = []
+            dst_sl: List[np.ndarray] = []
+            dst_rw: List[np.ndarray] = []
+            slot_map: Dict[object, int] = {}
+            for seq_id, old_slot, new_slot, n_pages in moves:
+                slot_map[seq_id] = new_slot
+                owned_rows = 0
+                for slot, lo, hi in self.pool.owned_view(seq_id):
+                    # the stale-page guard: every copied row lies inside
+                    # a page this sequence's table owns, in its slot
+                    assert slot == new_slot and hi <= self.max_len, \
+                        (seq_id, slot, new_slot, lo, hi)
+                    src_sl.append(np.full(hi - lo, old_slot, np.int32))
+                    src_rw.append(np.arange(lo, hi, dtype=np.int32))
+                    dst_sl.append(np.full(hi - lo, new_slot, np.int32))
+                    dst_rw.append(np.arange(lo, hi, dtype=np.int32))
+                    owned_rows += hi - lo
+                assert owned_rows <= n_pages * self.pool.page_size, \
+                    (seq_id, owned_rows, n_pages)
+            copied = int(sum(a.size for a in src_rw))
+            if copied:
+                c_src_sl = np.concatenate(src_sl)
+                c_src_rw = np.concatenate(src_rw)
+                c_dst_sl = np.concatenate(dst_sl)
+                c_dst_rw = np.concatenate(dst_rw)
+            # the device allocation + gather/scatter runs OUTSIDE the
+            # lock (the cache arrays are touched only by this scheduler
+            # thread); server threads keep submitting/reading stats while
+            # the copy is in flight — only the pointer swap is locked
+            old_caches = self._caches
+            new_caches: Dict[str, Dict[str, object]] = {}
+            for name, pair in old_caches.items():
+                new_caches[name] = {}
+                for part, arr in pair.items():
+                    buf = jnp.zeros((target,) + tuple(arr.shape[1:]),
+                                    arr.dtype)
+                    if copied:
+                        buf = buf.at[c_dst_sl, c_dst_rw].set(
+                            arr[c_src_sl, c_src_rw])
+                    new_caches[name][part] = buf
+            with self._cv:
+                self._caches = new_caches
+                new_slot_list: List[Optional[_Slot]] = [None] * target
+                for s in live:
+                    s.slot = slot_map[s.req.id]
+                    new_slot_list[s.slot] = s
+                self._slots = new_slot_list
+                prev = self.num_slots
+                self.num_slots = target
+                self._pending_resize = None
+            result = {
+                "from": prev, "to": target, "direction": direction,
+                "migrated_rows": copied, "in_flight": len(moves),
+                "predicted_us": round(float(predicted_us), 2),
+                "wall_ms": round((time.monotonic() - t0) * 1e3, 3),
+            }
+            self._resizes.append(result)
+            self._c_resizes.inc(direction=direction)
+            sp.set(**result)
+        ticket._finish(result)
+        with self._cv:
+            self._cv.notify_all()
+
     def _admit_new(self, params, state, tracer) -> None:
         """Move queued requests into free slots. One-shot mode runs the
         whole prefill here (the pre-chunking behavior); chunked mode pins +
@@ -782,6 +988,11 @@ class ContinuousBatcher:
 
         while True:
             with self._cv:
+                if self._pending_resize is not None:
+                    # hold admissions while a resize is pending: a shrink
+                    # is waiting for live sequences to drain, and filling
+                    # freed slots would starve it
+                    return
                 if not self._queue or self.pool.free_slot_count() == 0:
                     return
                 req = self._queue.pop(0)
@@ -950,6 +1161,12 @@ class ContinuousBatcher:
         self._g_active.set(sum(1 for s in self._slots if s is not None),
                            pool=self.pool.label)
 
+    def _fail_pending_resize(self, err: BaseException) -> None:
+        with self._cv:
+            ticket, self._pending_resize = self._pending_resize, None
+        if ticket is not None and not ticket.done():
+            ticket._fail(err)
+
     def _drain_queue(self, err: BaseException) -> None:
         with self._cv:
             pending, self._queue = self._queue, []
@@ -972,3 +1189,4 @@ class ContinuousBatcher:
             self._c_requests.inc(outcome="failed")
             s.req._fail(err)
         self._drain_queue(err)
+        self._fail_pending_resize(err)

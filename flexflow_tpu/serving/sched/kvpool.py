@@ -15,12 +15,14 @@ reasons about *pages*, utilization reports live tokens rather than
 worst-case slots, and a finished short request frees capacity mid-decode
 instead of at batch end.
 
-What this deliberately does NOT do (yet) is scatter one sequence across
+What this deliberately does NOT do is scatter one sequence across
 slots: a sequence's pages are consecutive blocks of the slot it occupies,
 so the attention kernel needs no gather. The portable-redistribution view
-of arXiv:2112.01075 applies unchanged if the elastic coordinator re-plans
-the serving mesh — pool pages are named independently of devices, so
-resharding is a page-table rewrite plus an array reshard.
+of arXiv:2112.01075 applies when the serving mesh resizes — pool pages
+are named independently of devices, so a resize is a page-table rewrite
+(`resize`) plus a device copy of exactly the rows the page tables still
+own (`owned_view`; the ContinuousBatcher's migration path, gated by the
+same FFTA06x analysis family elastic recovery uses — docs/resharding.md).
 
 Multi-tenant prefix reuse (`PrefixCache`) builds on exactly that naming:
 cached prefix pages live in a device-side *band* of extra slot-shaped
@@ -485,6 +487,76 @@ class PagedKVPool:
                 return
             self._free_slots.append(ent[0])
         self._sync_gauges()
+
+    # -- live resharding (mesh resize) -------------------------------------
+    def owned_view(self, seq_id) -> List[Tuple[int, int, int]]:
+        """(slot, row_lo, row_hi) spans of the cache rows `seq_id`
+        currently OWNS, driven by its page table (`pages_of`). The device
+        arrays keep freed pages' contents live until reallocation, so
+        anything OUTSIDE these spans is stale by definition — a migration
+        (resize) must copy owned rows and nothing else, or it would ship
+        a dead sequence's KV into the new arrays. Adjacent pages merge
+        into one span (a sequence's pages are consecutive blocks of its
+        slot)."""
+        with self._lock:
+            ent = self._table.get(seq_id)
+            if ent is None:
+                return []
+            slot, pages = ent
+            spans: List[Tuple[int, int, int]] = []
+            for p in pages:
+                blk = p - slot * self.pages_per_slot
+                lo = blk * self.page_size
+                hi = min(lo + self.page_size, self.max_len)
+                if spans and spans[-1][0] == slot and spans[-1][2] == lo:
+                    spans[-1] = (slot, spans[-1][1], hi)
+                else:
+                    spans.append((slot, lo, hi))
+            return spans
+
+    def resize(self, new_num_slots: int) -> List[Tuple[object, int, int,
+                                                       int]]:
+        """Rewrite the page tables for `new_num_slots` decode slots (the
+        serving mesh grew or shrank). Per-slot geometry (max_len,
+        page_size, pages_per_slot) is unchanged — a page keeps its block
+        offset, sequences whose slot survives keep it, and sequences
+        whose slot index no longer exists move into the lowest free
+        surviving slot. Raises PoolExhausted when live sequences exceed
+        the new capacity (the batcher defers the resize until enough
+        finish). Returns the FULL migration list [(seq_id, old_slot,
+        new_slot, n_pages)] — on a resize the device arrays are
+        reallocated, so even unmoved slots' owned rows must be copied
+        across by the caller."""
+        new_num_slots = int(new_num_slots)
+        if new_num_slots < 1:
+            raise ValueError(f"new_num_slots={new_num_slots}: need >= 1")
+        with self._lock:
+            live = sorted(self._table.items(), key=lambda kv: kv[1][0])
+            if len(live) > new_num_slots:
+                raise PoolExhausted(
+                    f"{len(live)} live sequences exceed the new capacity"
+                    f" ({new_num_slots} slots); drain first")
+            keep = {slot for _, (slot, _) in live
+                    if slot < new_num_slots}
+            free_new = [s for s in range(new_num_slots) if s not in keep]
+            free_new.reverse()  # pop() yields the lowest index first
+            moves: List[Tuple[object, int, int, int]] = []
+            pps = self.pages_per_slot
+            for seq_id, (slot, pages) in live:
+                new_slot = slot if slot < new_num_slots \
+                    else free_new.pop()
+                blocks = [p - slot * pps for p in pages]
+                self._table[seq_id] = (
+                    new_slot, [new_slot * pps + b for b in blocks])
+                moves.append((seq_id, slot, new_slot, len(pages)))
+            taken = {m[2] for m in moves}
+            self._free_slots = [s for s in range(new_num_slots)
+                                if s not in taken][::-1]
+            self.num_slots = new_num_slots
+            self.total_pages = new_num_slots * pps
+        self._g_total.set(self.total_pages, pool=self.label)
+        self._sync_gauges()
+        return moves
 
     # -- accounting --------------------------------------------------------
     def slot_of(self, seq_id) -> Optional[int]:

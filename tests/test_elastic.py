@@ -265,7 +265,10 @@ def test_kill_chip_research_restore_resume(tmp_path):
     x, y = make_data(batch=12)
     coord = ElasticCoordinator(
         builder, make_config(devices=4, batch=12), fault_plan=plan,
-        events=events, checkpoint_dir=str(tmp_path), checkpoint_every=2)
+        events=events, checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        # this test pins the DISK restore path (checkpoint + replay);
+        # the zero-disk live path has its own tests in test_resharding.py
+        live_resharding=False)
     history = coord.fit(x, y, steps=8)
 
     # recovered exactly once onto the 3 survivors

@@ -506,7 +506,11 @@ def test_recovery_spans_in_trace(tmp_path):
             builder, config, fault_plan=plan,
             checkpoint_dir=str(tmp_path), checkpoint_every=2,
             events=EventLog(),
-            retry_policy=RetryPolicy(max_retries=2, base_delay_s=0.01))
+            retry_policy=RetryPolicy(max_retries=2, base_delay_s=0.01),
+            # pin the disk restore path: this test asserts the
+            # checkpoint.restore span; live-recovery spans are covered
+            # by test_resharding.py
+            live_resharding=False)
         x, y = _data(32)
         coord.fit(x, y, steps=6)
         names = tr.span_names()

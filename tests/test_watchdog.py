@@ -152,7 +152,11 @@ def test_coordinator_corrupt_checkpoint_falls_back(tmp_path):
     x, y = make_data(batch=12)
     coord = ElasticCoordinator(
         builder, make_config(devices=4, batch=12), fault_plan=plan,
-        events=events, checkpoint_dir=str(tmp_path), checkpoint_every=2)
+        events=events, checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        # the scenario exists to prove the DISK path's verified fallback;
+        # with live resharding on, a clean live tree would sidestep the
+        # torn checkpoint entirely
+        live_resharding=False)
     history = coord.fit(x, y, steps=8)
 
     assert len(events.events("recovery.done")) == 1
