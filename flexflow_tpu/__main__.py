@@ -47,7 +47,19 @@ def _synthetic(model_name, config):
         y = rng.randint(0, 10, size=(b * 4, 1)).astype(np.int32)
         return m, [x], y
     if model_name == "bert":
-        cfg = zoo.TransformerConfig()
+        import os
+
+        # FF_BERT_* env knobs shrink the OSDI'22 config so the CPU CI
+        # (and the kernels job's profile run) can afford it; unset =
+        # the real bert_base (bench.py's BENCH_* knobs, same idea)
+        cfg = zoo.TransformerConfig(
+            hidden_size=int(os.environ.get("FF_BERT_HIDDEN", 1024)),
+            embedding_size=int(os.environ.get("FF_BERT_HIDDEN", 1024)),
+            num_heads=int(os.environ.get("FF_BERT_HEADS", 16)),
+            num_layers=int(os.environ.get("FF_BERT_LAYERS", 12)),
+            sequence_length=int(os.environ.get("FF_BERT_SEQ", 512)),
+            vocab_size=int(os.environ.get("FF_BERT_VOCAB", 30522)),
+        )
         tokens = m.create_tensor([b, cfg.sequence_length],
                                  ff.DataType.DT_INT32)
         zoo.build_bert_encoder(m, tokens, cfg)

@@ -44,9 +44,10 @@ class FFConfig:
     `--memory-search`, `--profiling`, `--fusion`.
 
     TPU-native additions beyond the reference surface:
-    `--steps-per-execution` (K optimizer steps per jitted dispatch) and
+    `--steps-per-execution` (K optimizer steps per jitted dispatch),
     `--flash-block-q`/`--flash-block-k` (Pallas flash-attention tiling,
-    swept by scripts/sweep_flash.py).
+    swept by scripts/sweep_flash.py), and `--kernel-impl` (fused-kernel
+    tier selection, kernels/registry.py).
     """
 
     batch_size: int = 64
@@ -60,6 +61,12 @@ class FFConfig:
     # scripts/sweep_flash.py sweeps these on the live chip.
     flash_block_q: int = 512
     flash_block_k: int = 512
+    # Kernel-tier selection knob (kernels/registry.py, docs/kernels.md):
+    # "auto" (backend capability + calibration residuals), a bare
+    # "pallas"/"reference" forcing every family, or a per-family list
+    # "attention=pallas,layernorm=reference,...". ONE knob for what used
+    # to be the ad-hoc use_flash heuristic plus per-callsite flags.
+    kernel_impl: str = "auto"
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
@@ -201,6 +208,12 @@ class FFConfig:
                 self.flash_block_q = int(take())
             elif a == "--flash-block-k":
                 self.flash_block_k = int(take())
+            elif a == "--kernel-impl":
+                v = take()
+                from .kernels.registry import KernelRegistry
+
+                KernelRegistry.parse_spec(v)  # validate; raises on junk
+                self.kernel_impl = v
             elif a in ("--lr", "--learning-rate"):
                 self.learning_rate = float(take())
             elif a in ("--wd", "--weight-decay"):

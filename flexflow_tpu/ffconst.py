@@ -121,6 +121,7 @@ class OpType(enum.Enum):
     SOFTMAX = "softmax"
     BATCHNORM = "batchnorm"
     LAYERNORM = "layernorm"
+    RMSNORM = "rmsnorm"
     CONCAT = "concat"
     SPLIT = "split"
     EMBEDDING = "embedding"

@@ -1,0 +1,138 @@
+"""Fused vector-`decode_pos` attention decode step (Pallas, fwd-only).
+
+The continuous batcher's per-iteration hot loop (serving/sched/
+continuous.py `decode_all`) runs ops/attention.py `_decode_step` with a
+(B,) VECTOR of per-slot positions: every active slot attends its one new
+query against its own span of the paged KV cache. The reference lowering
+materializes the (B, h, 1, M) logits and probs in HBM every iteration;
+this kernel runs QK^T -> masked softmax -> V in ONE pass with the
+query resident and the cache streamed through VMEM in `block_k` rows
+(online softmax across blocks, f32 accumulation).
+
+Inference-only, so no VJP. Layout is packed (heads iterated over lane
+slices inside the body, like kernels/flash_attention.py's packed
+variant): q (B, 1, heads*d), caches (B, M, heads*d) — free trailing-dim
+reshapes of the attention op's [B, M, h, d] caches, no transposes.
+
+Token parity: when the whole cache fits one block the kernel computes
+max/exp/sum/divide in exactly the reference einsum path's order and
+dtypes, so greedy decode is token-identical to the reference
+(tests/test_pallas_kernels.py pins this, including ragged positions and
+slot reuse).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_k, kv_len, heads, head_dim):
+    """Grid = (B, n_k_blocks); k innermost, q row resident."""
+    ik = pl.program_id(1)
+    n_kb = pl.num_programs(1)
+    single = n_kb == 1
+
+    if not single:
+        @pl.when(ik == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                          # (1, e)
+    k = k_ref[0].astype(q.dtype)                          # (bk, e)
+    v = v_ref[0].astype(q.dtype)
+    pos = pos_ref[0, 0]
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    mask = (k_pos < kv_len) & (k_pos <= pos)
+
+    for h in range(heads):
+        sl = slice(h * head_dim, (h + 1) * head_dim)
+        s = jnp.dot(q[:, sl], k[:, sl].T,
+                    preferred_element_type=jnp.float32) * scale  # (1, bk)
+        s = jnp.where(mask, s, NEG_INF)
+        if single:
+            # plain softmax in the reference path's exact op order, so
+            # greedy decode stays token-identical to the einsum lowering
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, sl] = jnp.dot(
+                (p / l_safe).astype(q.dtype), v[:, sl],
+                preferred_element_type=jnp.float32).astype(o_ref.dtype)
+            continue
+        m_prev = m_ref[:, h:h + 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        m_ref[:, h:h + 1] = m_new
+        l_ref[:, h:h + 1] = (l_ref[:, h:h + 1] * correction
+                             + jnp.sum(p, axis=1, keepdims=True))
+        acc_ref[:, sl] = acc_ref[:, sl] * correction + jnp.dot(
+            p.astype(q.dtype), v[:, sl],
+            preferred_element_type=jnp.float32)
+
+    if not single:
+        @pl.when(ik == n_kb - 1)
+        def _emit():
+            l = l_ref[:]                                  # (1, heads)
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            for h in range(heads):
+                sl = slice(h * head_dim, (h + 1) * head_dim)
+                o_ref[0, :, sl] = (acc_ref[:, sl]
+                                   / l_safe[:, h:h + 1]).astype(o_ref.dtype)
+
+
+def fused_decode_attention(q, k_cache, v_cache, pos, *, scale: float,
+                           block_k: int = 512, interpret: bool = False):
+    """One decode step for every slot: q (B, 1, h, d) new-token
+    projections, caches (B, M, h, d) ALREADY updated at pos, pos (B,)
+    per-slot positions. Returns the context (B, 1, h, d) in q.dtype —
+    the output projection stays outside (a plain matmul XLA handles)."""
+    b, c, heads, head_dim = q.shape
+    if c != 1:
+        raise ValueError(
+            f"fused decode takes one query token per slot, got C={c}")
+    m = k_cache.shape[1]
+    e = heads * head_dim
+    qp = q.reshape(b, 1, e)
+    kp = k_cache.reshape(b, m, e)
+    vp = v_cache.reshape(b, m, e)
+    block_k = max(1, min(block_k, m))
+    m_pad = -(-m // block_k) * block_k
+    if m_pad != m:
+        kp = jnp.pad(kp, ((0, 0), (0, m_pad - m), (0, 0)))
+        vp = jnp.pad(vp, ((0, 0), (0, m_pad - m), (0, 0)))
+    pos2 = pos.astype(jnp.int32).reshape(b, 1)
+    n_kb = m_pad // block_k
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          block_k=block_k, kv_len=m, heads=heads,
+                          head_dim=head_dim),
+        grid=(b, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, e), lambda ib, ik: (ib, 0, 0)),
+            pl.BlockSpec((1, block_k, e), lambda ib, ik: (ib, ik, 0)),
+            pl.BlockSpec((1, block_k, e), lambda ib, ik: (ib, ik, 0)),
+            pl.BlockSpec((1, 1), lambda ib, ik: (ib, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, e), lambda ib, ik: (ib, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1, e), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, e), jnp.float32),
+            pltpu.VMEM((1, heads), jnp.float32),
+            pltpu.VMEM((1, heads), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, pos2)
+    return out.reshape(b, 1, heads, head_dim)

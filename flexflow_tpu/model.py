@@ -295,6 +295,20 @@ class FFModel:
             axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps,
         ).outputs[0]
 
+    def rms_norm(
+        self,
+        input: Tensor,
+        axes: Sequence[int],
+        elementwise_affine: bool = True,
+        eps: float = 1e-6,
+        name: str = "",
+    ) -> Tensor:
+        axes = [a if a >= 0 else input.num_dims + a for a in axes]
+        return self._add_op(
+            OpType.RMSNORM, [input], name,
+            axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps,
+        ).outputs[0]
+
     def softmax(self, input: Tensor, axis: int = -1, name: str = "") -> Tensor:
         return self._add_op(OpType.SOFTMAX, [input], name, axis=axis).outputs[0]
 
@@ -601,6 +615,13 @@ class FFModel:
         self.loss = Loss(loss_type) if not isinstance(loss_type, Loss) else loss_type
         self.metrics = Metrics(self.loss.loss_type, list(metrics))
         self.comp_mode = comp_mode
+
+        # kernel tier (docs/kernels.md): adopt the --kernel-impl knob and
+        # the fitted profile's per-op-family residuals BEFORE the search,
+        # so the simulator prices the same selections the lowering makes
+        from .kernels.registry import KERNELS
+
+        KERNELS.configure(self.config)
 
         self.graph = Graph(self.ops)
         order = self.graph.topo_order()

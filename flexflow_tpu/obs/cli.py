@@ -16,6 +16,14 @@ emits the full observability bundle into --out (default ./profile_out):
                       (samples/s/chip, MFU, predicted vs measured step us)
                       so the perf trajectory resumes with every run
 
+`--kernel-report` additionally prints (and writes kernel_report.txt)
+the ranked fused-kernel candidates: per kernel-tier op family
+(docs/kernels.md), the median calibration residual weighted by the
+family's share of predicted step time — where a Pallas kernel buys the
+most. The same per-family residuals are persisted by `--refit` into the
+fitted profile, which is what lets the KernelRegistry auto-select the
+fused kernels on later runs.
+
 Refit mode (`--refit`, docs/observability.md "Closing the loop"): after
 training, fit the machine-model coefficients from the calibration data
 (obs/refit.py) until the re-simulated predicted step cost converges on
@@ -234,6 +242,9 @@ def run_profile(argv: Optional[List[str]] = None) -> int:
     refit_mode = "--refit" in argv
     if refit_mode:
         argv.remove("--refit")
+    kernel_report = "--kernel-report" in argv
+    if kernel_report:
+        argv.remove("--kernel-report")
     refit_rounds = _take(argv, "--refit-rounds", 3, cast=int)
     refit_tol = _take(argv, "--refit-tol", 0.15, cast=float)
     miscal_spec = _take(argv, "--miscalibrate", None)
@@ -347,6 +358,12 @@ def run_profile(argv: Optional[List[str]] = None) -> int:
                                  "final_ratio": None, "replans": 0,
                                  "error": str(e)}
     print(report.format())
+    if kernel_report:
+        # ranked fused-kernel candidates (docs/kernels.md): worst
+        # calibration residual weighted by share of predicted step time
+        print(report.format_kernel_report())
+        with open(os.path.join(out_dir, "kernel_report.txt"), "w") as f:
+            f.write(report.format_kernel_report() + "\n")
     trace_path = tracer.export_chrome_trace(
         os.path.join(out_dir, "trace.json"))
     with open(os.path.join(out_dir, "calibration.json"), "w") as f:
@@ -414,6 +431,8 @@ def run_profile(argv: Optional[List[str]] = None) -> int:
         "predicted_step_us": predicted,
         "measured_step_us": report.measured_step_us,
         "refit": refit_summary,
+        "kernel_candidates": (report.kernel_candidates()
+                              if kernel_report else None),
         "problems": problems,
     }
     print(json.dumps(summary))

@@ -143,6 +143,13 @@ class FittedProfile:
     rounds: int = 0
     step_ratio: float = float("nan")
     num_chips: int = 0
+    # per-kernel-family calibration residuals (median measured/predicted
+    # at fit time, obs/calibration.op_family_residuals): the evidence the
+    # KernelRegistry auto-selects fused Pallas kernels from
+    # (kernels/registry.py, docs/kernels.md). Informational for the
+    # machine model itself — apply_to never touches it.
+    op_family_residuals: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     def __post_init__(self):
         if not self.spec_hash:
@@ -213,7 +220,10 @@ class FittedProfile:
                    fitted_ops=int(d.get("fitted_ops", 0)),
                    rounds=int(d.get("rounds", 0)),
                    step_ratio=float(d.get("step_ratio", float("nan"))),
-                   num_chips=int(d.get("num_chips", 0)))
+                   num_chips=int(d.get("num_chips", 0)),
+                   op_family_residuals={
+                       str(k): float(v) for k, v in dict(
+                           d.get("op_family_residuals", {})).items()})
 
 
 # -- the coefficient fit ---------------------------------------------------
@@ -460,11 +470,18 @@ def refit(model, measured_step_us: float, op_rows,
         max(1, model.config.total_devices))
     import jax
 
+    from .calibration import op_family_residuals
+
     profile = FittedProfile(
         chip=machine.chip.name, backend=jax.default_backend(),
         coefficients=coeffs, fitted_steps=1, fitted_ops=len(rows),
         rounds=len(history), step_ratio=history[-1].ratio,
-        num_chips=max(1, model.config.total_devices))
+        num_chips=max(1, model.config.total_devices),
+        # residuals from the ORIGINAL rows (usable_rows(op_rows)), not
+        # the re-predicted ones: the registry wants the gap the backend
+        # showed against the un-refit roofline, which is what nominates
+        # a fused kernel
+        op_family_residuals=op_family_residuals(usable_rows(op_rows)))
     REGISTRY.gauge(
         "ff_refit_step_ratio",
         "Measured/predicted step cost after the last refit "

@@ -312,7 +312,13 @@ class MultiHeadAttentionOp(Op):
         the lockstep GenerativeSession path) or a traced (B,) VECTOR of
         per-row positions (continuous batching, serving/sched/continuous.py:
         each slot decodes its own sequence, so slot i writes its K/V at
-        pos[i] and masks to its own length).
+        pos[i] and masks to its own length). The vector form is the
+        continuous batcher's per-iteration hot loop, and a kernel-tier
+        family (`attention_decode`): when the registry selects pallas the
+        QK^T -> masked softmax -> V chain runs as ONE fused kernel over
+        the paged cache (kernels/pallas/decode.py) instead of
+        materializing the (B, h, 1, M) logits/probs in HBM; the einsum
+        chain below is its reference/parity oracle.
 
         The scalar form doubles as the CHUNK-OFFSET PREFILL entry: with
         C > 1 query tokens at offset `pos`, the chunk's K/V rows are
@@ -326,22 +332,36 @@ class MultiHeadAttentionOp(Op):
         pos = ctx.decode_pos
         kc = ctx.state[(self.name, "k_cache")]
         vc = ctx.state[(self.name, "v_cache")]
-        if getattr(pos, "ndim", 0) == 1:
+        vector = getattr(pos, "ndim", 0) == 1
+        if vector:
             rows = jnp.arange(kc.shape[0])
             kc = kc.at[rows, pos].set(k[:, 0].astype(kc.dtype))
             vc = vc.at[rows, pos].set(v[:, 0].astype(vc.dtype))
-            mask = jnp.arange(kc.shape[1])[None, :] <= pos[:, None]  # (B, M)
-            mask = mask[:, None, None, :]
         else:
             kc = jax.lax.dynamic_update_slice(
                 kc, k.astype(kc.dtype), (0, pos, 0, 0))
             vc = jax.lax.dynamic_update_slice(
                 vc, v.astype(vc.dtype), (0, pos, 0, 0))
+        ctx.state_updates[(self.name, "k_cache")] = kc
+        ctx.state_updates[(self.name, "v_cache")] = vc
+
+        if vector:
+            from ..kernels.registry import KERNELS
+
+            if KERNELS.select("attention_decode", config=ctx.config):
+                from ..kernels.pallas.decode import fused_decode_attention
+
+                ctxv = fused_decode_attention(
+                    q, kc, vc, pos, scale=scale,
+                    block_k=getattr(ctx.config, "flash_block_k", 512),
+                    interpret=jax.default_backend() != "tpu")
+                return self._decode_project(ctxv, q.dtype, weights)
+            mask = jnp.arange(kc.shape[1])[None, :] <= pos[:, None]  # (B, M)
+            mask = mask[:, None, None, :]
+        else:
             qpos = pos + jnp.arange(q.shape[1])  # (C,) absolute positions
             mask = (jnp.arange(kc.shape[1])[None, :]
                     <= qpos[:, None])[None, None, :, :]  # (1, 1, C, M)
-        ctx.state_updates[(self.name, "k_cache")] = kc
-        ctx.state_updates[(self.name, "v_cache")] = vc
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, kc.astype(q.dtype),
             preferred_element_type=jnp.float32,
@@ -350,35 +370,46 @@ class MultiHeadAttentionOp(Op):
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
                           vc.astype(q.dtype))
-        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(q.dtype),
-                         weights["wo"].astype(q.dtype))
+        return self._decode_project(ctxv, q.dtype, weights)
+
+    def _decode_project(self, ctxv, cdt, weights):
+        """Output projection shared by the fused and reference decode
+        paths."""
+        out = jnp.einsum("bqhd,hde->bqe", ctxv.astype(cdt),
+                         weights["wo"].astype(cdt))
         out = out.astype(self.outputs[0].dtype.jnp_dtype)
         if "bo" in weights:
             out = out + weights["bo"]
         return out
 
     def _use_flash(self, ctx) -> bool:
-        """Auto policy, measured on v5e. Since the kernel's bf16-MXU-input
-        fix (round 3) the Pallas flash path wins from seq ~512 up (r4
-        ablation: 39.1 ms/step flash vs 44.0 einsum at the BERT bench
-        config, where the per-chip f32 score matrix is 134 MB); below that
-        the blocks are too small to fill the grid and XLA's fused einsum
-        attention stays ahead. The threshold is the score-matrix size at
-        the measured crossover. Explicit use_flash=True/False overrides
-        (tests force True with interpret-mode Pallas on CPU)."""
-        setting = self.params.get("use_flash")
-        if setting is not None:
-            return bool(setting)
-        if jax.default_backend() != "tpu":
-            return False
-        q, k = self.inputs[0], self.inputs[1]
-        # per-chip pressure: the batch dim is sharded over the data axis
-        dp = 1
-        if ctx is not None and ctx.mesh is not None:
-            dp = dict(getattr(ctx.mesh, "shape", {})).get("data", 1)
-        score_bytes = (4.0 * q.dims[0] * self.params["num_heads"]
-                       * q.dims[1] * k.dims[1]) / max(dp, 1)
-        return score_bytes > 1e8
+        """Flash/pallas vs einsum selection, routed through the ONE
+        KernelRegistry code path: an explicit use_flash=True/False param
+        is the per-op override lane (what the CPU tests use to force the
+        interpret-mode kernel — formerly a special case here), the
+        `--kernel-impl` knob and `KERNELS.override` sit above auto, and
+        the auto policy on TPU is the per-family calibration residual
+        first, then the v5e-measured crossover: since the kernel's
+        bf16-MXU-input fix (round 3) the Pallas flash path wins from seq
+        ~512 up (r4 ablation: 39.1 ms/step flash vs 44.0 einsum at the
+        BERT bench config, where the per-chip f32 score matrix is
+        134 MB); below that the blocks are too small to fill the grid
+        and XLA's fused einsum attention stays ahead. The threshold is
+        the score-matrix size at the measured crossover."""
+        from ..kernels.registry import KERNELS, flash_crossover
+
+        def crossover() -> bool:
+            q, k = self.inputs[0], self.inputs[1]
+            # per-chip pressure: the batch dim shards over the data axis
+            dp = 1
+            if ctx is not None and ctx.mesh is not None:
+                dp = dict(getattr(ctx.mesh, "shape", {})).get("data", 1)
+            return flash_crossover(q.dims[0], self.params["num_heads"],
+                                   q.dims[1], k.dims[1], dp)
+
+        return bool(KERNELS.select(
+            "attention", param=self.params.get("use_flash"),
+            config=getattr(ctx, "config", None), heuristic=crossover))
 
     def flops(self) -> float:
         q, k, v, embed, heads, kdim, vdim = self._dims()

@@ -1,8 +1,14 @@
-"""LayerNorm / Softmax / Dropout.
+"""LayerNorm / RMSNorm / Softmax / Dropout.
 
 Reference: src/ops/layer_norm.cc (custom CUDA kernels), softmax.cc (cuDNN),
 dropout.cc (cuDNN dropout states). Dropout here uses jax PRNG threaded through
 the LoweringContext — functional replacement for cuDNN's stateful RNG.
+
+The norm/softmax ops are kernel-tier families (docs/kernels.md): when the
+KernelRegistry selects `pallas` — trailing-axis normalization only — the
+lowering emits the fused Pallas kernel from kernels/pallas/norm.py (one
+VMEM pass, f32 statistics, custom fwd+bwd); otherwise the unfused jnp
+reference below, which doubles as the parity oracle.
 """
 from __future__ import annotations
 
@@ -14,6 +20,17 @@ import jax.numpy as jnp
 from ..core.op import Op, WeightSpec, register_op
 from ..ffconst import CompMode, OpType
 from ..runtime.initializers import ConstantInitializer, ZeroInitializer
+
+
+def _trailing_axis_only(op: Op, axes) -> bool:
+    """The fused kernels normalize the trailing axis with leading dims
+    flattened; anything else stays on the reference lowering."""
+    nd = len(op.inputs[0].dims)
+    return tuple(axes) == (nd - 1,)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 @register_op
@@ -40,6 +57,15 @@ class LayerNormOp(Op):
         x = inputs[0]
         axes = tuple(self.params["axes"])
         eps = self.params.get("eps", 1e-5)
+        from ..kernels.registry import KERNELS
+
+        if _trailing_axis_only(self, axes) and KERNELS.select(
+                "layernorm", config=ctx.config):
+            from ..kernels.pallas.norm import fused_layernorm
+
+            return [fused_layernorm(x, weights.get("gamma"),
+                                    weights.get("beta"), eps=eps,
+                                    interpret=_interpret())]
         # statistics in f32 even when activations flow bf16; the result is
         # stored back in the activation dtype
         xf = x.astype(jnp.float32)
@@ -57,6 +83,50 @@ class LayerNormOp(Op):
 
 
 @register_op
+class RMSNormOp(Op):
+    """Root-mean-square norm (no mean-centering, no beta) — the
+    LayerNorm variant of LLaMA-family decoders, added with the kernel
+    tier so the serving models it matters for can use the fused path."""
+
+    op_type = OpType.RMSNORM
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def _norm_shape(self):
+        axes = self.params["axes"]
+        return tuple(self.inputs[0].dims[a] for a in axes)
+
+    def weight_specs(self) -> List[WeightSpec]:
+        if not self.params.get("elementwise_affine", True):
+            return []
+        return [WeightSpec("gamma", self._norm_shape(),
+                           self.inputs[0].dtype, ConstantInitializer(1.0))]
+
+    def lower(self, ctx, inputs, weights):
+        x = inputs[0]
+        axes = tuple(self.params["axes"])
+        eps = self.params.get("eps", 1e-6)
+        from ..kernels.registry import KERNELS
+
+        if _trailing_axis_only(self, axes) and KERNELS.select(
+                "rmsnorm", config=ctx.config):
+            from ..kernels.pallas.norm import fused_rmsnorm
+
+            return [fused_rmsnorm(x, weights.get("gamma"), eps=eps,
+                                  interpret=_interpret())]
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(
+            jnp.mean(jnp.square(xf), axis=axes, keepdims=True) + eps)
+        if "gamma" in weights:
+            shape = [1] * x.ndim
+            for a in axes:
+                shape[a] = x.shape[a]
+            y = y * weights["gamma"].astype(jnp.float32).reshape(shape)
+        return [y.astype(x.dtype)]
+
+
+@register_op
 class SoftmaxOp(Op):
     op_type = OpType.SOFTMAX
 
@@ -66,6 +136,13 @@ class SoftmaxOp(Op):
     def lower(self, ctx, inputs, weights):
         axis = self.params.get("axis", -1)
         x = inputs[0]
+        from ..kernels.registry import KERNELS
+
+        if axis in (-1, x.ndim - 1) and KERNELS.select(
+                "softmax", config=ctx.config):
+            from ..kernels.pallas.norm import fused_softmax
+
+            return [fused_softmax(x, interpret=_interpret())]
         # f32 exp/sum even for bf16 activations
         return [jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)]
 

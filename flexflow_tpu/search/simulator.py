@@ -242,7 +242,73 @@ class CostModel:
             shards *= s.sp
         flops = op.flops() / max(1, shards)
         bytes_ = op.bytes_accessed() / max(1, shards)
-        return self.machine.compute_time_us(flops, bytes_, self.op_dtype_bytes(op))
+        t = self.machine.compute_time_us(flops, bytes_,
+                                         self.op_dtype_bytes(op))
+        return t * self.kernel_time_factor(op, s)
+
+    def kernel_time_factor(self, op: Op, s: OpStrategy) -> float:
+        """Fused-kernel tier pricing (docs/kernels.md): ops whose family
+        the KernelRegistry would select pallas for cost PALLAS_COST_GAIN
+        of their roofline estimate, so the Unity search ranks strategies
+        against the kernels the lowering will actually emit. The
+        structural gates mirror the lowerings exactly — a norm/softmax
+        the op would NOT fuse (non-trailing axes) is never discounted.
+        1.0 for reference selections and non-tier ops — on CPU
+        (reference everywhere by default) this is an exact no-op."""
+        from ..kernels.registry import (KERNELS, OPTYPE_FAMILY,
+                                        flash_crossover)
+
+        family = OPTYPE_FAMILY.get(op.op_type)
+        if family is None:
+            return 1.0
+        # memoized per selection-relevant key: the registry resolves the
+        # fitted profile's residuals per call (an os.stat for freshness),
+        # and this sits on the search's per-op-per-strategy hot path.
+        # Assumes selection policy is stable for this CostModel's
+        # lifetime — construct a fresh Simulator after changing the
+        # config knob or entering a KERNELS.override
+        memo = getattr(self, "_kernel_factor_memo", None)
+        if memo is None:
+            memo = self._kernel_factor_memo = {}
+        nd = len(op.inputs[0].dims) if op.inputs else 0
+        if family in ("layernorm", "rmsnorm", "softmax"):
+            if family == "softmax":
+                # ops/norm.py gates: fused only on the trailing axis
+                if op.params.get("axis", -1) not in (-1, nd - 1):
+                    return 1.0
+            elif tuple(op.params.get("axes", ())) != (nd - 1,):
+                return 1.0
+            hit = memo.get(family)
+            if hit is None:
+                hit = memo[family] = KERNELS.cost_factor(
+                    family, config=self.config)
+            return hit
+
+        # the lowering's structural flash gates (ops/attention.py):
+        # attention-prob dropout, kdim != vdim, and the sequence-parallel
+        # ring all keep the einsum core regardless of selection
+        heads = op.params.get("num_heads", 1)
+        kdim = op.params.get("kdim") or op.params.get("embed_dim", 0) // heads
+        vdim = op.params.get("vdim") or op.params.get("embed_dim", 0) // heads
+        if (op.params.get("dropout", 0.0) > 0 or kdim != vdim
+                or (op.params.get("sequence_parallel") and s.sp > 1)):
+            return 1.0
+
+        # the attention lowering's measured score-bytes policy (the
+        # SHARED registry helper) at this STRATEGY's data-parallel
+        # degree (ops/attention.py _use_flash consults the live mesh;
+        # costing has s.dp)
+        q, k = op.inputs[0], op.inputs[1]
+        param = op.params.get("use_flash")
+        key = ("attention", param,
+               flash_crossover(q.dims[0], op.params["num_heads"],
+                               q.dims[1], k.dims[1], s.dp))
+        hit = memo.get(key)
+        if hit is None:
+            hit = memo[key] = KERNELS.cost_factor(
+                "attention", param=param, config=self.config,
+                heuristic=lambda: key[2])
+        return hit
 
     def backward_time_us(self, op: Op, s: OpStrategy) -> float:
         if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):

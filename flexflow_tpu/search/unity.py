@@ -959,20 +959,28 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
             result.predicted_step_us = result.cost_us
             # the native core prices from the chip scalars alone — the
             # fitted latency/step-scale coefficients a profile overlay
-            # sets (obs/refit.py) don't cross the line protocol. When any
-            # is active, re-price the CHOSEN plan with the fully-overlaid
-            # Python simulator so predicted_step_us (what calibration and
-            # the drift detector compare against) reflects the fit; the
-            # native ranking stands (the extra terms are uniform enough
-            # across candidates not to re-rank them)
+            # sets (obs/refit.py) don't cross the line protocol, and
+            # neither does the kernel tier's PALLAS_COST_GAIN pricing
+            # (docs/kernels.md). When either is active, re-price the
+            # CHOSEN plan with the fully-overlaid Python simulator so
+            # predicted_step_us (what calibration and the drift detector
+            # compare against) reflects them; the native ranking stands
+            # (the extra terms are uniform enough across candidates not
+            # to re-rank them)
+            sim = Simulator(machine, config)
+            tier_active = any(
+                sim.cost.kernel_time_factor(
+                    op, result.strategies.get(op.guid, OpStrategy())) != 1.0
+                for op in graph.ops.values())
             if (getattr(machine, "step_time_scale", 1.0) != 1.0
                     or getattr(machine, "dispatch_overhead_us", 1.0) != 1.0
                     or getattr(machine, "collective_latency_us", 1.0)
-                    != 1.0):
-                repriced = Simulator(machine, config).simulate(
-                    graph, result.strategies)
+                    != 1.0
+                    or tier_active):
+                repriced = sim.simulate(graph, result.strategies)
                 result.log.append(
-                    f"fitted-profile reprice: native {result.cost_us:.1f}"
+                    f"{'kernel-tier' if tier_active else 'fitted-profile'}"
+                    f" reprice: native {result.cost_us:.1f}"
                     f"us -> {repriced:.1f}us predicted")
                 result.predicted_step_us = repriced
             return result
