@@ -11,7 +11,13 @@ checked-in strategies (.github/workflows/tests.yml `analyze` job).
 
 Flags: --model NAME (zoo model, default mnist_mlp), --strategy FILE,
 --json (machine-readable report), plus every standard FFConfig flag
-(--chips N sizes the analyzed device pool/machine model).
+(--chips N sizes the analyzed device pool/machine model;
+--machine-spec FILE loads a machine spec — a hierarchical
+chip->ICI->pod->DCN one when the JSON carries a "tiers" list, which
+arms the FFTA07x cross-tier legality pass; docs/machine.md):
+
+    python -m flexflow_tpu analyze --model mnist_mlp --chips 16 \
+        --machine-spec examples/machines/multipod_2x8.json
 """
 from __future__ import annotations
 
@@ -61,17 +67,29 @@ def run_analyze(argv: Optional[List[str]] = None) -> int:
     graph = Graph(model.ops)
 
     strategies = None
+    reductions = None
     if strategy_path is not None:
         # the one shared preamble compile()'s --import path uses, so the
-        # CLI's verdict matches what compile() will actually do
+        # CLI's verdict matches what compile() will actually do (the file
+        # is read ONCE here and the parsed spec threaded through)
+        import json as _json
+
         from ..search.unity import rewrite_and_import_strategy
 
+        with open(strategy_path) as f:
+            spec = _json.load(f)
         try:
             strategies, axes = rewrite_and_import_strategy(
-                graph, config, strategy_path)
+                graph, config, strategy_path, spec=spec)
         except PlanAnalysisError as exc:
             print(exc.report.to_json() if as_json else exc.report.format())
             return 1
+        # a tiered search exports its per-tier reduction decomposition
+        # ("reductions", docs/machine.md): analyze the plan as pinned.
+        # Files without it are analyzed the way compile() treats them —
+        # the machine re-synthesizes (reductions=None), so a flat-model
+        # export is not spuriously rejected on a hierarchical spec.
+        reductions = spec.get("reductions")
     else:
         axes = {"data": n_dev} if n_dev > 1 else {}
 
@@ -80,6 +98,7 @@ def run_analyze(argv: Optional[List[str]] = None) -> int:
         graph, strategies=strategies,
         machine=make_machine_model(config, n_dev), config=config,
         batch_size=config.batch_size, n_devices=n_dev, mesh_axes=axes,
+        reduction_strategies=reductions,
         final_guid=final.guid if final is not None else None)
     record_report(report)
     print(report.to_json() if as_json else report.format())

@@ -75,6 +75,14 @@ CODE_CATALOG: Dict[str, tuple] = {
                 "redistribution peak scratch above 85% of per-chip HBM"),
     "FFTA063": (Severity.ERROR,
                 "live shards unrecoverable from the surviving devices"),
+    # -- cross-tier collective legality (FFTA07x, hierarchical machines,
+    # docs/machine.md) --
+    "FFTA070": (Severity.ERROR,
+                "collective spans a tier boundary without a"
+                " tier-decomposable reduction strategy"),
+    "FFTA071": (Severity.WARNING,
+                "per-step collective pushes heavy traffic across the"
+                " outermost (DCN) tier"),
 }
 
 

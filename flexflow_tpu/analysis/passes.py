@@ -50,6 +50,13 @@ class AnalysisContext:
     batch_size: Optional[int] = None
     n_devices: Optional[int] = None
     final_guid: Optional[int] = None
+    # per-tier reduction decomposition the plan carries (SearchResult
+    # .reduction_strategies / FFModel._reduction_plan) for the FFTA07x
+    # pass. None = the plan does not pin one yet and compile() will
+    # synthesize it (checked against the machine's own choice); a dict
+    # missing an op means that op's sync is UN-decomposed (flat) — what a
+    # plan searched under a flat machine model carries.
+    reduction_strategies: Optional[Dict[str, dict]] = None
 
     def strategy_of(self, op):
         if not self.strategies:
@@ -385,6 +392,109 @@ def pass_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
                     " by re-partition", op,
                     hint="keep the chain at one dp degree"))
                 break
+    return diags
+
+
+# ---------------------------------------------------------------------
+# pass 6 (FFTA07x): cross-tier collective legality
+# ---------------------------------------------------------------------
+# per-step collectives pushing more than this across the OUTERMOST tier
+# (the DCN on a multi-pod spec) draw an FFTA071 warning — at DCN-class
+# bandwidth (a few GB/s) 64 MB is already ~20 ms of per-step exposure
+DCN_STEP_BYTES_WARN = 64e6
+
+
+def pass_tier_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
+    """Hierarchical-machine legality (docs/machine.md):
+
+     - FFTA070 (error): a synced tensor whose reduction group spans a
+       tier boundary is pinned to a NON-tier-decomposable (flat)
+       strategy — a flat ring across the DCN serializes every step on
+       the slowest link; the plan must carry rs_ar_ag or hier_ring
+       there. Plans that carry no decomposition yet (ctx
+       .reduction_strategies is None) are checked against the machine's
+       own synthesized choice, which is always decomposable.
+     - FFTA071 (warning): a per-step collective (gradient sync or a
+       tensor-parallel activation collective) pushes more than
+       DCN_STEP_BYTES_WARN across the outermost tier — legal, but the
+       cross-DCN traffic will dominate the step.
+
+    No-ops on flat machine models."""
+    machine = ctx.machine
+    if machine is None or not hasattr(machine, "tier_path"):
+        return []
+    from ..search.simulator import (AP_CAPABLE, CostModel, OpStrategy,
+                                    TP_CAPABLE)
+
+    diags: List[Diagnostic] = []
+    strategies = ctx.strategies or {}
+    reds = ctx.reduction_strategies
+    cost = CostModel(machine, ctx.config)
+    # axis strides come from the realized mesh, exactly as the simulator
+    # prices them (an op replicated over the model axis still has its dp
+    # groups strided across it)
+    cost.set_mesh_context(strategies)
+    default = OpStrategy()
+    outer_name = machine.tiers[-1].name
+    for op in ctx.graph.ops.values():
+        s = strategies.get(op.guid) or default
+        # gradient sync over the dp (x ap) group
+        sync = s.dp * (s.ap if op.op_type in AP_CAPABLE else 1)
+        if sync > 1 and op.weights:
+            inner = cost._sync_inner(op, s)
+            path = machine.tier_path(sync, inner)
+            wb = cost._grad_sync_bytes(op, s)
+            if machine.crosses_tier_boundary(sync, inner):
+                if len(path) > 1:
+                    # a multi-tier path can (and must) decompose
+                    if reds is None:
+                        strat, _, _ = machine.reduction_choice(
+                            wb, sync, inner=inner)
+                    else:
+                        strat = (reds.get(op.name) or {}).get("strategy",
+                                                              "flat")
+                    boundary = "->".join(t.name for t, _ in path)
+                    if strat == "flat":
+                        diags.append(make_diag(
+                            "FFTA070",
+                            f"gradient sync (degree {sync}, "
+                            f"{wb / 1e6:.2f} MB) spans tier boundary"
+                            f" {boundary} with a flat all-reduce", op,
+                            hint="use a tier-decomposable reduction"
+                                 " (rs_ar_ag/hier_ring); re-search under"
+                                 " the hierarchical machine spec"))
+                else:
+                    # the whole group lives ON an outer tier (one member
+                    # per pod): flat is the only — and legal — shape,
+                    # but its traffic still rides the slow tier
+                    strat = "flat"
+                dcn = machine.dcn_step_bytes(wb, sync, inner=inner,
+                                             strategy=strat)
+                if dcn > DCN_STEP_BYTES_WARN:
+                    diags.append(make_diag(
+                        "FFTA071",
+                        f"gradient sync pushes {dcn / 1e6:.1f} MB/step"
+                        f" across the {outer_name!r} tier"
+                        f" (strategy {strat})", op,
+                        hint="shard the weight (tp/ep) or accumulate"
+                             " gradients over more steps"))
+        # tensor-parallel activation collectives cannot decompose — a
+        # model axis that escapes the innermost tiers is per-layer
+        # latency on the slowest link, worth a warning on its own
+        if s.tp > 1 and op.op_type in TP_CAPABLE and op.outputs:
+            tp_inner = cost._axis_inner(s, "tp")
+            if machine.crosses_tier_boundary(s.tp, tp_inner):
+                out = op.outputs[0]
+                act = (out.num_elements() * cost.op_dtype_bytes(op)
+                       / max(1, s.dp))
+                if act > DCN_STEP_BYTES_WARN:
+                    diags.append(make_diag(
+                        "FFTA071",
+                        f"tp={s.tp} activation collective"
+                        f" ({act / 1e6:.1f} MB) crosses a tier boundary"
+                        " every layer", op,
+                        hint="keep the model axis inside one"
+                             " pod/ICI domain"))
     return diags
 
 

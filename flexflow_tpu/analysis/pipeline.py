@@ -21,7 +21,7 @@ from ..core.graph import Graph
 from .diagnostics import DiagnosticReport, PlanAnalysisError, record_report
 from .passes import (AnalysisContext, default_strategies_for,
                      pass_collectives, pass_divisibility, pass_donation,
-                     pass_hygiene, pass_memory_fit)
+                     pass_hygiene, pass_memory_fit, pass_tier_collectives)
 
 _log = logging.getLogger("flexflow_tpu.analysis")
 
@@ -29,6 +29,7 @@ PASS_REGISTRY = {
     "divisibility": pass_divisibility,
     "memory": pass_memory_fit,
     "collectives": pass_collectives,
+    "tiers": pass_tier_collectives,
     "donation": pass_donation,
     "hygiene": pass_hygiene,
 }
@@ -46,6 +47,7 @@ def analyze_plan(graph: Graph,
                  n_devices: Optional[int] = None,
                  mesh_axes: Optional[Dict[str, int]] = None,
                  final_guid: Optional[int] = None,
+                 reduction_strategies: Optional[Dict[str, dict]] = None,
                  passes: Optional[Sequence[str]] = None) -> DiagnosticReport:
     """Run the pass pipeline; returns the DiagnosticReport (never raises).
 
@@ -57,7 +59,8 @@ def analyze_plan(graph: Graph,
     ctx = AnalysisContext(graph=graph, strategies=strategies,
                           mesh_axes=mesh_axes, machine=machine,
                           config=config, batch_size=batch_size,
-                          n_devices=n_devices, final_guid=final_guid)
+                          n_devices=n_devices, final_guid=final_guid,
+                          reduction_strategies=reduction_strategies)
     names = list(passes) if passes is not None else list(ALL_PASSES)
     report = DiagnosticReport(passes_run=names)
     for name in names:

@@ -67,6 +67,13 @@ class FFConfig:
     # "attention=pallas,layernorm=reference,...". ONE knob for what used
     # to be the ad-hoc use_flash heuristic plus per-callsite flags.
     kernel_impl: str = "auto"
+    # Calibration-residual threshold for auto kernel selection
+    # (kernels/registry.py, docs/kernels.md): an op family whose measured
+    # cost runs >= this multiple of the roofline prediction is a fusion
+    # candidate. 1.10 is the hand-set default the registry shipped with;
+    # fit it from before/after kernel measurements on real TPU
+    # (--kernel-residual-threshold).
+    kernel_residual_threshold: float = 1.10
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
@@ -214,6 +221,13 @@ class FFConfig:
 
                 KernelRegistry.parse_spec(v)  # validate; raises on junk
                 self.kernel_impl = v
+            elif a == "--kernel-residual-threshold":
+                v = float(take())
+                if not v > 0:
+                    raise ValueError(
+                        "--kernel-residual-threshold must be > 0 "
+                        f"(a measured/predicted ratio), got {v}")
+                self.kernel_residual_threshold = v
             elif a in ("--lr", "--learning-rate"):
                 self.learning_rate = float(take())
             elif a in ("--wd", "--weight-decay"):
@@ -293,7 +307,10 @@ class FFConfig:
                 self.num_devices = int(take())
             elif a == "--machine-model-version":
                 self.machine_model_version = int(take())
-            elif a == "--machine-model-file":
+            elif a in ("--machine-model-file", "--machine-spec"):
+                # --machine-spec: the hierarchical-machine-friendly alias
+                # (docs/machine.md) — one flag loads either format, the
+                # factory dispatches on the spec's "tiers" key
                 self.machine_model_file = take()
             elif a == "--fitted-profile":
                 self.fitted_profile_file = take()

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import random
 import tempfile
@@ -70,6 +71,8 @@ from .faults import FaultInjector, FaultPlan, TopologyLoss
 from .retry import RetryPolicy
 from .watchdog import OK, ROLLBACK, SKIP, TrainingWatchdog
 
+_log = logging.getLogger("flexflow_tpu.elastic")
+
 
 def ring_topology_spec(num_chips: int, gbps: float = 45.0) -> Dict:
     """Default ICI topology spec when the config names no machine-model
@@ -85,7 +88,39 @@ def shrink_topology_spec(spec: Dict, lost_positions: Sequence[int]) -> Dict:
     with both endpoints alive. A loss can leave the survivor set with few
     or NO intact links (e.g. both ring neighbors of a survivor died) —
     NetworkedMachineModel.from_json handles the empty-links case by
-    falling back to its default ring at the default 45 GB/s."""
+    falling back to its default ring at the default 45 GB/s.
+
+    Hierarchical ("tiers") specs — docs/machine.md — shrink too: losing
+    whole outermost-tier groups (a pod dropping off the DCN, the
+    realistic multi-pod failure) keeps the hierarchy with a smaller
+    outer degree, so recovery re-plans stay tier-aware. A PARTIAL-group
+    loss breaks tier uniformity, which this spec format cannot express:
+    the survivors degrade to a flat ring at the innermost tier's
+    bandwidth — logged loudly, because tier pricing and the FFTA07x
+    gate disarm until a full restart re-reads the original spec."""
+    if spec.get("tiers"):
+        tiers = [dict(t) for t in spec["tiers"]]
+        inner = 1
+        for t in tiers[:-1]:
+            inner *= int(t["degree"])
+        outer = int(tiers[-1]["degree"])
+        lost = set(lost_positions)
+        lost_groups = {p // inner for p in lost}
+        if all(g * inner + i in lost
+               for g in lost_groups for i in range(inner)):
+            tiers[-1]["degree"] = max(1, outer - len(lost_groups))
+            out = dict(spec)
+            out["tiers"] = tiers
+            out["num_chips"] = inner * tiers[-1]["degree"]
+            return out
+        survivors = inner * outer - len(lost)
+        _log.warning(
+            "chip loss %s is not whole outermost-tier groups: the %d "
+            "survivors degrade to a FLAT ring spec (tier-aware pricing "
+            "and the FFTA07x gate disarm until restart)",
+            sorted(lost), survivors)
+        return ring_topology_spec(survivors,
+                                  gbps=float(tiers[0].get("gbps", 45.0)))
     lost = set(lost_positions)
     n = spec["num_chips"]
     survivors = [i for i in range(n) if i not in lost]
@@ -192,10 +227,11 @@ class ElasticCoordinator:
                 self._topo_spec = json.load(f)
             if "num_chips" not in self._topo_spec:
                 # from_json permits specs without num_chips; shrink needs
-                # it, so normalize with the same inference rule
-                links = self._topo_spec.get("links") or []
-                self._topo_spec["num_chips"] = max(
-                    (max(i, j) for i, j, _ in links), default=0) + 1
+                # it, so normalize with the shared per-format rule
+                from ..search.machine_model import spec_num_chips
+
+                self._topo_spec["num_chips"] = spec_num_chips(
+                    self._topo_spec)
         else:
             self._topo_spec = ring_topology_spec(len(self.device_ids))
         self._base_config = config

@@ -107,7 +107,12 @@ PALLAS_COST_GAIN = {
 
 # a family whose calibration residual (measured/predicted, median over
 # its ops) reaches this is a fusion candidate: the backend is leaving
-# that much of the roofline on the table
+# that much of the roofline on the table. This is only the DEFAULT of
+# the `--kernel-residual-threshold` config knob
+# (FFConfig.kernel_residual_threshold, docs/kernels.md) — selection
+# reads the knob of the config in hand (or the last configure()d one),
+# so the threshold can be fit from real before/after kernel
+# measurements instead of staying hand-set.
 RESIDUAL_CANDIDATE_THRESHOLD = 1.10
 
 
@@ -129,6 +134,7 @@ class KernelRegistry:
         self._config_overrides: Dict[str, str] = {}
         self._overrides: Dict[str, str] = {}
         self._residuals: Dict[str, float] = {}
+        self._threshold: float = RESIDUAL_CANDIDATE_THRESHOLD
         self.residual_source: Optional[str] = None
         # per-call config resolution caches: spec string -> overrides,
         # (profile path, mtime, size) -> residuals
@@ -206,6 +212,9 @@ class KernelRegistry:
         loss/metrics reductions) read this default."""
         self._config_overrides = self._spec_overrides(
             getattr(config, "kernel_impl", "auto"))
+        self._threshold = float(
+            getattr(config, "kernel_residual_threshold",
+                    RESIDUAL_CANDIDATE_THRESHOLD))
         path = getattr(config, "fitted_profile_file", None)
         self._residuals = self._profile_residuals(path)
         self.residual_source = path if self._residuals else None
@@ -273,13 +282,16 @@ class KernelRegistry:
                 residuals = (self._profile_residuals(
                     getattr(config, "fitted_profile_file", None))
                     if config is not None else self._residuals)
+                threshold = (float(getattr(
+                    config, "kernel_residual_threshold", self._threshold))
+                    if config is not None else self._threshold)
                 r = residuals.get(RESIDUAL_ALIAS.get(family, family))
                 # a family with a measured size policy (attention's
                 # crossover) keeps it as a GATE even under residual
                 # evidence: the residual says the family underperforms
                 # at the profiled shape, the heuristic says whether THIS
                 # instance is in the regime where the fused kernel wins
-                if (r is not None and r >= RESIDUAL_CANDIDATE_THRESHOLD
+                if (r is not None and r >= threshold
                         and (heuristic is None or heuristic())):
                     choice = KernelChoice(family, "pallas", "residual")
                 elif heuristic is not None:

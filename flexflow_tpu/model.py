@@ -686,6 +686,31 @@ class FFModel:
         self.parallel_axes = dict(parallel_axes)
         self._assign_strategy(self.parallel_axes)
 
+        # hierarchical machines (docs/machine.md): synthesize the per-tier
+        # reduction decomposition for every synced tensor of the CHOSEN
+        # plan — searched, imported, or the mesh-wide default alike — so
+        # the FFTA07x gate below, the executor, and any exported artifact
+        # all see the one decomposition the simulator priced
+        self._reduction_plan = None
+        if (self.search_result is not None
+                and self.search_result.reduction_strategies):
+            # the Unity search already synthesized the plan for these
+            # exact strategies — reuse it rather than re-pricing
+            self._reduction_plan = self.search_result.reduction_strategies
+        elif n_dev > 1:
+            from .search.machine_model import make_machine_model as _mk
+
+            _machine = _mk(self.config, n_dev)
+            if hasattr(_machine, "tier_path"):
+                from .analysis.passes import default_strategies_for
+                from .search.simulator import CostModel
+
+                _strats = self._op_strategies or default_strategies_for(
+                    self.graph, self.parallel_axes, self.config.batch_size)
+                self._reduction_plan = CostModel(
+                    _machine, self.config).reduction_plan(self.graph,
+                                                          _strats)
+
         # pre-flight plan sanitizer (analysis/): statically prove the chosen
         # plan legal before any XLA trace sees it — errors reject the plan,
         # warnings go to the analysis event log (profiling.print_event_log)
@@ -703,7 +728,8 @@ class FFModel:
         self.mesh = (make_mesh(self.parallel_axes, mesh_devices)
                      if self.parallel_axes else None)
 
-        self.executor = Executor(self.graph, self.config, self.mesh)
+        self.executor = Executor(self.graph, self.config, self.mesh,
+                                 reduction_plan=self._reduction_plan)
         import jax
 
         self.params, self.state = self.executor.init_params(
@@ -836,6 +862,7 @@ class FFModel:
             n_devices=n_dev,
             mesh_axes=getattr(self, "parallel_axes", None),
             final_guid=final_guid,
+            reduction_strategies=getattr(self, "_reduction_plan", None),
             passes=passes,
         )
 

@@ -84,6 +84,13 @@ class FittedCoefficients:
     # per-link bandwidth multiplier (ici_link_gbps / NetworkedMachineModel
     # link_gbps)
     link_bw_scale: float = 1.0
+    # per-TIER bandwidth multipliers for hierarchical machine specs,
+    # keyed by tier name ("ici", "dcn", ... — docs/machine.md). A tier
+    # named here overrides link_bw_scale for that tier; unnamed tiers
+    # (and every flat machine model) keep the single-scale path, so old
+    # profiles — which lack this field — still load and apply.
+    tier_link_scales: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
     # per-op dispatch/launch latency and per-collective base latency (us)
     dispatch_latency_us: float = 1.0
     collective_latency_us: float = 1.0
@@ -101,6 +108,9 @@ class FittedCoefficients:
                 setattr(out, f.name, d[f.name])
         out.compute_scale = {k: float(v)
                              for k, v in dict(out.compute_scale).items()}
+        out.tier_link_scales = {str(k): float(v)
+                                for k, v in dict(out.tier_link_scales
+                                                 ).items()}
         return out
 
 
@@ -318,11 +328,15 @@ def fit_compute_coefficients(rows, prior: FittedCoefficients,
 
 
 def _simulate_step_us(model, coeffs: FittedCoefficients,
-                      comm_free: bool = False) -> float:
+                      comm_free: bool = False,
+                      free_tier: Optional[str] = None) -> float:
     """The plan's predicted step cost under a coefficient overlay —
     `comm_free=True` re-prices with (near-)infinite link bandwidth and
     zero collective latency, isolating the communication share of the
-    prediction for the bandwidth fit."""
+    prediction for the bandwidth fit. `free_tier` frees ONE tier of a
+    hierarchical machine instead (its comm share = total - this), which
+    is how the per-tier bandwidth fit attributes the step-level residual
+    to the tiers that actually carry traffic."""
     from ..search.machine_model import make_machine_model
     from ..search.simulator import Simulator
 
@@ -332,13 +346,49 @@ def _simulate_step_us(model, coeffs: FittedCoefficients,
         dataclasses.replace(cfg, fitted_profile_file=None), n_dev)
     applied = coeffs
     if comm_free:
+        tier_free = {name: scale * 1e9
+                     for name, scale in _effective_tier_scales(
+                         machine, coeffs).items()}
         applied = dataclasses.replace(
             coeffs, compute_scale=dict(coeffs.compute_scale),
             link_bw_scale=coeffs.link_bw_scale * 1e9,
+            tier_link_scales=tier_free,
             collective_latency_us=0.0)
+        # a tier's EXPLICIT latency_us bypasses the fitted
+        # collective_latency_us (machine_model.tier_latency); zero those
+        # too, or latency-dominated DCN syncs would be misread as compute
+        tiers = getattr(machine, "tiers", None)
+        if tiers:
+            machine.tiers = [dataclasses.replace(t, latency_us=0.0)
+                             for t in tiers]
+    elif free_tier is not None:
+        scales = _effective_tier_scales(machine, coeffs)
+        scales[free_tier] = scales.get(free_tier,
+                                       coeffs.link_bw_scale) * 1e9
+        applied = dataclasses.replace(
+            coeffs, compute_scale=dict(coeffs.compute_scale),
+            tier_link_scales=scales)
+        # zero the freed tier's EXPLICIT latency too (mirroring the
+        # comm_free branch): a latency-dominated DCN tier must still
+        # show its comm share when freed, or it is never attributed
+        machine.tiers = [dataclasses.replace(t, latency_us=0.0)
+                         if t.name == free_tier else t
+                         for t in machine.tiers]
     machine.apply_overlay(applied)
     sim = Simulator(machine, cfg)
     return float(sim.simulate(model.graph, model._op_strategies or {}))
+
+
+def _effective_tier_scales(machine, coeffs: FittedCoefficients
+                           ) -> Dict[str, float]:
+    """The per-tier scales an overlay of `coeffs` would apply to
+    `machine` — named tiers from tier_link_scales, the rest falling back
+    to the global link_bw_scale. {} for flat machines."""
+    tiers = getattr(machine, "tiers", None)
+    if not tiers:
+        return {}
+    return {t.name: float(coeffs.tier_link_scales.get(
+        t.name, coeffs.link_bw_scale)) for t in tiers}
 
 
 def _predict_op_rows(model, coeffs: FittedCoefficients, rows) -> List:
@@ -421,6 +471,14 @@ def refit(model, measured_step_us: float, op_rows,
         coeffs, compute_scale=dict(coeffs.compute_scale))
     rows = usable_rows(op_rows)
     history: List[RefitRound] = []
+    # tier names are invariant across rounds: resolve them once instead
+    # of rebuilding the machine model (a spec-file read) per round
+    from ..search.machine_model import make_machine_model
+
+    tier_names = [t.name for t in getattr(
+        make_machine_model(
+            dataclasses.replace(model.config, fitted_profile_file=None),
+            max(1, model.config.total_devices)), "tiers", [])]
     with get_tracer().span("refit.fit", rounds=rounds) as sp:
         converged = False
         for rnd in range(1, max(1, rounds) + 1):
@@ -433,8 +491,6 @@ def refit(model, measured_step_us: float, op_rows,
             # 1. compute terms from the op rows (re-predicted under the
             # current coefficients so each round fits fresh residuals)
             if rows:
-                from ..search.machine_model import make_machine_model
-
                 machine = make_machine_model(
                     dataclasses.replace(model.config,
                                         fitted_profile_file=None),
@@ -449,7 +505,26 @@ def refit(model, measured_step_us: float, op_rows,
             if comm_share > 0.02 and measured_step_us > comp_only:
                 k = (measured_step_us - comp_only) / max(
                     total - comp_only, 1e-9)
-                coeffs.link_bw_scale = _clamp(coeffs.link_bw_scale / k)
+                if tier_names:
+                    # hierarchical machine: fit PER-TIER scales, keyed by
+                    # tier name — the correction lands only on tiers that
+                    # carry an attributable share of the step's comm
+                    # (freeing a tier the plan never crosses changes
+                    # nothing, so its share is 0 and its prior survives)
+                    scales = dict(coeffs.tier_link_scales)
+                    for name in tier_names:
+                        t_free = _simulate_step_us(model, coeffs,
+                                                   free_tier=name)
+                        share_t = max(0.0, total - t_free) / max(total,
+                                                                 1e-9)
+                        if share_t > 0.02:
+                            prior_t = scales.get(name,
+                                                 coeffs.link_bw_scale)
+                            scales[name] = _clamp(prior_t / k)
+                    coeffs.tier_link_scales = scales
+                else:
+                    # flat machine spec: the single-scale path, unchanged
+                    coeffs.link_bw_scale = _clamp(coeffs.link_bw_scale / k)
             # 3. whatever residual remains is whole-step systematic bias
             predicted = _simulate_step_us(model, coeffs)
             if predicted > 0:
@@ -462,8 +537,6 @@ def refit(model, measured_step_us: float, op_rows,
             history.append(RefitRound(len(history) + 1, final,
                                       measured_step_us))
         sp.set(rounds_run=len(history), final_ratio=history[-1].ratio)
-
-    from ..search.machine_model import make_machine_model
 
     machine = make_machine_model(
         dataclasses.replace(model.config, fitted_profile_file=None),

@@ -8,6 +8,13 @@ iterations it displaces. Pricing reuses the SAME MachineModel collective
 formulas the Unity search costs plans with (allgather_time_us /
 p2p_time_us), so a resize is priced in the same currency as the plans it
 moves between.
+
+On a hierarchical machine (machine_model.HierarchicalMachineModel,
+docs/machine.md) the same calls decompose over the tier path the step's
+participant group spans — an allgather that crosses the DCN tier is
+priced at DCN bandwidth, not like a neighbor hop — so redistribution
+schedules stay communication-minimal across tiers (arXiv:2112.01075)
+without this module knowing about tiers at all.
 """
 from __future__ import annotations
 

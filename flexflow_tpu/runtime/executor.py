@@ -26,7 +26,8 @@ from .metrics import Metrics
 
 
 class Executor:
-    def __init__(self, graph: Graph, config, mesh=None):
+    def __init__(self, graph: Graph, config, mesh=None,
+                 reduction_plan=None):
         self.graph = graph
         self.config = config
         self.mesh = mesh
@@ -35,6 +36,16 @@ class Executor:
         self._multi_step = None
         self._eval_step = None
         self._forward_jit = None
+        # per-tier reduction decomposition of each synced tensor on a
+        # hierarchical machine ({op name: {strategy, tiers, ...}},
+        # docs/machine.md) — compile() threads the SAME plan the search
+        # priced and the FFTA07x gate proved, so the lowering surface and
+        # the cost model can never disagree about how a cross-pod sync
+        # decomposes. On the GSPMD path XLA realizes the gradient psum;
+        # this records the decomposition it is expected (and priced) to
+        # use, and is what a DCN-aware lowering keys its reduce-scatter /
+        # donut all-reduce grouping off.
+        self.reduction_plan = reduction_plan or {}
         # elastic runtime: wraps jitted TRAIN-step dispatch with fault
         # injection + failure detection + retry (elastic/detector.py).
         # Train steps only — eval/forward dispatches are side-effect-free

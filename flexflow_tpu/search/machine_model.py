@@ -9,13 +9,20 @@ TPU-native re-design: the units are chips connected by ICI links in a 2D/3D
 torus (v4/v5p: 3D, v5e: 2D 4x4 per pod-slice), pods connected by DCN.
 Collective costs use the standard ring/torus formulas instead of per-hop
 routing: that's what XLA's collectives actually do on ICI.
+
+Beyond the flat models, `HierarchicalMachineModel` (docs/machine.md) makes
+the spec a chip -> host/ICI -> pod -> DCN tier hierarchy: collectives
+decompose over the tiers a device group actually spans, and reductions can
+be priced per strategy ({flat, rs_ar_ag, hier_ring}) so the Unity search
+synthesizes per-tier reduction schedules jointly with placement
+(arXiv:2110.10548).
 """
 from __future__ import annotations
 
 import dataclasses
 import json
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -379,6 +386,421 @@ class NetworkedMachineModel(MachineModel):
         return min(self._min_degree(), 2) * self.link_gbps * 1e9
 
 
+@dataclasses.dataclass(frozen=True)
+class TierSpec:
+    """One level of a hierarchical interconnect, innermost first.
+
+    `degree` is the fan-out at this tier (chips per host-ICI group, pods
+    per DCN domain, ...); `bw_gbps` the per-direction per-link bandwidth;
+    `links` the parallel usable links of one group's ring (bidirectional
+    ICI ring = 2, a single host NIC = 1); `latency_us` the per-collective
+    base latency at this tier (None = the model's fit-able
+    `collective_latency_us`, which keeps one-tier hierarchies bit-for-bit
+    identical to the flat models and lets a fitted profile overlay it)."""
+
+    name: str
+    degree: int
+    bw_gbps: float
+    links: int = 2
+    latency_us: Optional[float] = None
+
+
+# per-tier reduction strategies the Unity search synthesizes for synced
+# tensors (arXiv:2110.10548: placement + reduction strategy are chosen
+# jointly on hierarchical systems):
+#  - flat:      one ring over every participant, bottlenecked by the
+#               slowest tier crossed — the only choice inside ONE tier,
+#               and what a flat machine model implicitly prices;
+#  - rs_ar_ag:  reduce-scatter within each inner tier, all-reduce at the
+#               outermost tier on the 1/prod(inner) shard, all-gather back
+#               out — minimal slow-tier traffic, one phase per tier;
+#  - hier_ring: a full-bytes ring per tier — more outer-tier traffic than
+#               rs_ar_ag but fewer phases, wins for small tensors where
+#               per-phase latency dominates.
+# A degree spanning a tier boundary must use a tier-decomposable strategy
+# (rs_ar_ag or hier_ring) — the FFTA070 legality rule; "auto" therefore
+# never picks flat across a boundary.
+REDUCTION_FLAT = "flat"
+REDUCTION_RS_AR_AG = "rs_ar_ag"
+REDUCTION_HIER_RING = "hier_ring"
+REDUCTION_STRATEGIES = (REDUCTION_FLAT, REDUCTION_RS_AR_AG,
+                        REDUCTION_HIER_RING)
+
+
+class HierarchicalMachineModel(MachineModel):
+    """Tiered machine spec: chip -> host/ICI -> pod -> DCN, each tier with
+    its own bandwidth, latency, and degree (ROADMAP item 1, following
+    arXiv:2110.10548). Collectives decompose over the tier path a device
+    group actually spans — `tier_path(n, inner)` — so a cross-pod
+    all-reduce no longer prices like a neighbor hop, and the simulator
+    can ask for a specific per-tier reduction strategy
+    (`allreduce_time_us(..., strategy=...)`).
+
+    A ONE-tier hierarchy prices identically to the flat `TpuPodModel`
+    (pinned by tests/test_machine_hierarchy.py): the single-tier formulas
+    below mirror the base-class expressions term for term."""
+
+    def __init__(self, tiers: Sequence[TierSpec],
+                 chip: Optional[ChipSpec] = None):
+        tiers = list(tiers)
+        if not tiers:
+            raise ValueError("HierarchicalMachineModel needs >= 1 tier")
+        n = 1
+        for t in tiers:
+            if t.degree < 1 or t.bw_gbps <= 0 or t.links < 1:
+                raise ValueError(f"bad tier spec {t!r}")
+            n *= t.degree
+        super().__init__(n, chip or CHIP_SPECS["tpu-v5e"])
+        self.tiers = tiers
+        # per-tier bandwidth overlay multipliers (obs/refit.py fits these
+        # keyed by tier name; apply_overlay folds them in)
+        self.tier_scales: Dict[str, float] = {t.name: 1.0 for t in tiers}
+
+    def version(self) -> int:
+        return 3
+
+    def comm_channels(self) -> bool:
+        return True  # disjoint ring sets per mesh axis, like TpuPodModel
+
+    # -- tier geometry ----------------------------------------------------
+    def tier_bw(self, tier: TierSpec) -> float:
+        """Usable bytes/s of one tier's ring (links x per-link bw x fitted
+        per-tier scale)."""
+        return tier.links * (
+            tier.bw_gbps * self.tier_scales.get(tier.name, 1.0)) * 1e9
+
+    def tier_latency(self, tier: TierSpec) -> float:
+        return (self.collective_latency_us if tier.latency_us is None
+                else float(tier.latency_us))
+
+    def tier_path(self, n: int, inner: int = 1) -> List[Tuple[TierSpec, int]]:
+        """[(tier, participants), ...] inner->outer spanned by a group of
+        `n` devices whose mesh axis nests OUTSIDE `inner` inner devices
+        (mesh axes are row-major: an axis of size n with inner stride
+        `inner` occupies device ids [i*inner, (i+1)*inner) x n). Tiers
+        the group never crosses are omitted; participant counts round up
+        (a non-dividing group conservatively spans the next tier)."""
+        path: List[Tuple[TierSpec, int]] = []
+        cprev = 1
+        span = max(1, inner) * max(1, n)
+        for t in self.tiers:
+            c = cprev * t.degree
+            ni = -(-min(c, span) // max(cprev, inner))  # ceil division
+            if ni > 1:
+                path.append((t, ni))
+            cprev = c
+        return path
+
+    def crosses_tier_boundary(self, n: int, inner: int = 1) -> bool:
+        """True when the group's traffic leaves the innermost tier —
+        either the path spans several tiers, or the group's members are
+        spread so wide (large inner stride) that even a single-tier path
+        rides an outer tier's links."""
+        path = self.tier_path(n, inner)
+        return bool(path) and (len(path) > 1
+                               or path[0][0] is not self.tiers[0])
+
+    def link_bw(self, n_participants: int) -> float:
+        """Bottleneck bandwidth over the tiers an n-group spans (generic
+        base-class consumers; the collective methods below decompose)."""
+        path = self.tier_path(n_participants)
+        if not path:
+            return self.tier_bw(self.tiers[0])
+        return min(self.tier_bw(t) for t, _ in path)
+
+    # -- strategy-priced collectives --------------------------------------
+    def _flat_allreduce(self, bytes_: float, n: int, path) -> float:
+        # one ring over all n participants: the slowest tier's links carry
+        # every step, and the outermost tier's latency applies (base-class
+        # expression order kept so a one-tier path is bit-for-bit
+        # MachineModel.allreduce_time_us)
+        bw = min(self.tier_bw(t) for t, _ in path)
+        lat = self.tier_latency(path[-1][0])
+        return 2.0 * (n - 1) / n * bytes_ / bw * 1e6 + lat
+
+    def _rs_ar_ag(self, bytes_: float, path) -> float:
+        # reduce-scatter up the inner tiers, all-reduce the residual shard
+        # at the outermost tier, all-gather back down
+        t = 0.0
+        shard = bytes_
+        for tier, ni in path[:-1]:
+            t += ((ni - 1) / ni * shard / self.tier_bw(tier) * 1e6
+                  + self.tier_latency(tier))
+            shard /= ni
+        tier, ni = path[-1]
+        t += (2.0 * (ni - 1) / ni * shard / self.tier_bw(tier) * 1e6
+              + self.tier_latency(tier))
+        for tier, ni in reversed(path[:-1]):
+            t += ((ni - 1) * shard / self.tier_bw(tier) * 1e6
+                  + self.tier_latency(tier))
+            shard *= ni
+        return t
+
+    def _hier_ring(self, bytes_: float, path) -> float:
+        # a full-bytes ring per tier (fewer phases than rs_ar_ag; the
+        # outer tiers carry the whole tensor)
+        return sum(
+            2.0 * (ni - 1) / ni * bytes_ / self.tier_bw(tier) * 1e6
+            + self.tier_latency(tier)
+            for tier, ni in path)
+
+    def allreduce_time_us(self, bytes_: float, n: int, inner: int = 1,
+                          strategy: str = "auto") -> float:
+        if n <= 1:
+            return 0.0
+        path = self.tier_path(n, inner)
+        if not path:
+            return 0.0
+        if len(path) == 1:
+            return self._flat_allreduce(bytes_, n, path)
+        if strategy == "auto":
+            # flat excluded across a boundary: FFTA070 legality — every
+            # synthesized cross-tier reduction is tier-decomposable
+            return min(self._rs_ar_ag(bytes_, path),
+                       self._hier_ring(bytes_, path))
+        if strategy == REDUCTION_FLAT:
+            return self._flat_allreduce(bytes_, n, path)
+        if strategy == REDUCTION_RS_AR_AG:
+            return self._rs_ar_ag(bytes_, path)
+        if strategy == REDUCTION_HIER_RING:
+            return self._hier_ring(bytes_, path)
+        raise ValueError(
+            f"unknown reduction strategy {strategy!r}; choices:"
+            f" {REDUCTION_STRATEGIES} or 'auto'")
+
+    def reduction_choice(self, bytes_: float, n: int, inner: int = 1
+                         ) -> Tuple[str, float, List[Dict[str, Any]]]:
+        """(strategy, time_us, tier decomposition) for one synced tensor —
+        what the Unity search records on the plan (SearchResult
+        .reduction_strategies) and the FFTA07x gate checks. Within one
+        tier the only (and legal) choice is flat; across a boundary the
+        cheapest tier-decomposable strategy wins."""
+        path = self.tier_path(n, inner)
+        tiers = [{"tier": t.name, "group": ni} for t, ni in path]
+        if n <= 1 or not path:
+            return REDUCTION_FLAT, 0.0, tiers
+        if len(path) == 1:
+            return (REDUCTION_FLAT,
+                    self._flat_allreduce(bytes_, n, path), tiers)
+        best = min(
+            ((s, self.allreduce_time_us(bytes_, n, inner=inner, strategy=s))
+             for s in (REDUCTION_RS_AR_AG, REDUCTION_HIER_RING)),
+            key=lambda kv: kv[1])
+        return best[0], best[1], tiers
+
+    def allgather_time_us(self, bytes_per_shard: float, n: int,
+                          inner: int = 1) -> float:
+        if n <= 1:
+            return 0.0
+        path = self.tier_path(n, inner)
+        if not path:
+            return 0.0
+        if len(path) == 1:
+            tier, _ = path[0]
+            bw = self.tier_bw(tier)
+            return ((n - 1) * bytes_per_shard / bw * 1e6
+                    + self.tier_latency(tier))
+        # tiered: gather outer-first so the slow tiers move the small
+        # per-shard chunks and the fast inner tiers the grown ones
+        t = 0.0
+        gathered = bytes_per_shard
+        for tier, ni in reversed(path):
+            t += ((ni - 1) * gathered / self.tier_bw(tier) * 1e6
+                  + self.tier_latency(tier))
+            gathered *= ni
+        flat = ((n - 1) * bytes_per_shard
+                / min(self.tier_bw(tr) for tr, _ in path) * 1e6
+                + self.tier_latency(path[-1][0]))
+        return min(t, flat)
+
+    def reduce_scatter_time_us(self, bytes_: float, n: int,
+                               inner: int = 1) -> float:
+        if n <= 1:
+            return 0.0
+        path = self.tier_path(n, inner)
+        if not path:
+            return 0.0
+        if len(path) == 1:
+            tier, _ = path[0]
+            bw = self.tier_bw(tier)
+            return ((n - 1) / n * bytes_ / bw * 1e6
+                    + self.tier_latency(tier))
+        # mirror of the tiered allgather: scatter inner-first so the slow
+        # tiers only carry the already-reduced shard
+        t = 0.0
+        b = bytes_
+        for tier, ni in path:
+            t += ((ni - 1) / ni * b / self.tier_bw(tier) * 1e6
+                  + self.tier_latency(tier))
+            b /= ni
+        flat = ((n - 1) / n * bytes_
+                / min(self.tier_bw(tr) for tr, _ in path) * 1e6
+                + self.tier_latency(path[-1][0]))
+        return min(t, flat)
+
+    def all_to_all_time_us(self, bytes_: float, n: int,
+                           inner: int = 1) -> float:
+        if n <= 1:
+            return 0.0
+        path = self.tier_path(n, inner)
+        if not path:
+            return 0.0
+        if len(path) == 1:
+            tier, _ = path[0]
+            bw = self.tier_bw(tier)
+            return ((n - 1) / n * bytes_ / bw * 1e6
+                    + self.tier_latency(tier))
+        # each chip's traffic splits by destination distance: the share
+        # leaving its tier-i group must cross tier i's links
+        n_eff = 1
+        for _, ni in path:
+            n_eff *= ni
+        t = 0.0
+        cprev = 1
+        for tier, ni in path:
+            frac = (n_eff - cprev) / n_eff
+            t += bytes_ * frac / self.tier_bw(tier) * 1e6
+            cprev *= ni
+        return t + self.tier_latency(path[-1][0])
+
+    def dcn_step_bytes(self, bytes_: float, n: int, inner: int = 1,
+                       strategy: str = "auto") -> float:
+        """Bytes one chip's collective actually pushes across the
+        OUTERMOST tier it spans, under `strategy` — the FFTA071 warning's
+        measure of per-step DCN pressure. 0 when the group never leaves
+        the innermost tier; a group living entirely ON an outer tier
+        (e.g. dp=2 with one member per pod) rings its full bytes there."""
+        path = self.tier_path(n, inner)
+        if not path or (len(path) == 1 and path[0][0] is self.tiers[0]):
+            return 0.0
+        tier, ni = path[-1]
+        if strategy == "auto":
+            strategy, _, _ = self.reduction_choice(bytes_, n, inner=inner)
+        if strategy == REDUCTION_RS_AR_AG:
+            shard = bytes_
+            for _, nj in path[:-1]:
+                shard /= nj
+            return 2.0 * (ni - 1) / ni * shard
+        # flat and hier_ring both ring the full tensor across the top tier
+        return 2.0 * (ni - 1) / ni * bytes_
+
+    def p2p_time_us(self, bytes_: float) -> float:
+        # neighbor transfers ride the innermost tier's links (single
+        # direction, like the flat models' per-link p2p); tier_latency
+        # honors an explicit innermost latency_us, same as ring_hop and
+        # every collective (None keeps the fit-able collective latency,
+        # which is the flat models' expression bit-for-bit)
+        tier = self.tiers[0]
+        bw = (tier.bw_gbps * self.tier_scales.get(tier.name, 1.0)) * 1e9
+        return bytes_ / bw * 1e6 + self.tier_latency(tier)
+
+    def ring_hop_time_us(self, bytes_: float, n: int,
+                         inner: int = 1) -> float:
+        """One simultaneous neighbor hop of a ring laid over an n-wide
+        mesh axis with stride `inner` (the ring-SP K/V rotation, spatial
+        halo exchanges): every chip pushes the same direction at once, so
+        the rotation advances at the SLOWEST link the ring crosses — a
+        ring spanning two pods pays the DCN hop on every rotation step,
+        not the ICI neighbor price."""
+        path = self.tier_path(n, inner)
+        if not path:
+            return self.p2p_time_us(bytes_)
+        tier = path[-1][0]  # outermost tier crossed: the bottleneck hop
+        bw = (tier.bw_gbps * self.tier_scales.get(tier.name, 1.0)) * 1e9
+        return bytes_ / bw * 1e6 + self.tier_latency(tier)
+
+    def apply_overlay(self, coeffs) -> None:
+        """Overlay fitted coefficients. Per-tier link scales
+        (`coeffs.tier_link_scales`, keyed by tier name — obs/refit.py)
+        win for the tiers they name; unnamed tiers fall back to the
+        single `link_bw_scale`, so profiles fitted against flat specs
+        still apply."""
+        super().apply_overlay(coeffs)
+        per_tier = dict(getattr(coeffs, "tier_link_scales", {}) or {})
+        global_scale = float(getattr(coeffs, "link_bw_scale", 1.0))
+        for t in self.tiers:
+            self.tier_scales[t.name] = (
+                self.tier_scales.get(t.name, 1.0)
+                * float(per_tier.get(t.name, global_scale)))
+
+    @classmethod
+    def from_json(cls, spec_or_path, chip: Optional[ChipSpec] = None
+                  ) -> "HierarchicalMachineModel":
+        """Load a tiered spec — a JSON file path or an already-parsed
+        dict: {"chip": "tpu-v5e", "tiers": [{"name": "ici", "degree": 8,
+        "gbps": 45.0, "links": 2}, {"name": "dcn", "degree": 2,
+        "gbps": 3.125, "links": 1, "latency_us": 10.0}]} with tiers
+        listed innermost first (docs/machine.md). num_chips is the
+        product of tier degrees."""
+        if isinstance(spec_or_path, str):
+            with open(spec_or_path) as f:
+                spec = json.load(f)
+        else:
+            spec = dict(spec_or_path)
+        raw = spec.get("tiers")
+        if not raw:
+            raise ValueError("hierarchical machine spec needs a non-empty"
+                             " 'tiers' list")
+        tiers = []
+        for i, t in enumerate(raw):
+            try:
+                tiers.append(TierSpec(
+                    name=str(t.get("name", f"tier{i}")),
+                    degree=int(t["degree"]),
+                    bw_gbps=float(t["gbps"]),
+                    links=int(t.get("links", 2)),
+                    latency_us=(None if t.get("latency_us") is None
+                                else float(t["latency_us"]))))
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"bad tier entry #{i} ({t!r}) in machine spec: {e}"
+                ) from e
+        if len({t.name for t in tiers}) != len(tiers):
+            raise ValueError("tier names must be unique: "
+                             + str([t.name for t in tiers]))
+        if chip is None:
+            chip = CHIP_SPECS.get(spec.get("chip", "tpu-v5e"))
+            if chip is None:
+                raise ValueError(f"unknown chip {spec.get('chip')!r} in"
+                                 f" machine spec; choices: "
+                                 + str(sorted(CHIP_SPECS)))
+        declared = spec.get("num_chips")
+        model = cls(tiers, chip)
+        if declared is not None and int(declared) != model.num_chips:
+            raise ValueError(
+                f"machine spec declares num_chips={declared} but the tier"
+                f" degrees multiply to {model.num_chips}")
+        return model
+
+
+def load_machine_spec(path_or_spec):
+    """Parse a --machine-spec/--machine-model-file value into a dict (the
+    from_json constructors also accept dicts, so the file is read once)."""
+    if isinstance(path_or_spec, str):
+        with open(path_or_spec) as f:
+            return json.load(f)
+    return dict(path_or_spec)
+
+
+def spec_num_chips(spec: Dict) -> int:
+    """Chip count of a parsed machine-spec dict, by each format's own
+    rule: the product of tier degrees for hierarchical specs (what
+    HierarchicalMachineModel.__init__ computes and validates), else the
+    declared num_chips, else NetworkedMachineModel.from_json's
+    highest-chip-id-in-links inference. ONE place for the rule — the
+    elastic coordinator's spec normalization and shrink logic share it
+    with the model constructors."""
+    if spec.get("tiers"):
+        n = 1
+        for t in spec["tiers"]:
+            n *= int(t["degree"])
+        return n
+    if "num_chips" in spec:
+        return int(spec["num_chips"])
+    links = spec.get("links") or []
+    return max((max(i, j) for i, j, _ in links), default=0) + 1
+
+
 def make_machine_model(config, num_chips: int) -> MachineModel:
     """Factory keyed off FFConfig (reference: --machine-model-version/-file).
 
@@ -391,7 +813,15 @@ def make_machine_model(config, num_chips: int) -> MachineModel:
     FittedProfileMismatch) rather than silently mis-pricing."""
     chip = CHIP_SPECS.get("tpu-v5e")
     if config.machine_model_file:
-        m = NetworkedMachineModel.from_json(config.machine_model_file, chip)
+        # one read, then dispatch: a spec with a "tiers" list is the
+        # hierarchical machine (docs/machine.md); anything else keeps the
+        # explicit-topology NetworkedMachineModel format
+        spec = load_machine_spec(config.machine_model_file)
+        if spec.get("tiers"):
+            m = HierarchicalMachineModel.from_json(
+                spec, chip if "chip" not in spec else None)
+        else:
+            m = NetworkedMachineModel.from_json(spec, chip)
     elif config.machine_model_version >= 1:
         m = TpuPodModel(num_chips, chip)
     else:

@@ -315,6 +315,102 @@ class CostModel:
             return 0.0
         return _MEMORY_BOUND_BWD_FACTOR * self.forward_time_us(op, s)
 
+    # -- tier-aware collective plumbing -----------------------------------
+    # Mesh axes are row-major (core/machine.make_mesh reshapes the device
+    # list over mesh_axes_for's order: data, model, expert, attr, seq), so
+    # the LAST axis varies fastest: seq is innermost, then attr, expert,
+    # model, and data outermost. `_axis_inner` is the device stride of an
+    # axis — what a hierarchical machine needs to know which tiers the
+    # axis's collectives actually cross (a tp group stays inside the pod
+    # while the dp group, nested outside everything, spans the DCN).
+    #
+    # The stride comes from the MESH degrees, not the op's own strategy:
+    # an op replicated over the model axis (tp=1 on a tp=2 mesh) still
+    # has its dp groups strided across it — its "in-pod" sync really
+    # spans both pods. `set_mesh_context`/`set_mesh_degrees` install the
+    # realized mesh before pricing; (1, 1, 1, 1) — the flat default —
+    # reproduces op-local nesting.
+    def set_mesh_degrees(self, tp: int = 1, sp: int = 1, ep: int = 1,
+                         ap: int = 1) -> None:
+        """Install a candidate factorization's (tp, sp, ep, ap) as the
+        mesh context (the Unity search calls this per candidate; only
+        tiered machines price with it)."""
+        if self.tiered:
+            self._mesh_ctx = (max(1, tp), max(1, sp), max(1, ep),
+                              max(1, ap))
+
+    def set_mesh_context(self, strategies: Dict[int, "OpStrategy"]) -> None:
+        """Derive the realized mesh degrees from a strategy dict (an axis
+        exists at the largest degree any op shards over it — the same
+        convention as unity.mesh_axes_for)."""
+        if not self.tiered:
+            return
+        tp_m = sp_m = ep_m = ap_m = 1
+        for s in strategies.values():
+            tp_m = max(tp_m, s.tp)
+            sp_m = max(sp_m, s.sp)
+            ep_m = max(ep_m, s.ep)
+            ap_m = max(ap_m, s.ap)
+        self._mesh_ctx = (tp_m, sp_m, ep_m, ap_m)
+
+    def _axis_inner(self, s: OpStrategy, axis: str) -> int:
+        tp_m, sp_m, ep_m, ap_m = self._mesh_ctx
+        if axis == "sp":
+            return 1
+        if axis == "ap":
+            return sp_m
+        if axis == "ep":
+            return sp_m * ap_m
+        if axis == "tp":
+            return sp_m * ap_m * ep_m
+        return tp_m * sp_m * ep_m * ap_m  # dp, the outermost axis
+
+    def _sync_inner(self, op: Op, s: OpStrategy) -> int:
+        """Device stride of the gradient-sync group (dp, plus ap when
+        this op actually shards spatially — when ap is NOT part of the
+        group, including a spatial-capable op that could not shard
+        (s.ap == 1) on an ap mesh, the attr axis sits inside the dp
+        stride like every other inner axis)."""
+        tp_m, sp_m, ep_m, ap_m = self._mesh_ctx
+        inner = tp_m * sp_m * ep_m
+        if not (op.op_type in AP_CAPABLE and s.ap > 1):
+            inner *= ap_m
+        return max(1, inner)
+
+    def _allreduce_us(self, bytes_: float, n: int, inner: int,
+                      strategy: str = "auto") -> float:
+        if self.tiered:
+            return self.machine.allreduce_time_us(bytes_, n, inner=inner,
+                                                  strategy=strategy)
+        return self.machine.allreduce_time_us(bytes_, n)
+
+    def _allgather_us(self, bytes_per_shard: float, n: int,
+                      inner: int) -> float:
+        if self.tiered:
+            return self.machine.allgather_time_us(bytes_per_shard, n,
+                                                  inner=inner)
+        return self.machine.allgather_time_us(bytes_per_shard, n)
+
+    def _reduce_scatter_us(self, bytes_: float, n: int, inner: int) -> float:
+        if self.tiered:
+            return self.machine.reduce_scatter_time_us(bytes_, n,
+                                                       inner=inner)
+        return self.machine.reduce_scatter_time_us(bytes_, n)
+
+    def _all_to_all_us(self, bytes_: float, n: int, inner: int) -> float:
+        if self.tiered:
+            return self.machine.all_to_all_time_us(bytes_, n, inner=inner)
+        return self.machine.all_to_all_time_us(bytes_, n)
+
+    def _ring_hop_us(self, bytes_: float, n: int, inner: int) -> float:
+        """One simultaneous neighbor hop of a ring over an n-wide axis
+        (ring-SP rotation, ap halos): on tiered machines the rotation
+        advances at the slowest link the ring crosses — a cross-pod ring
+        pays the DCN hop, not the innermost-tier neighbor price."""
+        if self.tiered:
+            return self.machine.ring_hop_time_us(bytes_, n, inner=inner)
+        return self.machine.p2p_single_path_time_us(bytes_)
+
     def tp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
         """Extra collective a TP op needs per step: a row-parallel linear
         all-reduces its partial-sum output; a column-parallel op's gather is
@@ -322,6 +418,7 @@ class CostModel:
         if s.tp <= 1 or op.op_type not in TP_CAPABLE or not op.outputs:
             return 0.0
         out = op.outputs[0]
+        inner = self._axis_inner(s, "tp")
         bytes_ = out.num_elements() * self.op_dtype_bytes(op) / max(1, s.dp)
         if s.tp_row:
             # the Megatron pair costs TWO allreduces per step: fwd partial
@@ -329,10 +426,10 @@ class CostModel:
             # column partner's input gradient — same bytes for the
             # canonical d->4d->d pairing); simulate() charges half in each
             # pass
-            return 2.0 * self.machine.allreduce_time_us(bytes_, s.tp)
+            return 2.0 * self._allreduce_us(bytes_, s.tp, inner)
         # fwd allgather + bwd reduce_scatter of the same bytes
-        return self.machine.allgather_time_us(bytes_ / s.tp, s.tp) + \
-            self.machine.reduce_scatter_time_us(bytes_, s.tp)
+        return self._allgather_us(bytes_ / s.tp, s.tp, inner) + \
+            self._reduce_scatter_us(bytes_, s.tp, inner)
 
     def ap_halo_time_us(self, op: Op, s: OpStrategy) -> float:
         """Halo exchange cost of spatial (H) sharding: each chip swaps the
@@ -345,7 +442,12 @@ class CostModel:
         if elems <= 0:
             return 0.0
         halo_bytes = elems * self.op_dtype_bytes(op) / max(1, s.dp)
-        # exchanged once fwd + mirrored bwd
+        # exchanged once fwd + mirrored bwd; neighbors along the attr
+        # axis — on tiered machines the exchange pays the slowest tier
+        # the axis crosses
+        if self.tiered:
+            return 2.0 * self.machine.ring_hop_time_us(
+                halo_bytes, s.ap, inner=self._axis_inner(s, "ap"))
         return 2.0 * self.machine.p2p_time_us(halo_bytes)
 
     def sp_collective_time_us(self, op: Op, s: OpStrategy) -> float:
@@ -374,14 +476,17 @@ class CostModel:
             denom = max(1, s.dp) * s.sp
             q_tok = attn_q_bytes(op, self.op_dtype_bytes(op)) / denom
             kv_tok = (base / 2.0) / denom
+            sp_inner = self._axis_inner(s, "sp")
             return 2.0 * 2.0 * (
-                self.machine.all_to_all_time_us(q_tok, s.sp)
-                + self.machine.all_to_all_time_us(kv_tok, s.sp))
+                self._all_to_all_us(q_tok, s.sp, sp_inner)
+                + self._all_to_all_us(kv_tok, s.sp, sp_inner))
         kv_bytes = base / (max(1, s.dp) * s.sp)
         # fwd rotation + mirrored bwd rotation of dK/dV; single-path: all
-        # chips rotate the SAME direction, so ECMP cannot split the hop
-        return 2.0 * (s.sp - 1) * self.machine.p2p_single_path_time_us(
-            kv_bytes)
+        # chips rotate the SAME direction, so ECMP cannot split the hop —
+        # and each rotation step advances at the slowest link the seq
+        # ring crosses (tiered machines: a cross-pod ring pays the DCN)
+        return 2.0 * (s.sp - 1) * self._ring_hop_us(
+            kv_bytes, s.sp, self._axis_inner(s, "sp"))
 
     def ep_collective_time_us(self, op: Op, s: OpStrategy) -> float:
         """Token routing cost of expert parallelism: all_to_all of the
@@ -402,9 +507,10 @@ class CostModel:
         db = self.op_dtype_bytes(op)
         disp_bytes = n * cap * x.dims[1] * db / shard
         comb_bytes = n * cap * op.params["out_dim"] * db / shard
+        ep_inner = self._axis_inner(s, "ep")
         # each direction fwd + mirrored bwd
-        return 2.0 * (self.machine.all_to_all_time_us(disp_bytes, s.ep)
-                      + self.machine.all_to_all_time_us(comb_bytes, s.ep))
+        return 2.0 * (self._all_to_all_us(disp_bytes, s.ep, ep_inner)
+                      + self._all_to_all_us(comb_bytes, s.ep, ep_inner))
 
     def xfer_time_us(self, tensor_bytes: float, src: OpStrategy, dst: OpStrategy) -> float:
         """Reshard cost on an edge when producer/consumer batch degrees differ
@@ -414,8 +520,10 @@ class CostModel:
         n = max(src.dp, dst.dp)
         if dst.dp > src.dp:
             return 0.0  # replicated/coarse -> finer: local slice
-        # finer -> coarser: all_gather of the missing shards
-        return self.machine.allgather_time_us(tensor_bytes / n, n)
+        # finer -> coarser: all_gather of the missing shards (the producer's
+        # layout fixes which tiers the dp group crosses)
+        return self._allgather_us(tensor_bytes / n, n,
+                                  self._axis_inner(src, "dp"))
 
     def tp_boundary_time_us(self, tensor_bytes: float, src_op: Op,
                             src: OpStrategy, dst: OpStrategy,
@@ -431,11 +539,12 @@ class CostModel:
             return 0.0
         if dst.tp == src.tp and dst.tp_row:
             return 0.0  # paired column->row: stays sharded
+        tp_inner = self._axis_inner(src, "tp")
         if backward:
-            return self.machine.reduce_scatter_time_us(
-                tensor_bytes / max(1, src.dp), src.tp)
+            return self._reduce_scatter_us(
+                tensor_bytes / max(1, src.dp), src.tp, tp_inner)
         shard = tensor_bytes / max(1, src.dp * src.tp)
-        return self.machine.allgather_time_us(shard, src.tp)
+        return self._allgather_us(shard, src.tp, tp_inner)
 
     def grad_sync_time_us(self, op: Op, s: OpStrategy) -> float:
         """Weight-gradient allreduce over the data axis (reference: NCCL
@@ -449,7 +558,11 @@ class CostModel:
         memo = getattr(self, "_grad_sync_memo", None)
         if memo is None:
             memo = self._grad_sync_memo = {}
-        key = (op.guid, s)
+        # mesh context and reduction mode are part of the identity on
+        # tiered machines: the SAME op strategy prices differently under
+        # different candidate factorizations (its sync group strides
+        # across their inner axes) and under auto-vs-flat repricing
+        key = (op.guid, s, self._mesh_ctx, self.reduction_mode)
         hit = memo.get(key)
         if hit is not None:
             return hit
@@ -457,13 +570,54 @@ class CostModel:
         memo[key] = out
         return out
 
-    def _grad_sync_uncached(self, op: Op, s: OpStrategy,
-                            sync: int) -> float:
+    def _grad_sync_bytes(self, op: Op, s: OpStrategy) -> float:
         wshard = s.ep if op.op_type == OpType.EXPERTS else s.tp
-        wb = sum(
+        return sum(
             w.num_elements() * w.dtype.np_dtype.itemsize for w in op.weights
         ) / max(1, wshard)
+
+    def _grad_sync_uncached(self, op: Op, s: OpStrategy,
+                            sync: int) -> float:
+        wb = self._grad_sync_bytes(op, s)
+        if self.tiered:
+            # the sync group spans the dp (x ap) axes — every MESH axis
+            # nested inside them is its device stride, which fixes the
+            # tiers the reduction crosses. "auto" synthesizes the
+            # cheapest tier-decomposable strategy per tensor
+            # (reduction_plan exports the choices); "flat" reprices a
+            # plan searched under a flat machine model.
+            return self.machine.allreduce_time_us(
+                wb, sync, inner=self._sync_inner(op, s),
+                strategy=self.reduction_mode)
         return self.machine.allreduce_time_us(wb, sync)
+
+    def reduction_plan(self, graph: Graph,
+                       strategies: Dict[int, OpStrategy]
+                       ) -> Dict[str, Dict[str, Any]]:
+        """Per-synced-tensor reduction decomposition on a hierarchical
+        machine: {op name: {strategy, degree, bytes, tiers, time_us}} for
+        every op whose weight gradients sync over dp (x ap). This is THE
+        decomposition carried on the plan — the Unity search stores it on
+        SearchResult.reduction_strategies, export_strategy serializes it,
+        the FFTA07x analysis family checks it, and the executor surfaces
+        it (docs/machine.md). Empty on flat machines."""
+        if not self.tiered:
+            return {}
+        self.set_mesh_context(strategies)
+        out: Dict[str, Dict[str, Any]] = {}
+        default = OpStrategy()
+        for op in graph.ops.values():
+            s = strategies.get(op.guid, default)
+            sync = s.dp * (s.ap if op.op_type in AP_CAPABLE else 1)
+            if sync <= 1 or not op.weights:
+                continue
+            wb = self._grad_sync_bytes(op, s)
+            strat, t_us, tiers = self.machine.reduction_choice(
+                wb, sync, inner=self._sync_inner(op, s))
+            out[op.name] = {"strategy": strat, "degree": sync,
+                            "bytes": wb, "tiers": tiers,
+                            "time_us": t_us}
+        return out
 
     # outputs of these op types never materialize as saved-for-backward
     # buffers on TPU: XLA fuses elementwise chains into the surrounding
@@ -484,6 +638,17 @@ class CostModel:
                  optimizer_state_factor: float = 3.0):
         self.machine = machine
         self.config = config
+        # hierarchical machine (machine_model.HierarchicalMachineModel):
+        # collectives price against the tiers each parallel degree actually
+        # crosses, and gradient syncs get a synthesized per-tier reduction
+        # strategy (docs/machine.md). reduction_mode="flat" reprices a plan
+        # that carries NO tier decomposition (one searched under a flat
+        # machine model) — the baseline the multipod bench compares against.
+        self.tiered = hasattr(machine, "tier_path")
+        self.reduction_mode = "auto"
+        # (tp, sp, ep, ap) degrees of the realized mesh — see
+        # set_mesh_context/set_mesh_degrees above
+        self._mesh_ctx = (1, 1, 1, 1)
         # 3.0 = Adam (param + m + v); 2.0 = SGD momentum; 1.0 = plain SGD.
         # FFModel.compile sets config.optimizer_state_factor from the real
         # optimizer before running the search.
@@ -835,7 +1000,7 @@ class Simulator:
         resharding exactly on boundary edges, and best-first refinement
         re-scores flips with it — charging it at seed time just biases seeds
         conservatively where edges are unknown."""
-        key = (op.guid, s)
+        key = (op.guid, s, self.cost._mesh_ctx)
         hit = self._step_memo.get(key)
         if hit is not None:
             return hit
@@ -857,6 +1022,9 @@ class Simulator:
         collectives onto the compute stream (no overlap)."""
         default = OpStrategy()
         order = graph.topo_order()
+        # tiered machines: the realized mesh fixes each axis's device
+        # stride — derive it from THIS strategy set before any pricing
+        self.cost.set_mesh_context(strategies)
         overlap = bool(self.config is None
                        or self.config.search_overlap_backward_update)
         # per-axis ICI timelines (congestion analog of EnhancedMachineModel's
@@ -920,7 +1088,8 @@ class Simulator:
             """(data-axis reshard us, model-axis boundary us) — separate
             channels: the dp-degree allgather rides the data rings, the TP
             boundary collective rides the model rings."""
-            key = (t.guid, src_op.guid, backward, src_s, s)
+            key = (t.guid, src_op.guid, backward, src_s, s,
+                   self.cost._mesh_ctx)
             hit = edge_memo.get(key)
             if hit is not None:
                 return hit

@@ -226,6 +226,13 @@ class SearchResult:
     # passes rejected first
     candidates_simulated: int = 0
     candidates_pruned: int = 0
+    # per-tier reduction decomposition synthesized for synced tensors on a
+    # hierarchical machine (CostModel.reduction_plan, docs/machine.md):
+    # {op name: {strategy, degree, bytes, tiers, time_us}} — exported in
+    # the strategy JSON ("reductions") and checked by the FFTA07x family.
+    # Empty on flat machine models.
+    reduction_strategies: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
 
 class GraphSearchHelper:
@@ -291,6 +298,9 @@ class GraphSearchHelper:
         if key in self._memo:
             return self._memo[key]
         seg_graph = Graph(seg)
+        # tiered machines: seed pricing strides axes by THIS candidate
+        # factorization (simulate() re-derives from realized strategies)
+        self.sim.cost.set_mesh_degrees(tp=tp, sp=sp, ep=ep, ap=ap)
         # seed: per-op greedy best in isolation (memory-weighted under lam)
         strategies = {}
         for op in seg:
@@ -446,6 +456,12 @@ class GraphSearchHelper:
         # calibration anchor (obs/calibration.py): the selected plan's
         # predicted step cost, compared post-compile with measured steps
         best.predicted_step_us = best.cost_us
+        # hierarchical machines: record the per-tier reduction strategy the
+        # winning plan's synced tensors priced with, so export/analysis/
+        # executor all see the same decomposition the simulator chose
+        if hasattr(self.machine, "tier_path"):
+            best.reduction_strategies = self.sim.cost.reduction_plan(
+                self.graph, best.strategies)
         self.log.append(
             f"plan sanitizer: {self.candidates_simulated} factorization(s) "
             f"simulated, {self.candidates_pruned} pruned before costing")
@@ -599,6 +615,9 @@ class GraphSearchHelper:
         m = max(1, getattr(self.config, "pipeline_microbatches", 4))
         if batch_size % m:
             return []
+        # pipeline candidates are dp-only: reset any tiered mesh context
+        # a previous factorization's seeding installed
+        self.sim.cost.set_mesh_degrees()
         entry = entries[0]
         import numpy as np
 
@@ -938,6 +957,10 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
             and not rewrites_applicable
             and not config.memory_search  # lambda search is Python-only
             and not getattr(config, "enable_pipeline_parallel", False)
+            # hierarchical machines are Python-only: the native core's line
+            # protocol carries chip scalars, not tiers, so it would price a
+            # cross-DCN all-reduce like a neighbor hop (docs/machine.md)
+            and not hasattr(machine, "tier_path")
             and getattr(config, "use_native_search", True)):
         from .. import native
 
@@ -992,7 +1015,8 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                                  rule_spec=(spec, is_taso, taso_rules))
 
 
-def rewrite_and_import_strategy(graph: Graph, config, path: str):
+def rewrite_and_import_strategy(graph: Graph, config, path: str,
+                                spec: Optional[dict] = None):
     """compile()'s --import preamble, shared with the analyze CLI so the
     two paths cannot drift: the exporting search ran the greedy rewrite
     pass before choosing strategies, so op names in the file refer to the
@@ -1005,10 +1029,10 @@ def rewrite_and_import_strategy(graph: Graph, config, path: str):
     from .substitution import (apply_substitutions, load_rule_spec,
                                rule_set_from_spec, search_rules_from_spec)
 
-    spec, is_taso = load_rule_spec(config.substitution_json_path)
-    apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
-    return import_strategy(graph, path,
-                           rules=search_rules_from_spec(spec, is_taso))
+    rule_spec, is_taso = load_rule_spec(config.substitution_json_path)
+    apply_substitutions(graph, rule_set_from_spec(rule_spec, is_taso))
+    return import_strategy(graph, path, spec=spec,
+                           rules=search_rules_from_spec(rule_spec, is_taso))
 
 
 def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
@@ -1021,6 +1045,13 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         # (by rule + description) so op names in "ops" resolve
         "applied_rewrites": list(result.applied_rewrites),
         "greedy_search_rules": result.greedy_search_rules,
+        # per-tier reduction decomposition (hierarchical machines only):
+        # informational for import — reduction strategies are a property
+        # of the machine the plan compiles onto, so compile() re-derives
+        # them — but the tier decomposition stays visible in the exported
+        # artifact (docs/machine.md)
+        **({"reductions": result.reduction_strategies}
+           if result.reduction_strategies else {}),
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
                                    "ap": s.ap, "sp": s.sp,
@@ -1033,15 +1064,22 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         json.dump(data, f, indent=2)
 
 
-def import_strategy(graph: Graph, path: str,
-                    rules=None) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
+def import_strategy(graph: Graph, path: str, rules=None,
+                    spec: Optional[dict] = None
+                    ) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
     """Load a strategy exported by export_strategy (reference: --import).
 
     rules: the search-rule registry (search_rules_from_spec) — needed to
     replay the trade-off rewrites the exporting search materialized, so
-    rule-created op names in the file resolve against this graph."""
-    with open(path) as f:
-        data = json.load(f)
+    rule-created op names in the file resolve against this graph.
+    spec: the already-parsed file contents, when the caller read the JSON
+    itself (the analyze CLI also pulls "reductions" from it) — avoids a
+    second read that could drift from this one."""
+    if spec is not None:
+        data = spec
+    else:
+        with open(path) as f:
+            data = json.load(f)
     if rules:
         from .substitution import apply_substitutions
 

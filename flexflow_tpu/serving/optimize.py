@@ -91,7 +91,9 @@ def fold_batchnorm(model) -> List[str]:
         # and fit()/backward() refuse via the flag
         from ..runtime.executor import Executor
 
-        model.executor = Executor(graph, model.config, model.mesh)
+        model.executor = Executor(
+            graph, model.config, model.mesh,
+            reduction_plan=getattr(model, "_reduction_plan", None))
         model._build_step_functions()  # all paths rebuilt over the new graph
         if getattr(model, "_manual", None):
             model._manual.pop("seq_fns", None)
