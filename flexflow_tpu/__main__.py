@@ -106,6 +106,14 @@ def main(argv=None):
         from .obs.cli import run_profile
 
         raise SystemExit(run_profile(argv[1:]))
+    # collective microbench: sweep the explicit reduction-strategy
+    # lowerings x message sizes on the live mesh; emits the calibration
+    # rows the per-tier link-constant refit consumes (docs/machine.md
+    # "Lowering", docs/observability.md)
+    if argv and argv[0] == "collective-bench":
+        from .obs.collective_bench import run_collective_bench
+
+        raise SystemExit(run_collective_bench(argv[1:]))
     # serving load test: continuous batching vs the lockstep generation
     # path on a mixed-length workload (docs/serving.md)
     if argv and argv[0] == "serve-bench":

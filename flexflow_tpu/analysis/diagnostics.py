@@ -83,6 +83,9 @@ CODE_CATALOG: Dict[str, tuple] = {
     "FFTA071": (Severity.WARNING,
                 "per-step collective pushes heavy traffic across the"
                 " outermost (DCN) tier"),
+    "FFTA072": (Severity.ERROR,
+                "explicit collective lowering diverges from the priced"
+                " reduction plan (dropped or renamed sync)"),
 }
 
 

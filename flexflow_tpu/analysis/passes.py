@@ -57,6 +57,13 @@ class AnalysisContext:
     # missing an op means that op's sync is UN-decomposed (flat) — what a
     # plan searched under a flat machine model carries.
     reduction_strategies: Optional[Dict[str, dict]] = None
+    # what the explicit collective lowering ACTUALLY lowered ({op name:
+    # strategy}, GradSyncLowering.executed_plan()). None = GSPMD runs
+    # the schedule, nothing to compare. When set, the FFTA072 check
+    # fails loudly on any plan entry the lowering dropped/renamed —
+    # analysis of an explicit-lowered plan must describe the executed
+    # schedule, not the record (docs/analysis.md).
+    executed_reductions: Optional[Dict[str, str]] = None
 
     def strategy_of(self, op):
         if not self.strategies:
@@ -404,6 +411,54 @@ def pass_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
 DCN_STEP_BYTES_WARN = 64e6
 
 
+def check_executed_reductions(ctx: AnalysisContext) -> List[Diagnostic]:
+    """FFTA072: with an explicit collective lowering active, the priced
+    reduction plan and the executed schedule must describe the same
+    tensors the same way — an op the lowering dropped or renamed, or a
+    strategy it substituted, means every FFTA07x verdict (and the cost
+    model's grad-sync price) talks about a schedule that never ran."""
+    import math as _math
+
+    diags: List[Diagnostic] = []
+    executed = ctx.executed_reductions
+    if executed is None or ctx.reduction_strategies is None:
+        return diags
+    ops_by_name = {op.name: op for op in ctx.graph.ops.values()}
+    for name, entry in ctx.reduction_strategies.items():
+        planned = (entry or {}).get("strategy", "flat")
+        ran = executed.get(name)
+        if ran is None:
+            diags.append(make_diag(
+                "FFTA072",
+                f"reduction plan names {name!r} ({planned}) but the"
+                " explicit lowering dropped or renamed it — the"
+                " executed schedule never syncs this tensor",
+                ops_by_name.get(name),
+                hint="recompile so the lowering and the plan come from"
+                     " the same graph; a rewrite that renames ops must"
+                     " re-synthesize the reduction plan"))
+        elif ran != planned:
+            # the lowering's DOCUMENTED conservative fallback is legal:
+            # when the plan's tier groups do not multiply to the sync
+            # degree (tier_path's round-up on a non-factoring mesh),
+            # the entry cannot be expressed as axis groups and syncs
+            # flat — that is the lowering working as specified, not
+            # plan<->execution drift
+            groups = [int(t.get("group", 0))
+                      for t in (entry or {}).get("tiers", [])]
+            degree = int((entry or {}).get("degree") or 0)
+            expressible = bool(groups) and degree > 0 \
+                and _math.prod(groups) == degree
+            if ran == "flat" and not expressible:
+                continue
+            diags.append(make_diag(
+                "FFTA072",
+                f"reduction plan prices {name!r} as {planned} but the"
+                f" lowering executed {ran} — the analysis would judge a"
+                " schedule that never ran", ops_by_name.get(name)))
+    return diags
+
+
 def pass_tier_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
     """Hierarchical-machine legality (docs/machine.md):
 
@@ -418,15 +473,18 @@ def pass_tier_collectives(ctx: AnalysisContext) -> List[Diagnostic]:
        tensor-parallel activation collective) pushes more than
        DCN_STEP_BYTES_WARN across the outermost tier — legal, but the
        cross-DCN traffic will dominate the step.
+     - FFTA072 (error, check_executed_reductions): the explicit
+       lowering's executed schedule diverges from the priced plan —
+       checked whenever ctx.executed_reductions is set, on flat
+       machines too (the lowering runs wherever a 'data' axis does).
 
-    No-ops on flat machine models."""
+    The tier checks no-op on flat machine models."""
+    diags: List[Diagnostic] = list(check_executed_reductions(ctx))
     machine = ctx.machine
     if machine is None or not hasattr(machine, "tier_path"):
-        return []
+        return diags
     from ..search.simulator import (AP_CAPABLE, CostModel, OpStrategy,
                                     TP_CAPABLE)
-
-    diags: List[Diagnostic] = []
     strategies = ctx.strategies or {}
     reds = ctx.reduction_strategies
     cost = CostModel(machine, ctx.config)

@@ -48,6 +48,7 @@ def analyze_plan(graph: Graph,
                  mesh_axes: Optional[Dict[str, int]] = None,
                  final_guid: Optional[int] = None,
                  reduction_strategies: Optional[Dict[str, dict]] = None,
+                 executed_reductions: Optional[Dict[str, str]] = None,
                  passes: Optional[Sequence[str]] = None) -> DiagnosticReport:
     """Run the pass pipeline; returns the DiagnosticReport (never raises).
 
@@ -60,7 +61,8 @@ def analyze_plan(graph: Graph,
                           mesh_axes=mesh_axes, machine=machine,
                           config=config, batch_size=batch_size,
                           n_devices=n_devices, final_guid=final_guid,
-                          reduction_strategies=reduction_strategies)
+                          reduction_strategies=reduction_strategies,
+                          executed_reductions=executed_reductions)
     names = list(passes) if passes is not None else list(ALL_PASSES)
     report = DiagnosticReport(passes_run=names)
     for name in names:

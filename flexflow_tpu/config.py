@@ -74,6 +74,15 @@ class FFConfig:
     # fit it from before/after kernel measurements on real TPU
     # (--kernel-residual-threshold).
     kernel_residual_threshold: float = 1.10
+    # Collective lowering of the searched reduction plan
+    # (runtime/collectives.py, docs/machine.md "Lowering"): "gspmd" lets
+    # XLA synthesize the gradient-sync schedule (the historical path),
+    # "explicit" lowers each reduction_plan entry into real per-tier
+    # grouped collectives inside the jitted train step (raising a typed
+    # CollectiveLoweringError when the plan cannot be lowered), "auto"
+    # lowers explicitly only when supported AND the plan crosses a tier
+    # boundary — otherwise it falls back to gspmd.
+    collective_lowering: str = "gspmd"
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
@@ -221,6 +230,15 @@ class FFConfig:
 
                 KernelRegistry.parse_spec(v)  # validate; raises on junk
                 self.kernel_impl = v
+            elif a == "--collective-lowering":
+                v = take()
+                from .runtime.collectives import COLLECTIVE_LOWERINGS
+
+                if v not in COLLECTIVE_LOWERINGS:
+                    raise ValueError(
+                        "--collective-lowering must be one of "
+                        f"{COLLECTIVE_LOWERINGS}, got {v!r}")
+                self.collective_lowering = v
             elif a == "--kernel-residual-threshold":
                 v = float(take())
                 if not v > 0:

@@ -72,6 +72,11 @@ class LoweringContext:
         # true while lowering inside a shard_map manual-collective region
         # (ring attention, expert all_to_all) where lax collectives are legal
         self.in_shard_map: bool = False
+        # mesh axes the enclosing shard_map holds MANUAL (the explicit
+        # grad-sync lowering, runtime/collectives.py): sharding
+        # constraints naming a manual axis are illegal inside the body,
+        # so constrain() strips them (the data is already the shard)
+        self.manual_axes: frozenset = frozenset()
 
     def next_rng(self):
         import jax
@@ -86,6 +91,16 @@ class LoweringContext:
         if self.mesh is None or tensor.parallel_shape is None:
             return value
         spec = tensor.parallel_shape.partition_spec()
+        if self.manual_axes:
+            from jax.sharding import PartitionSpec
+
+            def drop_manual(p):
+                if isinstance(p, (tuple, list)):
+                    kept = tuple(q for q in p if q not in self.manual_axes)
+                    return kept if kept else None
+                return None if p in self.manual_axes else p
+
+            spec = PartitionSpec(*[drop_manual(p) for p in spec])
         if all(p is None for p in spec):
             return value
         import jax

@@ -730,6 +730,10 @@ class FFModel:
 
         self.executor = Executor(self.graph, self.config, self.mesh,
                                  reduction_plan=self._reduction_plan)
+        # FFTA072: with the explicit collective lowering active, what
+        # the executor will actually run must match what the gate above
+        # just proved and the simulator priced — fail loudly, not drift
+        self._verify_executed_reductions()
         import jax
 
         self.params, self.state = self.executor.init_params(
@@ -853,6 +857,10 @@ class FFModel:
         final_guid = (final.owner_op.guid
                       if final is not None and final.owner_op is not None
                       and final.owner_op.guid in self.graph.ops else None)
+        # an active explicit lowering makes the analysis compare against
+        # the EXECUTED schedule (FFTA072), not just the plan record
+        lowering = getattr(getattr(self, "executor", None),
+                           "grad_sync_lowering", None)
         return _analyze(
             self.graph,
             strategies=self._op_strategies,
@@ -863,8 +871,39 @@ class FFModel:
             mesh_axes=getattr(self, "parallel_axes", None),
             final_guid=final_guid,
             reduction_strategies=getattr(self, "_reduction_plan", None),
+            executed_reductions=(lowering.executed_plan()
+                                 if lowering is not None else None),
             passes=passes,
         )
+
+    def _verify_executed_reductions(self) -> None:
+        """The FFTA072 compile-time gate: with the explicit collective
+        lowering active, fail loudly (under plan_analysis="error") if
+        the lowering dropped or renamed any tensor the priced
+        reduction_plan names — the analysis and the cost model must
+        describe the schedule that actually runs (docs/analysis.md)."""
+        lowering = getattr(self.executor, "grad_sync_lowering", None)
+        mode = getattr(self.config, "plan_analysis", "error")
+        if lowering is None or mode == "off" or not self._reduction_plan:
+            return
+        from .analysis import PlanAnalysisError, record_report
+        from .analysis.diagnostics import DiagnosticReport
+        from .analysis.passes import (AnalysisContext,
+                                      check_executed_reductions)
+
+        ctx = AnalysisContext(
+            graph=self.graph,
+            reduction_strategies=self._reduction_plan,
+            executed_reductions=lowering.executed_plan())
+        report = DiagnosticReport(passes_run=["tiers"])
+        report.extend(check_executed_reductions(ctx))
+        if not report.diagnostics:
+            return
+        record_report(report)
+        for d in report.errors():
+            _log.error("plan analysis: %s", d.format())
+        if mode == "error" and report.errors():
+            raise PlanAnalysisError(report)
 
     def _run_plan_analysis(self) -> None:
         """The compile()/re-plan pre-flight gate: plan_analysis="error"

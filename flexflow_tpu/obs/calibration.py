@@ -95,6 +95,55 @@ class OpCalibration:
 
 
 @dataclasses.dataclass
+class CollectiveCalibration:
+    """One measured collective: the obs.calibrate row type the explicit
+    collective lowering emits (runtime/collectives.py via the
+    collective-bench sweep) and the resharding executor's transfer
+    rounds produce. `refit.fit_collective_coefficients` fits the
+    per-tier link constants from these — measured collectives, not the
+    step-level residual attribution the per-tier fit otherwise leans on.
+
+    op: "allreduce" (a full strategy lowering), "psum" (one tier's ring
+    phase in isolation — the per-tier fit's preferred evidence),
+    "transfer"/"allgather" (resharding rounds). tier: the tier the
+    traffic rides ("ici"/"dcn"/... on hierarchical machines, "mesh" on
+    flat ones)."""
+
+    op: str
+    strategy: str
+    tier: str
+    bytes: float
+    participants: int
+    predicted_us: float
+    measured_us: float
+    dtype: str = "f32"
+
+    @property
+    def ratio(self) -> float:
+        """measured/predicted — NaN when either side is degenerate, the
+        same contract as OpCalibration.ratio."""
+        if not (self.predicted_us > 0 and math.isfinite(self.predicted_us)
+                and self.measured_us > 0
+                and math.isfinite(self.measured_us)):
+            return float("nan")
+        return self.measured_us / self.predicted_us
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["ratio"] = self.ratio
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CollectiveCalibration":
+        return cls(op=str(d["op"]), strategy=str(d["strategy"]),
+                   tier=str(d["tier"]), bytes=float(d["bytes"]),
+                   participants=int(d["participants"]),
+                   predicted_us=float(d["predicted_us"]),
+                   measured_us=float(d["measured_us"]),
+                   dtype=str(d.get("dtype", "f32")))
+
+
+@dataclasses.dataclass
 class CalibrationReport:
     backend: str
     predicted_step_us: Optional[float]

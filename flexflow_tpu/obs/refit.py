@@ -562,6 +562,65 @@ def refit(model, measured_step_us: float, op_rows,
     return profile, history
 
 
+def fit_collective_coefficients(rows, machine,
+                                prior: Optional[FittedCoefficients] = None
+                                ) -> FittedCoefficients:
+    """Fit per-tier link-bandwidth scales from MEASURED collectives
+    (obs.calibration.CollectiveCalibration rows from the
+    collective-bench sweep), rather than from the step-level residual
+    attribution `refit()` uses when only op rows exist.
+
+    The evidence is the per-tier ring phases (op="psum",
+    strategy="tier_ring"): one tier's grouped psum in isolation is
+    linear in bytes, `measured ~= slope/scale * bytes + latency`, so the
+    robust linear fit of measured-vs-bytes against predicted-vs-bytes
+    gives that tier's scale directly — `scale = slope_pred/slope_meas`.
+    Whole-strategy rows (op="allreduce") mix tiers, and resharding
+    transfer rows (`ReshardResult.calibration_rows`) mix a round's
+    gather/transfer/slice components into one prediction — both are
+    report/trace artifacts, not fit evidence, and are ignored here. On
+    flat machines the single "mesh" tier fits
+    `link_bw_scale`. The mean positive intercept across tiers becomes
+    the fitted collective latency. Tiers with fewer than 2 usable rows
+    keep their prior."""
+    coeffs = prior if prior is not None else FittedCoefficients()
+    coeffs = dataclasses.replace(
+        coeffs, compute_scale=dict(coeffs.compute_scale),
+        tier_link_scales=dict(coeffs.tier_link_scales))
+    by_tier: Dict[str, List] = {}
+    for r in usable_rows(rows):
+        if getattr(r, "op", None) == "psum" \
+                and getattr(r, "strategy", None) == "tier_ring":
+            by_tier.setdefault(str(r.tier), []).append(r)
+    tier_names = {t.name for t in getattr(machine, "tiers", [])}
+    intercepts: List[float] = []
+    for tier, group in by_tier.items():
+        if len(group) < 2:
+            continue
+        xs = [float(r.bytes) for r in group]
+        if max(xs) <= min(xs):
+            continue  # one byte size cannot separate slope from latency
+        a_meas, b_meas = _trimmed_linear_fit(xs,
+                                             [r.measured_us for r in group])
+        a_pred, _ = _trimmed_linear_fit(xs,
+                                        [r.predicted_us for r in group])
+        if not (a_meas > 0 and a_pred > 0):
+            continue
+        scale = _clamp(a_pred / a_meas)
+        if tier in tier_names:
+            prior_t = coeffs.tier_link_scales.get(tier,
+                                                  coeffs.link_bw_scale)
+            coeffs.tier_link_scales[tier] = _clamp(prior_t * scale)
+        else:
+            # flat machine ("mesh" tier): the single-scale path
+            coeffs.link_bw_scale = _clamp(coeffs.link_bw_scale * scale)
+        intercepts.append(max(0.0, b_meas))
+    if intercepts:
+        coeffs.collective_latency_us = _clamp(
+            sum(intercepts) / len(intercepts), 0.0, 1e4)
+    return coeffs
+
+
 # -- live drift detection --------------------------------------------------
 
 class DriftDetector:

@@ -21,7 +21,7 @@ from __future__ import annotations
 from .plan import ALLGATHER, PERMUTE, SLICE, TRANSFER, ReshardSchedule
 
 
-def step_cost_us(step, machine) -> float:
+def step_cost_us(step, machine, n_devices: int = 0) -> float:
     if step.kind == ALLGATHER:
         n = max(2, step.participants)
         # allgather_time_us takes per-shard bytes; the step records the
@@ -29,6 +29,19 @@ def step_cost_us(step, machine) -> float:
         return machine.allgather_time_us(
             step.bytes_per_chip / max(1, n - 1), n)
     if step.kind in (TRANSFER, PERMUTE):
+        # on a hierarchical machine the DEVICE GROUP fixes the tiers a
+        # transfer crosses: a redistribution landing on a mesh spanning
+        # two pods pays the DCN hop, not the innermost-link p2p a flat
+        # model prices. The span is the target mesh's device count
+        # (`n_devices`, threaded by schedule_cost_us) — NOT
+        # step.participants, which records the array's new sharding
+        # degree and is 1 for a replicated landing even when the
+        # replicas live across pods. (ring_hop_time_us = the slowest
+        # tier an n-group's simultaneous transfer rides; one-tier
+        # groups keep the flat price.)
+        span = max(int(n_devices), step.participants)
+        if hasattr(machine, "ring_hop_time_us") and span > 1:
+            return machine.ring_hop_time_us(step.bytes_per_chip, span)
         return machine.p2p_time_us(step.bytes_per_chip)
     if step.kind == SLICE:
         # local carve-out: HBM-bound read+write of the kept shard, which
@@ -40,10 +53,12 @@ def step_cost_us(step, machine) -> float:
 def schedule_cost_us(schedule: ReshardSchedule, machine) -> float:
     """Total predicted wall time of the schedule in microseconds: moves
     run serially, each round re-issuing its step sequence."""
+    n_devices = len(schedule.new_mesh.device_ids)
     total = 0.0
     for move in schedule.moves:
         if move.noop:
             continue
-        per_round = sum(step_cost_us(s, machine) for s in move.steps)
+        per_round = sum(step_cost_us(s, machine, n_devices=n_devices)
+                        for s in move.steps)
         total += move.rounds * per_round
     return total

@@ -47,6 +47,15 @@ class ReshardResult:
     wall_s: float
     allgather_rounds: int = 0  # rounds lowered via the shard_map kernel
     transfer_rounds: int = 0   # rounds lowered via the transfer engine
+    # per-round measured wall timings as obs.calibrate rows
+    # (CollectiveCalibration, the same schema collective-bench emits:
+    # op/strategy/tier/bytes/measured_us next to the machine model's
+    # prediction when one was passed). Collected ONLY under
+    # apply_schedule(collect_timings=True) — each timed round host-syncs
+    # (block_until_ready), so the default path keeps rounds async. A
+    # report/trace artifact; the per-tier link fit's evidence is
+    # collective-bench's isolated tier_ring rows (docs/observability.md).
+    calibration_rows: list = dataclasses.field(default_factory=list)
 
 
 def _per_chip_bytes(arr) -> int:
@@ -101,13 +110,37 @@ def _pure_gather_dims(move: ArrayMove) -> Optional[list]:
     return dims or None
 
 
+def _transfer_tier(machine, n_devices: int) -> str:
+    """The tier label a cross-mesh transfer's traffic rides: the
+    outermost tier the target device group spans on a hierarchical
+    machine, "mesh" otherwise."""
+    if machine is None or not hasattr(machine, "tier_path"):
+        return "mesh"
+    path = machine.tier_path(max(1, n_devices))
+    return path[-1][0].name if path else machine.tiers[0].name
+
+
 def apply_schedule(tree, schedule: ReshardSchedule,
-                   new_plan: ShardingPlan) -> ReshardResult:
+                   new_plan: ShardingPlan, machine=None,
+                   collect_timings: bool = False) -> ReshardResult:
     """Move every leaf of `tree` per its scheduled ArrayMove. Leaves and
     moves are matched by flattened path; a leaf without a move is a
-    planner bug and raises."""
+    planner bug and raises.
+
+    Every non-noop move runs under an ``exec.transfer`` span and counts
+    its rounds on
+    ``ff_collective_lowered_total{strategy=transfer|allgather,tier=...}``.
+    ``collect_timings=True`` additionally times each round
+    (host-syncing it — the default stays async so XLA can overlap the
+    slice/transfer/update chain) into CollectiveCalibration rows on the
+    result, predicted side priced with `machine` when given."""
     import jax
     import jax.numpy as jnp
+
+    from ..obs.calibration import CollectiveCalibration
+    from ..obs.tracing import get_tracer
+    from ..runtime.collectives import lowered_counter
+    from .cost import step_cost_us
 
     t0 = time.perf_counter()
     flat = flatten_tree(tree)
@@ -119,6 +152,11 @@ def apply_schedule(tree, schedule: ReshardSchedule,
             f" (+{max(0, len(missing) - 5)} more)")
     same_mesh = schedule.old_mesh == schedule.new_mesh
     old_mesh = schedule.old_mesh.jax_mesh() if same_mesh else None
+    n_new_devices = len(schedule.new_mesh.device_ids)
+    tier = _transfer_tier(machine, n_new_devices)
+    tracer = get_tracer()
+    counter = lowered_counter()
+    rows: list = []
     out: Dict[str, object] = {}
     observed_peak = 0
     bytes_moved = 0
@@ -133,59 +171,97 @@ def apply_schedule(tree, schedule: ReshardSchedule,
         gather_dims = _pure_gather_dims(move) if same_mesh \
             and old_mesh is not None else None
         rounds = 1 if move.chunk_dim is None else move.rounds
-        if rounds == 1:
-            if gather_dims is not None:
-                from ..kernels.redistribute import allgather_dims
-
-                moved = allgather_dims(src, old_mesh, move.old, gather_dims)
-                moved = jax.device_put(moved, tgt)
-                n_allgather += 1
-            else:
-                moved = jax.device_put(src, tgt)
-                n_transfer += 1
-            observed_peak = max(observed_peak,
-                                _per_chip_bytes(src)
-                                + _per_chip_bytes(moved))
-            out[path] = moved
+        strategy = "allgather" if gather_dims is not None else "transfer"
+        if gather_dims is not None:
+            # an in-mesh gather's traffic rides the tiers ITS group
+            # spans (the gathered degrees), not the whole mesh's
+            participants = 1
+            for d in gather_dims:
+                participants *= move.old.degrees[d]
+            move_tier = _transfer_tier(machine, participants)
         else:
-            # the destination buffer is born SHARDED (out_shardings):
-            # jnp.zeros + device_put would transiently commit the whole
-            # array to one device, defeating the peak bound chunking
-            # exists to enforce
-            buf = jax.jit(lambda s=move.shape, d=src.dtype: jnp.zeros(
-                s, dtype=d), out_shardings=tgt)()
-            dim = move.chunk_dim
-            extent = int(move.shape[dim]) // rounds
-            for lo in range(0, rounds * extent, extent):
-                ch = jax.lax.slice_in_dim(src, lo, lo + extent, axis=dim)
+            participants, move_tier = n_new_devices, tier
+        predicted_round_us = (
+            sum(step_cost_us(s, machine, n_devices=n_new_devices)
+                for s in move.steps)
+            if machine is not None else float("nan"))
+        round_bytes = move.total_bytes_moved() / max(1, move.rounds)
+
+        def note_round(t0, chunk):
+            if not collect_timings:
+                return
+            jax.block_until_ready(chunk)
+            rows.append(CollectiveCalibration(
+                op=strategy, strategy=strategy, tier=move_tier,
+                bytes=round_bytes, participants=participants,
+                predicted_us=predicted_round_us,
+                measured_us=(time.perf_counter() - t0) * 1e6))
+
+        with tracer.span("exec.transfer", path=path, strategy=strategy,
+                         tier=move_tier, rounds=rounds,
+                         bytes=move.total_bytes_moved()):
+            if rounds == 1:
+                r0 = time.perf_counter()
                 if gather_dims is not None:
                     from ..kernels.redistribute import allgather_dims
 
-                    ch_t = allgather_dims(ch, old_mesh, move.old,
-                                          gather_dims)
-                    ch_t = jax.device_put(ch_t, tgt)
+                    moved = allgather_dims(src, old_mesh, move.old,
+                                           gather_dims)
+                    moved = jax.device_put(moved, tgt)
                     n_allgather += 1
                 else:
-                    ch_t = jax.device_put(ch, tgt)
+                    moved = jax.device_put(src, tgt)
                     n_transfer += 1
+                note_round(r0, moved)
                 observed_peak = max(observed_peak,
-                                    _per_chip_bytes(ch)
-                                    + _per_chip_bytes(ch_t))
-                buf = jax.lax.dynamic_update_slice_in_dim(
-                    buf, ch_t, lo, axis=dim)
-            out[path] = buf
+                                    _per_chip_bytes(src)
+                                    + _per_chip_bytes(moved))
+                out[path] = moved
+            else:
+                # the destination buffer is born SHARDED (out_shardings):
+                # jnp.zeros + device_put would transiently commit the
+                # whole array to one device, defeating the peak bound
+                # chunking exists to enforce
+                buf = jax.jit(lambda s=move.shape, d=src.dtype: jnp.zeros(
+                    s, dtype=d), out_shardings=tgt)()
+                dim = move.chunk_dim
+                extent = int(move.shape[dim]) // rounds
+                for lo in range(0, rounds * extent, extent):
+                    r0 = time.perf_counter()
+                    ch = jax.lax.slice_in_dim(src, lo, lo + extent,
+                                              axis=dim)
+                    if gather_dims is not None:
+                        from ..kernels.redistribute import allgather_dims
+
+                        ch_t = allgather_dims(ch, old_mesh, move.old,
+                                              gather_dims)
+                        ch_t = jax.device_put(ch_t, tgt)
+                        n_allgather += 1
+                    else:
+                        ch_t = jax.device_put(ch, tgt)
+                        n_transfer += 1
+                    note_round(r0, ch_t)
+                    observed_peak = max(observed_peak,
+                                        _per_chip_bytes(ch)
+                                        + _per_chip_bytes(ch_t))
+                    buf = jax.lax.dynamic_update_slice_in_dim(
+                        buf, ch_t, lo, axis=dim)
+                out[path] = buf
+        counter.inc(rounds, strategy=strategy, tier=move_tier)
         bytes_moved += move.total_bytes_moved()
     return ReshardResult(
         tree=unflatten_tree(out), schedule=schedule,
         observed_peak_bytes=int(observed_peak),
         bytes_moved=int(bytes_moved),
         wall_s=time.perf_counter() - t0,
-        allgather_rounds=n_allgather, transfer_rounds=n_transfer)
+        allgather_rounds=n_allgather, transfer_rounds=n_transfer,
+        calibration_rows=rows)
 
 
 def redistribute(tree, old_plan: ShardingPlan, new_plan: ShardingPlan, *,
                  peak_bytes: int, machine=None,
-                 check: bool = True) -> ReshardResult:
+                 check: bool = True,
+                 collect_timings: bool = False) -> ReshardResult:
     """THE primitive: move a live tree of device arrays from old_plan's
     layout to new_plan's under a per-chip scratch bound, with zero host
     round-trips. Plans the schedule, proves it through the FFTA06x
@@ -193,12 +269,14 @@ def redistribute(tree, old_plan: ShardingPlan, new_plan: ShardingPlan, *,
     or over-budget schedule — pass `machine` so the memory-fit check has
     an HBM figure), then applies it on device."""
     schedule = plan_redistribution(tree, old_plan, new_plan,
-                                   peak_bytes=peak_bytes)
+                                   peak_bytes=peak_bytes,
+                                   machine=machine)
     if check:
         from ..analysis import check_redistribution
 
         check_redistribution(schedule, machine=machine)
-    return apply_schedule(tree, schedule, new_plan)
+    return apply_schedule(tree, schedule, new_plan, machine=machine,
+                          collect_timings=collect_timings)
 
 
 def verify_live_tree(tree) -> Optional[str]:

@@ -54,6 +54,16 @@ class ReshardPlanError(ValueError):
     which the analysis gate reports as FFTA06x diagnostics."""
 
 
+# per-chip bytes one cross-tier TRANSFER round may ship over the
+# OUTERMOST tier it spans (docs/resharding.md): moves whose transfer
+# crosses a tier boundary (a 2-pod mesh's DCN) are chunked down to this
+# even when scratch memory would allow bigger rounds, so the slow-tier
+# transfer pipelines in bounded pieces instead of one multi-second
+# monolith. Deliberately equal to the FFTA071 per-step DCN pressure
+# threshold — the same "too much at once across the slow tier" judgment.
+TRANSFER_TIER_CHUNK_BYTES = 64e6
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Device mesh of one plan: global `jax.devices()` positions in mesh
@@ -381,9 +391,25 @@ def _chunking(shape: Sequence[int], itemsize: int, kept_degree: int,
     return fallback[0], fallback[1], fallback[2], True
 
 
+def transfer_chunk_bound(machine, n_devices: int, kept_degree: int,
+                         new_total: int) -> Optional[int]:
+    """The scratch-equivalent chunk bound a cross-tier TRANSFER adds
+    (None when the machine is flat or the device group never leaves its
+    innermost tier). A round ships chunk_bytes/new_total per chip across
+    the outermost tier; bounding that at TRANSFER_TIER_CHUNK_BYTES
+    translates to scratch = 2*chunk_bytes/kept <= 2*cap*new_total/kept
+    — the planner's currency."""
+    if machine is None or not hasattr(machine, "crosses_tier_boundary"):
+        return None
+    if n_devices <= 1 or not machine.crosses_tier_boundary(n_devices):
+        return None
+    return max(1, int(2 * TRANSFER_TIER_CHUNK_BYTES * max(1, new_total)
+                      // max(1, kept_degree)))
+
+
 def plan_move(path: str, shape: Tuple[int, ...], itemsize: int, dtype: str,
               old_plan: ShardingPlan, new_plan: ShardingPlan,
-              peak_bytes: int) -> ArrayMove:
+              peak_bytes: int, machine=None) -> ArrayMove:
     old = old_plan.spec_for(path, len(shape))
     new = new_plan.spec_for(path, len(shape))
     for d, size in enumerate(shape):
@@ -402,8 +428,23 @@ def plan_move(path: str, shape: Tuple[int, ...], itemsize: int, dtype: str,
     for d in range(len(shape)):
         if (old.degrees[d], old.axes[d]) == (new.degrees[d], new.axes[d]):
             kept *= old.degrees[d]
+    effective_peak = peak_bytes
+    if not same_mesh:
+        # cross-mesh moves land through a TRANSFER step; when the target
+        # group spans a tier boundary, chunk the rounds down so the slow
+        # tier moves bounded pieces (best-effort: a bound no chunking
+        # can meet falls back to the memory bound alone — the cap is a
+        # pipelining preference, not a legality limit)
+        cap = transfer_chunk_bound(
+            machine, len(new_plan.mesh.device_ids), kept,
+            new.total_degree())
+        if cap is not None:
+            effective_peak = min(peak_bytes, cap)
     rounds, chunk_dim, scratch, infeasible = _chunking(
-        shape, itemsize, kept, old, new, peak_bytes)
+        shape, itemsize, kept, old, new, effective_peak)
+    if infeasible and effective_peak < peak_bytes:
+        rounds, chunk_dim, scratch, infeasible = _chunking(
+            shape, itemsize, kept, old, new, peak_bytes)
     chunk_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
     if chunk_dim is not None:
         chunk_elems = chunk_elems // rounds
@@ -445,10 +486,12 @@ def unflatten_tree(flat: Dict[str, object]):
 
 def plan_redistribution(tree, old_plan: ShardingPlan,
                         new_plan: ShardingPlan, *,
-                        peak_bytes: int) -> ReshardSchedule:
+                        peak_bytes: int, machine=None) -> ReshardSchedule:
     """Schedule every leaf of `tree` (a nested dict of arrays) from
     old_plan's layout to new_plan's, each move bounded by `peak_bytes`
-    per-chip scratch."""
+    per-chip scratch. A hierarchical `machine` additionally caps each
+    cross-tier TRANSFER round at TRANSFER_TIER_CHUNK_BYTES over the
+    outermost tier (see transfer_chunk_bound)."""
     if peak_bytes < 1:
         raise ValueError(f"peak_bytes={peak_bytes}: need >= 1")
     moves = []
@@ -457,7 +500,7 @@ def plan_redistribution(tree, old_plan: ShardingPlan,
         shape = tuple(int(s) for s in arr.shape)
         moves.append(plan_move(path, shape, leaf_itemsize(arr.dtype),
                                str(arr.dtype), old_plan, new_plan,
-                               peak_bytes))
+                               peak_bytes, machine=machine))
     return ReshardSchedule(old_mesh=old_plan.mesh, new_mesh=new_plan.mesh,
                            moves=moves, peak_bytes=int(peak_bytes))
 

@@ -63,6 +63,19 @@ class Executor:
                                                     mesh.shape["stage"])
             self.pipeline_microbatches = max(
                 1, getattr(config, "pipeline_microbatches", 4))
+        # explicit collective lowering (runtime/collectives.py,
+        # docs/machine.md "Lowering"): turn the reduction_plan record
+        # into real per-tier grouped collectives inside the jitted train
+        # step. None = the GSPMD path; the reasons record why (what
+        # --collective-lowering explicit raises with, and what auto's
+        # fallback logs).
+        from .collectives import plan_grad_sync_lowering
+
+        self._manual_axes: frozenset = frozenset()
+        self.grad_sync_lowering, self._grad_sync_reasons = \
+            plan_grad_sync_lowering(config, graph, mesh,
+                                    self.reduction_plan,
+                                    pipeline_plan=self.pipeline_plan)
 
     # -- pipeline helpers --------------------------------------------------
     def _pp_key(self, j: int, r: int, op) -> str:
@@ -225,6 +238,11 @@ class Executor:
                               iter_seq_length=seq_length)
         ctx.decode_pos = decode_pos
         ctx.fill_kv_cache = fill_kv_cache
+        if self._manual_axes:
+            # tracing inside the explicit grad-sync shard_map body: the
+            # manual axes' constraints must not reach XLA (core/op.py)
+            ctx.manual_axes = self._manual_axes
+            ctx.in_shard_map = True
         # flatten state into ctx keyed by (op_name, var)
         for op_name, vars_ in state.items():
             for var, val in vars_.items():
@@ -299,6 +317,18 @@ class Executor:
             mvals["loss"] = loss
             return grads, mvals, new_state
 
+        if self.grad_sync_lowering is not None:
+            # explicit collective lowering: per-shard grads inside a
+            # data-manual shard_map, reduced with the planned per-tier
+            # collectives (runtime/collectives.py)
+            return self.grad_sync_lowering.wrap_gstep(self, gstep)
+        if getattr(self.config, "collective_lowering", "gspmd") \
+                == "explicit":
+            from .collectives import CollectiveLoweringError
+
+            raise CollectiveLoweringError(
+                "--collective-lowering explicit cannot lower this plan: "
+                + "; ".join(self._grad_sync_reasons))
         return gstep
 
     def build_train_step(self, optimizer, loss_fn, metrics: Metrics,
