@@ -24,7 +24,7 @@ from .refit import (DriftDetector, FittedCoefficients, FittedProfile,
                     FittedProfileError, FittedProfileMismatch, refit)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                        get_registry, iter_samples, parse_exposition,
-                       validate_exposition)
+                       render_labeled, render_merged, validate_exposition)
 from .stepstats import (StepStats, model_peak_tflops,
                         model_train_flops_per_step)
 from .tracing import (Tracer, disable_tracing, enable_tracing, get_tracer,
@@ -46,8 +46,8 @@ __all__ = [
     "DriftDetector", "FittedCoefficients", "FittedProfile",
     "FittedProfileError", "FittedProfileMismatch", "refit",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "get_registry", "iter_samples", "parse_exposition",
-    "validate_exposition",
+    "get_registry", "iter_samples", "parse_exposition", "render_labeled",
+    "render_merged", "validate_exposition",
     "StepStats", "model_peak_tflops", "model_train_flops_per_step",
     "Tracer", "disable_tracing", "enable_tracing", "get_tracer", "span",
     "traced_dispatch", "reset_all",

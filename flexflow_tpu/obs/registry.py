@@ -95,13 +95,19 @@ class _Family:
             self._values.pop(self._key(labels), None)
 
     # -- rendering --------------------------------------------------------
-    def _sample_lines(self) -> List[str]:
+    def _sample_lines(self, extra: Sequence[Tuple[str, str]] = ()) \
+            -> List[str]:
+        """Sample lines, optionally with extra (name, value) label pairs
+        PREPENDED to every series — how `render_merged` stamps each
+        replica's samples with its `replica` label."""
         items = self.items()
         if not items and not self.label_names:
             # an unlabeled family is born at 0 (prometheus-client
             # semantics) — a reset family renders 0, not nothing
             items = [((), 0.0)]
-        return [self._line(self.name, self.label_names, key, v)
+        names = tuple(n for n, _ in extra) + self.label_names
+        vals = tuple(v for _, v in extra)
+        return [self._line(self.name, names, vals + key, v)
                 for key, v in items]
 
     @staticmethod
@@ -193,6 +199,57 @@ class Histogram(_Family):
             ent = self._hist.get(key)
             return int(ent[-1]) if ent else 0
 
+    def snapshot(self, **labels) -> Tuple[float, ...]:
+        """Immutable copy of one labelset's cumulative row
+        ([bucket counts..., sum, count]; all-zero when never observed) —
+        the baseline for a windowed `quantile(since=)` read."""
+        key = self._key(labels)
+        with self._lock:
+            ent = self._hist.get(key)
+            if ent is None:
+                return (0.0,) * (len(self.buckets) + 2)
+            return tuple(ent)
+
+    def quantile(self, q: float, since: Optional[Sequence[float]] = None,
+                 **labels) -> float:
+        """Approximate q-quantile (q in [0, 1]) from the cumulative
+        buckets, linearly interpolated inside the landing bucket — what
+        the fleet autoscaler reads TTFT percentiles from without keeping
+        raw samples. Observations in the +Inf bucket clamp to the last
+        finite boundary (the histogram has no upper bound to interpolate
+        toward). 0.0 when nothing was observed.
+
+        `since`: a `snapshot()` baseline subtracted bucket-wise first, so
+        the quantile covers only observations AFTER the snapshot — the
+        buckets themselves never decay, and a control loop reading the
+        lifetime quantile would treat one historic slow period as a
+        permanent overload."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q={q}: want [0, 1]")
+        key = self._key(labels)
+        with self._lock:
+            ent = self._hist.get(key)
+            if ent is None or ent[-1] <= 0:
+                return 0.0
+            if since is not None and len(since) == len(ent):
+                ent = [max(0.0, a - b) for a, b in zip(ent, since)]
+                if ent[-1] <= 0:
+                    return 0.0
+            total = ent[-1]
+            rank = q * total
+            prev_le, prev_cum = 0.0, 0.0
+            for i, le in enumerate(self.buckets):
+                cum = ent[i]
+                if cum >= rank:
+                    if math.isinf(le):
+                        return prev_le
+                    if cum == prev_cum:
+                        return le
+                    frac = (rank - prev_cum) / (cum - prev_cum)
+                    return prev_le + frac * (le - prev_le)
+                prev_le, prev_cum = (0.0 if math.isinf(le) else le), cum
+            return prev_le
+
     def sum(self, **labels) -> float:
         key = self._key(labels)
         with self._lock:
@@ -207,7 +264,8 @@ class Histogram(_Family):
         with self._lock:
             self._hist.pop(self._key(labels), None)
 
-    def _sample_lines(self) -> List[str]:
+    def _sample_lines(self, extra: Sequence[Tuple[str, str]] = ()) \
+            -> List[str]:
         out = []
         with self._lock:
             # deep-copy the per-labelset lists INSIDE the lock: a
@@ -215,15 +273,20 @@ class Histogram(_Family):
             # and a lock-free read could emit a torn histogram
             # (bucket{+Inf} != count) that breaks rate()/quantile math
             items = sorted((k, list(v)) for k, v in self._hist.items())
+        ex_names = tuple(n for n, _ in extra)
+        ex_vals = tuple(v for _, v in extra)
         for key, ent in items:
-            names = self.label_names + ("le",)
+            names = ex_names + self.label_names + ("le",)
             for i, le in enumerate(self.buckets):
                 out.append(self._line(f"{self.name}_bucket", names,
-                                      tuple(key) + (_fmt(le),), ent[i]))
-            out.append(self._line(f"{self.name}_sum", self.label_names,
-                                  key, ent[-2]))
-            out.append(self._line(f"{self.name}_count", self.label_names,
-                                  key, ent[-1]))
+                                      ex_vals + tuple(key) + (_fmt(le),),
+                                      ent[i]))
+            out.append(self._line(f"{self.name}_sum",
+                                  ex_names + self.label_names,
+                                  ex_vals + key, ent[-2]))
+            out.append(self._line(f"{self.name}_count",
+                                  ex_names + self.label_names,
+                                  ex_vals + key, ent[-1]))
         return out
 
 
@@ -307,6 +370,79 @@ class MetricsRegistry:
     def render(self) -> str:
         """Prometheus exposition text for every family, sorted by name."""
         return "".join(fam.render() for fam in self.families())
+
+
+def render_labeled(
+        members: List[Tuple[Tuple[Tuple[str, str], ...],
+                            "MetricsRegistry"]]) -> str:
+    """One exposition document over MANY registries, each contributing
+    its samples with an (optionally empty) tuple of extra label pairs
+    prepended — the general form behind `render_merged` and the fleet
+    server's /metrics. Emitting one SINGLE # HELP/# TYPE header per
+    family name across all members is the point: a server whose default
+    registry already carries ff_serving_*/ff_kvpool_* families (a
+    non-fleet batcher in the same process) and whose fleet replicas
+    carry the same families replica-labeled must render ONE exposition,
+    not two concatenated documents with duplicate TYPE headers.
+
+    Same-name families across members must agree on kind, label schema,
+    and (for histograms) bucket boundaries — a mismatch is a loud
+    ValueError, never a silent sum of incompatible series. A family that
+    already declares one of its member's stamp labels is rejected too:
+    the stamp would be ambiguous."""
+    # family name -> (prototype family, [(label pairs, family), ...])
+    merged: Dict[str, Tuple[_Family,
+                            List[Tuple[Tuple[Tuple[str, str], ...],
+                                       _Family]]]] = {}
+    for pairs, reg in members:
+        for ln, _ in pairs:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid merge label name {ln!r}")
+        for fam in reg.families():
+            for ln, _ in pairs:
+                if ln in fam.label_names:
+                    raise ValueError(
+                        f"metric {fam.name!r} already carries a {ln!r}"
+                        f" label; merging under {ln!r} would be"
+                        " ambiguous")
+            proto_entry = merged.get(fam.name)
+            if proto_entry is None:
+                merged[fam.name] = (fam, [(pairs, fam)])
+                continue
+            proto = proto_entry[0]
+            if (proto.kind != fam.kind
+                    or proto.label_names != fam.label_names
+                    or getattr(proto, "buckets", None)
+                    != getattr(fam, "buckets", None)):
+                raise ValueError(
+                    f"metric-name collision on {fam.name!r}: registered as"
+                    f" {proto.kind}{proto.label_names} and"
+                    f" {fam.kind}{fam.label_names} in different"
+                    " registries; refusing to merge")
+            proto_entry[1].append((pairs, fam))
+    out = []
+    for name in sorted(merged):
+        proto, fams = merged[name]
+        out.append(f"# HELP {name} {escape_help(proto.help)}\n")
+        out.append(f"# TYPE {name} {proto.kind}\n")
+        for pairs, fam in fams:
+            for line in fam._sample_lines(extra=pairs):
+                out.append(line + "\n")
+    return "".join(out)
+
+
+def render_merged(registries: Dict[str, "MetricsRegistry"],
+                  label: str = "replica") -> str:
+    """One exposition document over MANY registries — the fleet /metrics
+    path: each serving replica owns a private MetricsRegistry (so its
+    ff_serving_*/ff_kvpool_* series never clobber a sibling's), and the
+    merged render stamps every sample with a `label`="<key>" pair under a
+    SINGLE # HELP/# TYPE header per family. Collision semantics are
+    `render_labeled`'s."""
+    if not _LABEL_RE.match(label):
+        raise ValueError(f"invalid merge label name {label!r}")
+    return render_labeled([(((label, key),), registries[key])
+                           for key in sorted(registries)])
 
 
 # -- the process-wide default registry ------------------------------------

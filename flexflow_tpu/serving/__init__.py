@@ -15,6 +15,9 @@ the same FFModel/PCG core instead of a parallel re-implementation:
 - sched/ (serving/sched/): continuous-batching generation — PagedKVPool,
   iteration-level ContinuousBatcher, AdmissionController backpressure,
   and the `serve-bench` load harness (docs/serving.md).
+- fleet/ (serving/fleet/): N replicas behind a prefix-affine Router with
+  SLO-aware admission (shed by predicted TTFT) and a zero-drop
+  Autoscaler over `request_resize` (docs/serving.md "Fleet").
 """
 from .model import InferenceModel
 from .batcher import BatcherStopped, DynamicBatcher
@@ -23,11 +26,17 @@ from .repository import ModelRepository
 from .optimize import fold_batchnorm
 from .sched import (AdmissionController, AdmissionError, ContinuousBatcher,
                     GenRequest, PagedKVPool, PoolSaturated, QueueFull,
-                    RequestCancelled, RequestState, RequestTooLarge)
+                    RequestCancelled, RequestState, RequestTooLarge,
+                    SLOExceeded, prefix_route_chain, prefix_route_key)
+from .fleet import (Autoscaler, FleetRequest, FleetUnavailable, Replica,
+                    ReplicaState, Router)
 
 __all__ = ["InferenceModel", "DynamicBatcher", "BatcherStopped",
            "InferenceServer", "ModelMetrics", "ModelRepository",
            "fold_batchnorm", "AdmissionController", "AdmissionError",
            "ContinuousBatcher", "GenRequest", "PagedKVPool",
            "PoolSaturated", "QueueFull", "RequestCancelled",
-           "RequestState", "RequestTooLarge"]
+           "RequestState", "RequestTooLarge", "SLOExceeded",
+           "prefix_route_chain", "prefix_route_key", "Autoscaler",
+           "FleetRequest", "FleetUnavailable", "Replica", "ReplicaState",
+           "Router"]

@@ -1,0 +1,32 @@
+"""Serving fleet (ISSUE 12): N model replicas behind one router.
+
+Every ingredient existed as a single-replica piece — ContinuousBatcher,
+PagedKVPool + PrefixCache, `request_resize` live mesh resize,
+AdmissionController, per-request metrics — and this package composes
+them into the millions-of-users serving tier (docs/serving.md "Fleet"):
+
+ - `Replica` (replica.py): one model behind its own batcher + private
+   MetricsRegistry + lifecycle state (READY/DRAINING/STOPPED).
+ - `Router` (router.py): prefix-cache-AFFINE routing — the PrefixCache's
+   rolling page-block hashes (`prefix_route_key`) are the routing key,
+   so a request lands on the replica that already owns its shared
+   prefix, falling back to sticky-key then least-loaded when cold — with
+   fleet-wide SLO admission that sheds by PREDICTED TTFT
+   (`SLOExceeded`, same typed-429 contract as queue/pool rejections) and
+   drain-with-handoff replica removal.
+ - `Autoscaler` (autoscaler.py): watches queue depth, page utilization,
+   and registry-read p99 TTFT, grows/shrinks individual replica meshes
+   via `request_resize` (zero drops, token-identical) and adds/drains
+   whole replicas under sustained load swings.
+
+The fleet's merged observability — one /metrics with a `replica` label,
+one aggregated /healthz — is `obs.render_merged` over
+`Router.replica_registries()` plus `Router.health()`; server.py wires
+both when a fleet is registered.
+"""
+from .autoscaler import Autoscaler
+from .replica import Replica, ReplicaState
+from .router import FleetRequest, FleetUnavailable, Router
+
+__all__ = ["Autoscaler", "FleetRequest", "FleetUnavailable", "Replica",
+           "ReplicaState", "Router"]

@@ -1,0 +1,573 @@
+"""Router: prefix-affine request routing over N serving replicas.
+
+The fleet's front door. A request is routed by the SAME addresses the
+PrefixCache files prefix pages under — `prefix_route_key` (kvpool.py) is
+a pure function of (tokens, page_size), so the router and every replica
+agree on a prompt's key without exchanging state:
+
+ 1. AFFINE: probe each READY replica's prefix cache
+    (`Replica.prefix_probe`); the deepest owner of the prompt's shared
+    prefix wins — its TTFT is O(suffix), everyone else's is O(prompt).
+ 2. STICKY: no replica owns pages yet (e.g. the tenant's first burst is
+    still prefilling), but the routing key was seen before — route to
+    the replica the key was assigned to, so one tenant's flood warms ONE
+    cache instead of spraying cold prefills across the fleet.
+ 3. LEAST-LOADED: cold key (or no full page) — lowest
+    `Replica.load_score()` wins.
+
+Admission is SLO-aware and fleet-wide: with `slo_ttft_s` set, a
+candidate whose PREDICTED time-to-first-token
+(`ContinuousBatcher.predicted_ttft_s`: queue backlog x measured prefill
+rate + the chunk-interleave term) exceeds the budget is skipped, and
+when EVERY ready replica predicts over budget the request is shed with
+`SLOExceeded` — same typed-429 contract as the queue/pool rejections, so
+server.py maps it with zero changes. Replica-level `QueueFull` /
+`PoolSaturated` fall through to the next candidate and only propagate
+when the whole fleet rejects.
+
+Drain with connection handoff: `drain(name)` marks the replica DRAINING
+(no new routes) and re-homes its QUEUED requests — submit the duplicate
+to a sibling FIRST, then cancel the original; whichever copy already
+reached a slot wins, so a request is never in zero places. The caller's
+`FleetRequest` handle rebinds transparently (greedy/seeded decode is a
+pure function of (prompt, seed), never of the replica that runs it, so a
+handoff is token-invisible).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...obs.registry import MetricsRegistry
+from ...obs.tracing import get_tracer
+from ..sched.admission import (AdmissionError, PoolSaturated, QueueFull,
+                               SLOExceeded)
+from ..sched.continuous import RequestCancelled
+from ..sched.kvpool import prefix_route_chain
+from .replica import Replica, ReplicaState
+
+_HANDOFF_REBIND_TIMEOUT_S = 10.0
+
+
+class FleetUnavailable(AdmissionError):
+    """No READY replica to route to (all draining/stopped/failed)."""
+
+    http_status = 503
+    reason = "no_ready_replica"
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "fleet has no ready replica" + (f": {detail}" if detail else ""))
+
+
+class FleetRequest:
+    """The caller's handle for one routed request: a GenRequest proxy
+    that survives drain handoff. Handoff only ever happens while the
+    inner request is still QUEUED (zero tokens emitted), so a rebind
+    restarts the stream cleanly and greedy tokens are identical on the
+    new replica."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int, eos_id,
+                 seed: int):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.seed = int(seed)
+        self.t_submit = time.monotonic()
+        self.route = ""          # routing decision label (affine/...)
+        self.handoffs = 0
+        self._cv = threading.Condition()
+        self._inner = None
+        self._replica: Optional[str] = None
+        self._version = 0
+
+    # -- router side -------------------------------------------------------
+    def _bind(self, replica_name: str, inner) -> None:
+        with self._cv:
+            if self._inner is not None:
+                self.handoffs += 1
+            self._inner = inner
+            self._replica = replica_name
+            self._version += 1
+            self._cv.notify_all()
+
+    def _snapshot(self):
+        with self._cv:
+            return self._inner, self._version
+
+    def _await_rebind(self, version: int) -> bool:
+        with self._cv:
+            return self._cv.wait_for(lambda: self._version != version,
+                                     timeout=_HANDOFF_REBIND_TIMEOUT_S)
+
+    # -- consumer API (GenRequest contract) --------------------------------
+    @property
+    def replica(self) -> Optional[str]:
+        with self._cv:
+            return self._replica
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            inner, version = self._snapshot()
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            try:
+                return inner.result(timeout=left)
+            except RequestCancelled:
+                # a drain handoff cancelled the queued inner: wait for
+                # the rebind and retry on the new replica's handle
+                if not self._await_rebind(version):
+                    raise
+
+    def stream(self, timeout: Optional[float] = None):
+        while True:
+            inner, version = self._snapshot()
+            try:
+                yield from inner.stream(timeout=timeout)
+                return
+            except RequestCancelled:
+                if not self._await_rebind(version):
+                    raise
+                # rebound: no token was emitted pre-handoff, restart
+
+    def done(self) -> bool:
+        inner, _ = self._snapshot()
+        return inner.done()
+
+    @property
+    def id(self):
+        inner, _ = self._snapshot()
+        return inner.id
+
+    @property
+    def tokens(self) -> List[int]:
+        inner, _ = self._snapshot()
+        return inner.tokens
+
+    @property
+    def error(self):
+        inner, _ = self._snapshot()
+        return inner.error
+
+    @property
+    def token_times(self) -> List[float]:
+        inner, _ = self._snapshot()
+        return inner.token_times
+
+    @property
+    def cache_hit(self) -> bool:
+        inner, _ = self._snapshot()
+        return inner.cache_hit
+
+    @property
+    def prefix_tokens(self) -> int:
+        inner, _ = self._snapshot()
+        return inner.prefix_tokens
+
+    @property
+    def queue_wait_s(self):
+        inner, _ = self._snapshot()
+        return inner.queue_wait_s
+
+    @property
+    def t_done(self):
+        inner, _ = self._snapshot()
+        return inner.t_done
+
+    @property
+    def t_first_token(self):
+        inner, _ = self._snapshot()
+        return inner.t_first_token
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit-to-first-token measured from the ROUTER's submit time:
+        a handoff's re-queue wait stays inside the number."""
+        inner, _ = self._snapshot()
+        if inner.t_first_token is None:
+            return None
+        return inner.t_first_token - self.t_submit
+
+
+class Router:
+    """N replicas behind one prefix-affine, SLO-admitted front door.
+
+    policy: "affine" (the default three-stage route above),
+    "least_loaded" (skip affinity — the cold-path order only), or
+    "round_robin" (the serve-bench baseline the affine win is asserted
+    against). All three share the same SLO shedding and rejection
+    fall-through.
+    """
+
+    POLICIES = ("affine", "least_loaded", "round_robin")
+
+    def __init__(self, policy: str = "affine",
+                 slo_ttft_s: Optional[float] = None, route_depth: int = 1,
+                 registry: Optional[MetricsRegistry] = None,
+                 on_load_failure: Optional[Callable] = None,
+                 max_affinity_keys: int = 65536):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"policy={policy!r}: choose from {self.POLICIES}")
+        self.policy = policy
+        self.slo_ttft_s = None if slo_ttft_s is None else float(slo_ttft_s)
+        if int(route_depth) < 1:
+            raise ValueError(f"route_depth={route_depth}: need >= 1")
+        self.route_depth = int(route_depth)
+        self.max_affinity_keys = max(1, int(max_affinity_keys))
+        self.registry = MetricsRegistry() if registry is None else registry
+        # called with (name, exception) when a replica factory fails —
+        # server.py wires this to record_load_failure so fleet load
+        # failures extend ff_model_load_failures_total and /healthz
+        self.on_load_failure = on_load_failure
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Replica] = {}
+        self._failed_loads: Dict[str, str] = {}
+        # route key -> replica name, LRU-bounded at max_affinity_keys
+        # (lifetime-unique tenants must not grow router memory without
+        # bound); _homes mirrors it as a per-replica key count so the
+        # least-loaded tie-break reads O(replicas), not O(keys)
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._homes: Dict[str, int] = {}
+        self._outstanding: Dict[str, List[FleetRequest]] = {}
+        self._rr = itertools.count()
+        self._page_size: Optional[int] = None
+        self._c_requests = self.registry.counter(
+            "ff_fleet_requests_total", "Requests routed, by replica",
+            labels=("replica",))
+        self._c_routes = self.registry.counter(
+            "ff_fleet_routes_total",
+            "Routing decisions by kind (affine/sticky/least_loaded/"
+            "round_robin)", labels=("decision",))
+        self._c_shed = self.registry.counter(
+            "ff_fleet_shed_total",
+            "Requests shed at the fleet door, by typed reason",
+            labels=("reason",))
+        self._c_handoffs = self.registry.counter(
+            "ff_fleet_handoffs_total",
+            "Queued requests re-homed off a draining replica")
+        self._g_replicas = self.registry.gauge(
+            "ff_fleet_replicas", "Replicas by lifecycle state",
+            labels=("state",))
+        self._sync_replica_gauge()
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, name: str, replica_or_factory) -> Optional[Replica]:
+        """Add a READY replica. `replica_or_factory` is a built Replica
+        or a zero-arg factory; a factory failure is recorded (the fleet
+        keeps serving on what it has, `health()` turns degraded, and the
+        on_load_failure hook feeds ff_model_load_failures_total) instead
+        of raised. Returns the replica, or None when the load failed."""
+        name = str(name)
+        if callable(replica_or_factory) \
+                and not isinstance(replica_or_factory, Replica):
+            try:
+                replica = replica_or_factory()
+            except Exception as exc:
+                with self._lock:
+                    self._failed_loads[name] = \
+                        f"{type(exc).__name__}: {exc}"
+                if self.on_load_failure is not None:
+                    self.on_load_failure(name, exc)
+                self._sync_replica_gauge()
+                return None
+        else:
+            replica = replica_or_factory
+        ps = replica.batcher.pool.page_size
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            if self._page_size is None:
+                self._page_size = ps
+            elif ps != self._page_size:
+                # routing keys are computed per page_size: a mismatched
+                # replica would never match the fleet's keys
+                raise ValueError(
+                    f"replica {name!r} page_size={ps} != fleet page_size"
+                    f"={self._page_size}; prefix-affine routing needs one"
+                    " page geometry")
+            self._replicas[name] = replica
+            self._failed_loads.pop(name, None)
+            self._outstanding.setdefault(name, [])
+        self._c_requests.inc(0, replica=name)
+        self._sync_replica_gauge()
+        return replica
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            return self._replicas[name]
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def replica_registries(self) -> Dict[str, MetricsRegistry]:
+        """{replica name: its private MetricsRegistry} — what the fleet
+        /metrics merges through obs.render_merged."""
+        with self._lock:
+            return {n: r.registry for n, r in self._replicas.items()}
+
+    def _ready(self) -> List[Tuple[str, Replica]]:
+        with self._lock:
+            return [(n, r) for n, r in self._replicas.items()
+                    if r.state is ReplicaState.READY]
+
+    def _sync_replica_gauge(self) -> None:
+        with self._lock:
+            counts = {s.value: 0 for s in ReplicaState}
+            for r in self._replicas.values():
+                counts[r.state.value] += 1
+            counts["failed_load"] = len(self._failed_loads)
+        for state, n in counts.items():
+            self._g_replicas.set(n, state=state)
+
+    # -- routing -----------------------------------------------------------
+    def _assign_affinity(self, key: str, name: str) -> None:
+        """Record `key`'s home (lock held): LRU move-to-end, evicting the
+        coldest key past max_affinity_keys, with `_homes` kept in step."""
+        old = self._affinity.pop(key, None)
+        if old is not None:
+            self._drop_home(old)
+        self._affinity[key] = name
+        self._homes[name] = self._homes.get(name, 0) + 1
+        while len(self._affinity) > self.max_affinity_keys:
+            _, evicted = self._affinity.popitem(last=False)
+            self._drop_home(evicted)
+
+    def _drop_home(self, name: str) -> None:
+        n = self._homes.get(name, 0) - 1
+        if n > 0:
+            self._homes[name] = n
+        else:
+            self._homes.pop(name, None)
+
+    def _route_order(self, prompt_len: int, key: str, chain: List[str],
+                     ready: List[Tuple[str, Replica]]):
+        """Candidate (name, replica, shared_tokens) list in routing
+        order, plus the decision label for the FIRST candidate. The
+        least-loaded order tie-breaks on how many affinity keys already
+        call the replica home — cold tenants spread across the fleet
+        instead of piling onto whichever replica sorts first. Affine
+        probes reuse the routing `chain` (hashed once per request) so an
+        N-replica probe never re-hashes the prompt N times."""
+        with self._lock:
+            homes = dict(self._homes)
+        by_load = sorted(ready, key=lambda nr: (nr[1].load_score(),
+                                                homes.get(nr[0], 0),
+                                                nr[0]))
+        if self.policy == "round_robin":
+            i = next(self._rr) % len(ready)
+            order = ready[i:] + ready[:i]
+            return [(n, r, 0) for n, r in order], "round_robin"
+        if self.policy == "affine":
+            probes = [(n, r, r.prefix_probe_chain(chain, prompt_len))
+                      for n, r in by_load]
+            best = max((p for _, _, p in probes), default=0)
+            if best > 0:
+                # deepest owner first; ties already load-ordered
+                probes.sort(key=lambda nrp: -nrp[2])
+                return probes, "affine"
+            if key:
+                with self._lock:
+                    sticky = self._affinity.get(key)
+                    if sticky is not None:
+                        self._affinity.move_to_end(key)  # key is active
+                if sticky is not None:
+                    for i, (n, r, _) in enumerate(probes):
+                        if n == sticky:
+                            return ([probes[i]] + probes[:i]
+                                    + probes[i + 1:]), "sticky"
+            return probes, "least_loaded"
+        return [(n, r, 0) for n, r in by_load], "least_loaded"
+
+    def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
+               seed: int = 0) -> FleetRequest:
+        """Route and admit one request. Raises a typed AdmissionError —
+        SLOExceeded when every ready replica predicts TTFT over budget,
+        FleetUnavailable when nothing is READY, or the last replica-level
+        rejection when the whole fleet refuses."""
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"fleet routing takes ONE prompt per request — expected"
+                f" shape (L,) or (1, L), got {prompt.shape}")
+        ready = self._ready()
+        if not ready:
+            self._c_shed.inc(reason=FleetUnavailable.reason)
+            raise FleetUnavailable(f"{len(self._replicas)} registered")
+        chain = prefix_route_chain(prompt, self._page_size) \
+            if self._page_size else []
+        key = chain[min(self.route_depth, len(chain)) - 1] if chain else ""
+        order, decision = self._route_order(prompt.size, key, chain, ready)
+        tracer = get_tracer()
+        with tracer.span("fleet.route", decision=decision,
+                         candidates=len(order)):
+            # SLO gate: drop candidates predicting over budget; if that
+            # empties the list, shed with the fleet-wide minimum
+            if self.slo_ttft_s is not None:
+                preds = [r.predicted_ttft_s(prompt.size, shared_tokens=sh)
+                         for _, r, sh in order]
+                kept = [c for c, p in zip(order, preds)
+                        if p <= self.slo_ttft_s]
+                if not kept:
+                    self._c_shed.inc(reason=SLOExceeded.reason)
+                    raise SLOExceeded(min(preds), self.slo_ttft_s,
+                                      scope=f"fleet of {len(order)}")
+                order = kept
+            last_err: Optional[AdmissionError] = None
+            for name, rep, _ in order:
+                try:
+                    inner = rep.submit(prompt, max_new_tokens,
+                                       eos_id=eos_id, seed=seed)
+                except (QueueFull, PoolSaturated) as e:
+                    last_err = e
+                    continue
+                fr = FleetRequest(prompt, max_new_tokens, eos_id, seed)
+                fr.route = decision
+                fr._bind(name, inner)
+                with self._lock:
+                    if key:
+                        self._assign_affinity(key, name)
+                    pend = self._outstanding.setdefault(name, [])
+                    pend[:] = [f for f in pend if not f.done()]
+                    pend.append(fr)
+                self._c_requests.inc(replica=name)
+                self._c_routes.inc(decision=decision)
+                return fr
+            self._c_shed.inc(reason=last_err.reason)
+            raise last_err
+
+    def cancel(self, fr: FleetRequest) -> bool:
+        """Best-effort cancel of a still-queued FleetRequest (the
+        all-or-nothing fan-in path in server.py). False once it reached
+        a slot or its replica is gone."""
+        inner, _ = fr._snapshot()
+        name = fr.replica
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            return False
+        return rep.cancel(inner)
+
+    # -- drain / removal ---------------------------------------------------
+    def drain(self, name: str) -> Dict[str, int]:
+        """Mark a replica DRAINING and hand its QUEUED requests off to
+        siblings. Zero-drop ordering: the duplicate is submitted to the
+        new replica BEFORE the original is cancelled, and whichever copy
+        already reached a slot wins — the request is never in zero
+        places. Active (decoding) requests finish where they are."""
+        with self._lock:
+            rep = self._replicas[name]
+            rep.mark_draining()
+            pending = [f for f in self._outstanding.get(name, ())
+                       if not f.done()]
+            # affinity entries pointing at the drained replica go stale:
+            # drop them so sticky routing re-learns a live home
+            self._affinity = OrderedDict(
+                (k, v) for k, v in self._affinity.items() if v != name)
+            self._homes.pop(name, None)
+        self._sync_replica_gauge()
+        handed = kept = 0
+        tracer = get_tracer()
+        for fr in pending:
+            inner, _ = fr._snapshot()
+            if fr.replica != name or inner.done():
+                continue
+            with tracer.span("fleet.handoff", replica=name):
+                try:
+                    new = self.submit(fr.prompt, fr.max_new_tokens,
+                                      eos_id=fr.eos_id, seed=fr.seed)
+                except AdmissionError:
+                    kept += 1  # siblings full: it stays queued here and
+                    continue   # the draining batcher still finishes it
+                new_inner, _ = new._snapshot()
+                if rep.cancel(inner):
+                    # original was still queued: the duplicate takes
+                    # over. Track the CALLER's handle on the new home —
+                    # not the router-internal duplicate wrapper — so a
+                    # later drain of THAT replica re-homes fr again
+                    # instead of rebinding a wrapper nobody holds
+                    fr._bind(new.replica, new_inner)
+                    with self._lock:
+                        pend = self._outstanding.setdefault(new.replica, [])
+                        pend[:] = [f for f in pend if f is not new]
+                        pend.append(fr)
+                    self._c_handoffs.inc()
+                    handed += 1
+                else:
+                    # original already reached a slot: discard the
+                    # duplicate (best-effort; if it too was scheduled it
+                    # decodes into the void, bounded by max_new_tokens)
+                    self.replica(new.replica).cancel(new_inner)
+                    with self._lock:
+                        pend = self._outstanding.get(new.replica)
+                        if pend is not None:
+                            pend[:] = [f for f in pend if f is not new]
+                    kept += 1
+        return {"handed_off": handed, "kept": kept}
+
+    def remove(self, name: str, timeout: Optional[float] = 60.0) -> None:
+        """Drain (if not already), wait for the replica to empty, stop
+        it, and forget it. Its registry stops rendering on /metrics."""
+        self.drain(name)
+        rep = self.replica(name)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while rep.live_sequences() or rep.queue_depth():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica {name!r} not drained within {timeout}s"
+                    f" ({rep.live_sequences()} live,"
+                    f" {rep.queue_depth()} queued)")
+            time.sleep(0.01)
+        rep.stop()
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._outstanding.pop(name, None)
+        self._c_requests.remove(replica=name)
+        self._sync_replica_gauge()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        for r in reps:
+            r.stop()
+        self._sync_replica_gauge()
+
+    # -- reporting ---------------------------------------------------------
+    def health(self) -> Dict[str, object]:
+        """Aggregate fleet health: "ok" only when every replica is READY
+        and nothing failed to load; "degraded" while any replica drains
+        or a load failure is outstanding; "down" with zero ready."""
+        with self._lock:
+            reps = dict(self._replicas)
+            failed = dict(self._failed_loads)
+        per = {n: r.health() for n, r in sorted(reps.items())}
+        ready = sum(1 for h in per.values() if h["state"] == "ready")
+        if ready == 0:
+            status = "down"
+        elif failed or any(h["state"] != "ready" for h in per.values()):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {"status": status, "ready": ready, "replicas": per,
+                "failed_loads": failed}
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            reps = dict(self._replicas)
+            affinity = len(self._affinity)
+        return {
+            "policy": self.policy,
+            "slo_ttft_s": self.slo_ttft_s,
+            "affinity_keys": affinity,
+            "health": self.health(),
+            "replicas": {n: r.stats() for n, r in sorted(reps.items())},
+        }

@@ -24,6 +24,17 @@ config.json fields:
   batch_buckets  optional; defaults to (1, 4, 16, ...) clamped to
                  max_batch_size — requests never pad past the built batch
   max_delay_ms   optional batching delay, default 2.0
+  serving        optional {"mode": "fleet", ...}: register the entry as
+                 a serving FLEET (serving/fleet/) instead of a
+                 DynamicBatcher — N continuous-batching replicas of the
+                 (generative) model behind a prefix-affine Router. Keys:
+                 replicas (default 2), max_len (required), num_slots /
+                 page_size / prefill_chunk_tokens / prefix_cache_pages /
+                 max_queue (per-replica batcher knobs), policy
+                 (default "affine"), slo_ttft_ms (optional SLO shed
+                 budget). A replica that fails to construct is recorded
+                 (ff_model_load_failures_total under "<name>/<replica>",
+                 /healthz degraded) while the rest keep serving.
 """
 from __future__ import annotations
 
@@ -133,6 +144,11 @@ class ModelRepository:
             try:
                 cfg = self.config(name)
                 model = self.build(name, cfg)
+                serving = cfg.get("serving") or {}
+                if serving.get("mode") == "fleet":
+                    self._register_fleet(server, name, model, serving)
+                    loaded.append(name)
+                    continue
                 # batching defaults derive from the batch the model was
                 # BUILT for — padding a request to a bucket larger than
                 # the declared batch would run the executor at a shape the
@@ -165,6 +181,47 @@ class ModelRepository:
                 continue
             loaded.append(name)
         return loaded
+
+    @staticmethod
+    def _register_fleet(server, name: str, model, serving: dict) -> None:
+        """Build a serving fleet from one repository entry: N replicas of
+        the built (generative) model behind a prefix-affine Router,
+        registered through server.register_fleet so /metrics merges the
+        per-replica registries and /healthz aggregates replica health.
+        Replicas share the one built model — each carries its own KV
+        pool, prefix cache, and registry (fleet/replica.py)."""
+        from .fleet import Replica, Router
+
+        if "max_len" not in serving:
+            raise ValueError(
+                f"{name}: fleet serving config needs 'max_len' (the"
+                " per-slot KV cache span)")
+        n = int(serving.get("replicas", 2))
+        if n < 1:
+            raise ValueError(f"{name}: replicas={n}: need >= 1")
+        slo_ms = serving.get("slo_ttft_ms")
+        router = Router(
+            policy=str(serving.get("policy", "affine")),
+            slo_ttft_s=None if slo_ms is None else float(slo_ms) / 1e3)
+        batcher_kw = {
+            k: serving[k]
+            for k in ("max_len", "num_slots", "page_size",
+                      "prefill_chunk_tokens", "prefix_cache_pages",
+                      "max_queue")
+            if k in serving
+        }
+        # register FIRST so the router's load-failure hook is wired
+        # before any replica factory can fail
+        server.register_fleet(name, router)
+        for i in range(n):
+            router.add_replica(
+                f"r{i}",
+                lambda i=i: Replica(f"r{i}", model, **batcher_kw))
+        if not router.replica_names():
+            # nothing came up: surface the entry itself as failed
+            server.unregister(name)
+            raise RuntimeError(
+                f"{name}: all {n} fleet replicas failed to load")
 
     def unload(self, server, name: str) -> None:
         server.unregister(name)

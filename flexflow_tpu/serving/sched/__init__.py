@@ -31,16 +31,18 @@ one-batch-at-a-time `GenerativeSession.generate`:
    scenarios (docs/serving.md).
 """
 from .admission import (AdmissionController, AdmissionError, QueueFull,
-                        PoolSaturated, RequestTooLarge)
+                        PoolSaturated, RequestTooLarge, SLOExceeded)
 from .continuous import (BatcherStopped, ContinuousBatcher, GenRequest,
                          RequestCancelled, RequestState, ResizeTicket)
 from .kvpool import (PagedKVPool, PoolExhausted, PrefixCache,
-                     derive_num_slots, kv_bytes_per_token, kv_cache_spec)
+                     derive_num_slots, kv_bytes_per_token, kv_cache_spec,
+                     prefix_route_chain, prefix_route_key)
 
 __all__ = [
     "AdmissionController", "AdmissionError", "QueueFull", "PoolSaturated",
     "RequestTooLarge", "BatcherStopped", "ContinuousBatcher", "GenRequest",
     "RequestCancelled", "RequestState", "ResizeTicket", "PagedKVPool",
-    "PoolExhausted", "PrefixCache", "derive_num_slots",
-    "kv_bytes_per_token", "kv_cache_spec",
+    "PoolExhausted", "PrefixCache", "SLOExceeded", "derive_num_slots",
+    "kv_bytes_per_token", "kv_cache_spec", "prefix_route_chain",
+    "prefix_route_key",
 ]

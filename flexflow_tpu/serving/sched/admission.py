@@ -75,6 +75,25 @@ class RequestTooLarge(AdmissionError):
     reason = "too_large"
 
 
+class SLOExceeded(AdmissionError):
+    """SLO-aware shedding (the fleet router's admission leg): every
+    candidate replica's PREDICTED time-to-first-token — queue backlog x
+    measured prefill rate plus the chunk-interleave term
+    (`ContinuousBatcher.predicted_ttft_s`) — exceeds the configured TTFT
+    budget. Same 429 contract as the queue/pool rejections: transient,
+    retry with backoff."""
+
+    reason = "slo_ttft"
+
+    def __init__(self, predicted_s: float, budget_s: float,
+                 scope: str = "fleet"):
+        self.predicted_s = float(predicted_s)
+        self.budget_s = float(budget_s)
+        super().__init__(
+            f"predicted TTFT {predicted_s * 1e3:.0f} ms exceeds the"
+            f" {budget_s * 1e3:.0f} ms SLO budget ({scope}); retry later")
+
+
 class AdmissionController:
     """Bounded queue + page budget over one PagedKVPool.
 

@@ -26,6 +26,13 @@ and drives one of three workloads (``--workload``):
    zero dropped requests, both resizes applied with >=1 in-flight
    sequence migrated, and every request's greedy tokens identical to a
    no-resize reference run.
+ - ``fleet`` (ISSUE 12, serving/fleet/bench.py): ``--replicas`` model
+   replicas behind the prefix-affine Router, driven by a shared-prefix
+   tenant mix through a diurnal load swing with the Autoscaler resizing
+   replica meshes live. HARD-ASSERTS zero drops across the autoscale
+   grow+shrink cycle (and a mid-burst replica drain/handoff), token
+   parity vs a no-resize run, affine p99 TTFT beating round-robin, and
+   a valid `replica`-labeled merged exposition.
 
 Hard checks for every workload (exit 1 on violation), which is what the
 CI `serving-load` job runs:
@@ -174,6 +181,14 @@ def run_continuous(model, workload, max_len: int, slots: int,
     dropped = sum(1 for h, w in zip(handles, workload)
                   if h.error is not None or len(h.tokens) != w["max_new"])
     ttfts = [h.ttft_s * 1e3 for h in handles if h.ttft_s is not None]
+    # split by prefix-cache outcome: the ff_serving_ttft_ms histogram has
+    # carried the `cache` label since the PrefixCache landed, but the
+    # summary used to collapse it — the hit/miss p99 split is what makes
+    # an affine-routing (or cache-sizing) win visible in one BENCH line
+    hit_ttfts = [h.ttft_s * 1e3 for h in handles
+                 if h.cache_hit and h.ttft_s is not None]
+    miss_ttfts = [h.ttft_s * 1e3 for h in handles
+                  if not h.cache_hit and h.ttft_s is not None]
     lats = [(h.t_done - h.t_submit) * 1e3 for h in handles
             if h.t_done is not None]
     waits = [h.queue_wait_s or 0.0 for h in handles]
@@ -184,6 +199,11 @@ def run_continuous(model, workload, max_len: int, slots: int,
         "dropped": dropped,
         "ttft_ms_p50": round(_pct(ttfts, 50), 2),
         "ttft_ms_p95": round(_pct(ttfts, 95), 2),
+        "ttft_ms_p99": round(_pct(ttfts, 99), 2),
+        "ttft_hit_ms_p99": round(_pct(hit_ttfts, 99), 2),
+        "ttft_miss_ms_p99": round(_pct(miss_ttfts, 99), 2),
+        "cache_hits": len(hit_ttfts),
+        "cache_misses": len(miss_ttfts),
         "latency_ms_p50": round(_pct(lats, 50), 2),
         "latency_ms_p95": round(_pct(lats, 95), 2),
         "max_queue_wait_s": round(max(waits), 3) if waits else 0.0,
@@ -296,8 +316,10 @@ def run_shared_prefix(model, workload, max_len: int, slots: int,
         "misses": len(miss_ttfts),
         "ttft_hit_ms_p50": round(_pct(hit_ttfts, 50), 2),
         "ttft_hit_ms_p95": round(_pct(hit_ttfts, 95), 2),
+        "ttft_hit_ms_p99": round(_pct(hit_ttfts, 99), 2),
         "ttft_miss_ms_p50": round(_pct(miss_ttfts, 50), 2),
         "ttft_miss_ms_p95": round(_pct(miss_ttfts, 95), 2),
+        "ttft_miss_ms_p99": round(_pct(miss_ttfts, 99), 2),
         "ttft_miss_over_hit_p50": round(
             _pct(miss_ttfts, 50) / _pct(hit_ttfts, 50), 2)
         if hit_ttfts and _pct(hit_ttfts, 50) > 0 else 0.0,
@@ -473,7 +495,7 @@ def run_bench(argv=None) -> int:
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
                     choices=("mixed", "shared-prefix", "long-prefill",
-                             "mesh-resize"))
+                             "mesh-resize", "fleet"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -523,6 +545,29 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--shrink-to", type=int, default=None,
                     help="mid-decode shrink target in slots"
                          " (mesh-resize; default slots // 2)")
+    # fleet workload (serving/fleet/bench.py): N replicas behind the
+    # prefix-affine router, shared-prefix tenant mix, diurnal swing with
+    # the autoscaler live; --requests is the session count and
+    # --prefix-groups the tenant count
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet replica count (fleet)")
+    ap.add_argument("--min-slots", type=int, default=None,
+                    help="autoscaler floor per replica"
+                         " (fleet; default slots // 2)")
+    ap.add_argument("--max-slots", type=int, default=None,
+                    help="autoscaler ceiling per replica"
+                         " (fleet; default 2 * slots)")
+    ap.add_argument("--slo-ttft", type=float, default=None,
+                    help="SLO admission budget in ms: shed when every"
+                         " replica's PREDICTED TTFT exceeds it (fleet;"
+                         " default: no SLO shedding)")
+    ap.add_argument("--affine-margin", type=float, default=1.2,
+                    help="require round-robin p99 TTFT / affine p99 TTFT"
+                         " >= this (fleet)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="static routing runs per policy; the best"
+                         " steady-state p99 of each is compared (fleet —"
+                         " outlier armor for shared runners)")
     args = ap.parse_args(argv)
 
     if args.workload == "shared-prefix":
@@ -531,6 +576,10 @@ def run_bench(argv=None) -> int:
         return _run_long_prefill_cli(args)
     if args.workload == "mesh-resize":
         return _run_mesh_resize_cli(args)
+    if args.workload == "fleet":
+        from ..fleet.bench import run_fleet_cli
+
+        return run_fleet_cli(args)
 
     window = args.prompt_max
     max_len = args.prompt_max + args.out_max
@@ -555,6 +604,9 @@ def run_bench(argv=None) -> int:
     print(f"[serve-bench] continuous: {cont['tokens']} tokens in"
           f" {cont['wall_s']}s = {cont['tokens_per_s']} tok/s |"
           f" ttft p50/p95 {cont['ttft_ms_p50']}/{cont['ttft_ms_p95']} ms |"
+          f" ttft p99 hit/miss {cont['ttft_hit_ms_p99']}/"
+          f"{cont['ttft_miss_ms_p99']} ms"
+          f" ({cont['cache_hits']}h/{cont['cache_misses']}m) |"
           f" latency p50/p95 {cont['latency_ms_p50']}/"
           f"{cont['latency_ms_p95']} ms | dropped={cont['dropped']}"
           f" starved={cont['starved']}")

@@ -378,6 +378,20 @@ class ContinuousBatcher:
         self._c_resizes = registry.counter(
             "ff_serving_resizes_total",
             "Applied serving mesh resizes", labels=("direction",))
+        # measured serving-rate model (docs/serving.md "Fleet"): EWMAs of
+        # per-token prefill cost (sampled at SYNCED prefill dispatches —
+        # one-shot and fused-final-chunk, which block on the picked token)
+        # and decode-iteration wall. `predicted_ttft_s` composes them into
+        # the SLO-admission estimate the fleet router sheds by.
+        self._ewma_prefill_s_per_tok: Optional[float] = None
+        self._ewma_decode_iter_s: Optional[float] = None
+        self._g_prefill_rate = registry.gauge(
+            "ff_serving_prefill_tokens_per_s",
+            "Measured prefill rate, EWMA over synced prefill dispatches",
+            labels=("pool",))
+        self._g_decode_iter = registry.gauge(
+            "ff_serving_decode_iter_ms",
+            "Measured decode-iteration wall, EWMA", labels=("pool",))
 
     # -- jitted device functions ------------------------------------------
     def _zero_caches(self):
@@ -758,6 +772,100 @@ class ContinuousBatcher:
             self._cv.notify_all()
         return ticket
 
+    # -- fleet probes ------------------------------------------------------
+    # The router tier (serving/fleet/) routes and sheds on these three
+    # read-only probes; they take no scheduler locks beyond the condition
+    # variable and never touch device state.
+    _EWMA_ALPHA = 0.25
+
+    def _observe_prefill(self, n_tokens: int, dt: float) -> None:
+        """One synced prefill dispatch covered `n_tokens` in `dt` seconds
+        (scheduler thread only)."""
+        if n_tokens <= 0 or dt <= 0:
+            return
+        sample = dt / n_tokens
+        old = self._ewma_prefill_s_per_tok
+        self._ewma_prefill_s_per_tok = sample if old is None else \
+            (1 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * sample
+        self._g_prefill_rate.set(
+            1.0 / self._ewma_prefill_s_per_tok, pool=self.pool.label)
+
+    def _observe_decode_iter(self, dt: float) -> None:
+        if dt <= 0:
+            return
+        old = self._ewma_decode_iter_s
+        self._ewma_decode_iter_s = dt if old is None else \
+            (1 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * dt
+        self._g_decode_iter.set(self._ewma_decode_iter_s * 1e3,
+                                pool=self.pool.label)
+
+    def prefix_probe(self, prompt_ids) -> int:
+        """Tokens of `prompt_ids` THIS batcher's prefix cache would
+        install from already-resident pages (probe only — no pin, no
+        hit/miss accounting; 0 when prefix reuse is off). The fleet
+        router's affinity signal: the replica with the deepest probe
+        already owns the prompt's shared prefix."""
+        if self.pool.prefix is None:
+            return 0
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        matched, _ = self.pool.prefix.match(prompt)
+        return int(min(matched, max(prompt.size - 1, 0)))
+
+    def prefix_probe_chain(self, chain, prompt_len: int) -> int:
+        """`prefix_probe` against a PRECOMPUTED `prefix_route_chain`: the
+        fleet router hashes each prompt once and probes every replica
+        with the same chain (PrefixCache.match_chain), so an N-replica
+        probe costs N dict walks, not N full-prompt re-hashings."""
+        if self.pool.prefix is None or not chain:
+            return 0
+        matched = self.pool.prefix.match_chain(chain) * self.pool.page_size
+        return int(min(matched, max(int(prompt_len) - 1, 0)))
+
+    def queued_prefill_tokens(self) -> int:
+        """Prompt tokens admitted but not yet prefilled: the whole wait
+        queue plus the unfilled remainder of every slot still in the
+        PREFILL state — the backlog term of `predicted_ttft_s`."""
+        with self._cv:
+            backlog = sum(int(r.prompt.size) for r in self._queue)
+            for s in self._slots:
+                if s is not None and s.req.state is RequestState.PREFILL:
+                    backlog += max(0, s.plen - s.filled)
+        return backlog
+
+    def predicted_ttft_s(self, prompt_len: int,
+                         shared_tokens: int = 0) -> float:
+        """Predicted time-to-first-token for a NEW request of
+        `prompt_len` tokens, from the measured rate model:
+
+            (backlog + own) tokens x EWMA per-token prefill cost
+          + ceil((backlog + own) / chunk) x EWMA decode-iteration wall
+
+        The first term is the queue-depth x measured-prefill-rate leg
+        (own = prompt minus `shared_tokens` the prefix cache would
+        install); the second is the chunk-interleave model — chunked
+        prefill runs one decode iteration between chunks whenever
+        anything is decoding, so every pending chunk costs one decode
+        wall on top of its own compute. A cold batcher (no samples yet)
+        predicts 0 and admits — the estimate only starts shedding once
+        it is backed by measurements."""
+        own = max(1, int(prompt_len) - max(0, int(shared_tokens)))
+        backlog = self.queued_prefill_tokens()
+        total = own + backlog
+        per_tok = self._ewma_prefill_s_per_tok
+        t = total * per_tok if per_tok is not None else 0.0
+        chunk = self.prefill_chunk_tokens
+        iter_s = self._ewma_decode_iter_s
+        if chunk and iter_s is not None:
+            with self._cv:
+                interleaved = len(self._queue) > 0 or any(
+                    s is not None and s.req.state is RequestState.DECODE
+                    for s in self._slots)
+            if interleaved:
+                import math as _math
+
+                t += _math.ceil(total / chunk) * iter_s
+        return t
+
     def stats(self) -> Dict[str, object]:
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
@@ -769,6 +877,9 @@ class ContinuousBatcher:
             "failed": self._failed,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "num_slots": self.num_slots,
+            "prefill_s_per_token": self._ewma_prefill_s_per_tok,
+            "decode_iter_s": self._ewma_decode_iter_s,
+            "queued_prefill_tokens": self.queued_prefill_tokens(),
             "resizes": list(self._resizes),
             "pool": self.pool.stats(),
             "admission": self.admission.stats(),
@@ -836,10 +947,12 @@ class ContinuousBatcher:
                     pos[s.slot] = s.pos
                     keys[s.slot] = s.key
                 with tracer.span("serve.decode", slots=len(active)):
+                    t0 = time.monotonic()
                     next_tok, self._caches = self._decode_fn(
                         params, state, self._caches, jnp.asarray(toks),
                         jnp.asarray(pos), jnp.asarray(keys))
-                    next_tok = np.asarray(next_tok)
+                    next_tok = np.asarray(next_tok)  # sync
+                    self._observe_decode_iter(time.monotonic() - t0)
                 now = time.monotonic()
                 for s in active:
                     self._h_itl.observe((now - s.t_last_emit) * 1e3)
@@ -1011,10 +1124,12 @@ class ContinuousBatcher:
                 padded[0, :plen] = req.prompt
                 with tracer.span("serve.prefill", request=req.id,
                                  tokens=plen):
+                    t0 = time.monotonic()
                     tok, self._caches = self._prefill_fn(
                         params, state, self._caches, jnp.asarray(padded),
                         slot_idx, plen, jnp.asarray(key))
-                    tok = int(tok)
+                    tok = int(tok)  # sync: the dispatch really ran
+                    self._observe_prefill(plen, time.monotonic() - t0)
                 s.pos = plen
                 s.last_tok = tok
                 self._first_token(s, tok)
@@ -1073,13 +1188,15 @@ class ContinuousBatcher:
                 # final chunk: fused chunk + cache-span scatter + first
                 # token — a prompt that fits one chunk costs ONE dispatch,
                 # like the one-shot path did
+                t0 = time.monotonic()
                 tok, self._caches = self._last_chunk_fn(
                     params, state, self._caches, s.small,
                     jnp.asarray(tokens), jnp.asarray(off, jnp.int32),
                     s.slot, jnp.asarray(s.plen - 1 - off, jnp.int32),
                     jnp.asarray(s.plen - 1, jnp.int32),
                     jnp.asarray(s.key))
-                tok = int(tok)
+                tok = int(tok)  # sync: int() blocks on the dispatch
+                self._observe_prefill(n, time.monotonic() - t0)
             s.small = None
             s.filled = s.pos = s.plen
             s.last_tok = tok

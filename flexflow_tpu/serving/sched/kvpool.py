@@ -73,6 +73,45 @@ def _chain_key(parent: bytes, block: np.ndarray) -> bytes:
     return h.digest()
 
 
+def prefix_route_chain(tokens, page_size: int = 16) -> List[str]:
+    """The rolling page-block hash chain of a prompt's FULL page-aligned
+    blocks as hex keys — exactly the addresses a `PrefixCache` files the
+    prompt's prefix pages under (`_chain_key`), computed WITHOUT a pool
+    instance or any device state. Chain position i is the key of blocks
+    0..i, so two prompts share precisely the keys of their common
+    page-aligned prefix. Empty for prompts shorter than one page.
+
+    This is the fleet router's routing alphabet: because the chain is a
+    pure function of (tokens, page_size), every replica — and the router
+    in front of them — computes IDENTICAL keys for identical prompts,
+    which is what makes prefix-affine routing a table lookup instead of a
+    broadcast probe."""
+    tokens = np.asarray(tokens)
+    if int(page_size) < 1:
+        raise ValueError(f"page_size={page_size}: need >= 1")
+    chain: List[str] = []
+    parent = b""
+    for b in range(int(tokens.size) // int(page_size)):
+        parent = _chain_key(parent,
+                            tokens[b * page_size:(b + 1) * page_size])
+        chain.append(parent.hex())
+    return chain
+
+
+def prefix_route_key(tokens, page_size: int = 16, depth: int = 1) -> str:
+    """Stable prefix-routing key for one prompt: the chain key of its
+    first `depth` full page-aligned token blocks (the shared-tenant
+    identity — requests that share a system prompt share it). "" when the
+    prompt has no full page; such requests route by load instead. See
+    `prefix_route_chain` for the contract."""
+    if int(depth) < 1:
+        raise ValueError(f"depth={depth}: need >= 1")
+    chain = prefix_route_chain(tokens, page_size=page_size)
+    if not chain:
+        return ""
+    return chain[min(int(depth), len(chain)) - 1]
+
+
 class _PrefixEntry:
     """One immutable, refcounted cached prefix page: the K/V rows of one
     page-aligned token block, resident in a band page. `refcount` counts
@@ -189,6 +228,21 @@ class PrefixCache:
         with self._lock:
             entries = self._walk(tokens)
             return len(entries) * self.page_size, list(entries)
+
+    def match_chain(self, chain: Sequence[str]) -> int:
+        """Depth (full pages) of the longest cached run of a precomputed
+        `prefix_route_chain` — the fleet router computes the chain ONCE
+        per request and probes every replica with it, instead of each
+        probe re-hashing the full prompt. Key-presence only (no token
+        re-verification, no pin): a routing hint, not a correctness
+        surface — the install path (`acquire`) re-verifies content."""
+        with self._lock:
+            depth = 0
+            for hexkey in chain:
+                if bytes.fromhex(hexkey) not in self._entries:
+                    break
+                depth += 1
+            return depth
 
     def acquire(self, seq_id, tokens,
                 max_pages: Optional[int] = None) -> Tuple[int, List[_PrefixEntry]]:

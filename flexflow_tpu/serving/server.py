@@ -139,6 +139,11 @@ class InferenceServer:
         # scheduling over the paged KV pool — requests from many clients
         # interleave in one decode batch instead of serializing on a lock
         self._continuous: Dict[str, object] = {}
+        # name -> fleet Router (serving/fleet/): N replicas behind
+        # prefix-affine routing + SLO admission; /metrics renders every
+        # replica's private registry merged under a `replica` label and
+        # /healthz aggregates replica health
+        self._fleets: Dict[str, object] = {}
         # elastic runtime event log (elastic/events.py), exported on
         # /metrics when attached
         self._elastic_events = None
@@ -185,6 +190,7 @@ class InferenceServer:
         b = self._models.pop(name, None)
         self._generative.pop(name, None)
         cb = self._continuous.pop(name, None)
+        fleet = self._fleets.pop(name, None)
         m = self._metrics.pop(name, None)
         if m is not None:
             m.remove_series()
@@ -192,6 +198,8 @@ class InferenceServer:
             b.stop()
         if cb is not None:
             cb.stop()
+        if fleet is not None:
+            fleet.shutdown()
 
     def models(self):
         return sorted(self._models)
@@ -235,10 +243,10 @@ class InferenceServer:
             raise ValueError(f"top_k={top_k}: must be >= 1")
         if float(temperature) < 0.0:
             raise ValueError(f"temperature={temperature}: must be >= 0")
-        if name in self._continuous:
+        if name in self._continuous or name in self._fleets:
             raise ValueError(
-                f"{name!r} already has a continuous batcher; pick one"
-                " serving mode per name")
+                f"{name!r} already has a continuous batcher or fleet;"
+                " pick one serving mode per name")
         self._generative[name] = (
             session, threading.Lock(),
             {"tokens_per_dispatch": max(1, int(tokens_per_dispatch)),
@@ -258,10 +266,10 @@ class InferenceServer:
         chunk-prefilled without stalling other clients' decodes. The
         batcher's decode policy (temperature/top_k) is fixed at
         construction — same compile-DoS rule as register_generative."""
-        if name in self._generative:
+        if name in self._generative or name in self._fleets:
             raise ValueError(
-                f"{name!r} already has a lockstep generative session;"
-                " pick one serving mode per name")
+                f"{name!r} already has a lockstep generative session or"
+                " fleet; pick one serving mode per name")
         old = self._continuous.get(name)
         if old is not None and old is not batcher:
             # re-registration (model reload): the old scheduler thread and
@@ -272,9 +280,35 @@ class InferenceServer:
             batcher.start()
         self._metrics_for(name)
 
+    def register_fleet(self, name: str, router) -> None:
+        """Register a fleet Router (serving/fleet/) for POST
+        /v2/models/<name>/generate: requests route prefix-affine across
+        the router's replicas with SLO-aware admission, AdmissionError
+        rejections (incl. SLOExceeded sheds) surface as typed HTTP
+        backpressure, and the fleet's observability fans in — /metrics
+        carries each replica's registry merged under a `replica` label
+        plus the router's own ff_fleet_* families, /healthz degrades
+        while any replica drains or fails to load. Replica load failures
+        reported by the router extend ff_model_load_failures_total under
+        "<name>/<replica>"."""
+        if name in self._generative or name in self._continuous:
+            raise ValueError(
+                f"{name!r} already has a serving mode; pick one per name")
+        old = self._fleets.get(name)
+        if old is not None and old is not router:
+            old.shutdown()
+        router.on_load_failure = (
+            lambda rep, exc, _name=name:
+            self.record_load_failure(f"{_name}/{rep}", exc))
+        self._fleets[name] = router
+        self._metrics_for(name)
+
     def generate(self, name: str, prompt_ids: np.ndarray,
                  max_new_tokens: int, eos_id: Optional[int] = None,
                  seed: int = 0):
+        if name in self._fleets:
+            return self._generate_fleet(
+                name, prompt_ids, max_new_tokens, eos_id=eos_id, seed=seed)
         if name in self._continuous:
             return self._generate_continuous(
                 name, prompt_ids, max_new_tokens, eos_id=eos_id, seed=seed)
@@ -329,11 +363,45 @@ class InferenceServer:
         finally:
             metrics.record((time.perf_counter() - t0) * 1e3, ok)
 
+    def _generate_fleet(self, name: str, prompt_ids, max_new_tokens,
+                        eos_id=None, seed: int = 0):
+        """The continuous fan-out contract over a fleet Router: ragged
+        rows become independent routed requests, admission is
+        all-or-nothing per HTTP request (a rejected row cancels its
+        accepted siblings best-effort before the error propagates)."""
+        router = self._fleets[name]
+        metrics = self._metrics_for(name)
+        prompts = _prompt_rows(prompt_ids)
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with get_tracer().span("serve.generate", model=name,
+                                   requests=len(prompts)):
+                reqs = []
+                try:
+                    for row in prompts:
+                        reqs.append(router.submit(
+                            row, max_new_tokens, eos_id=eos_id, seed=seed))
+                except Exception:
+                    for r in reqs:
+                        router.cancel(r)
+                    raise
+                out = [r.result(timeout=600.0).tolist() for r in reqs]
+            ok = True
+            return out
+        finally:
+            metrics.record((time.perf_counter() - t0) * 1e3, ok)
+
     def generate_stream(self, name: str, prompt_ids, max_new_tokens,
                         eos_id=None, seed: int = 0):
-        """Submit ONE prompt to a continuous batcher and return the
-        GenRequest handle — its .stream() yields tokens as the scheduler
-        emits them (the HTTP endpoint's "stream": true path)."""
+        """Submit ONE prompt to a continuous batcher (or fleet router)
+        and return the request handle — its .stream() yields tokens as
+        the scheduler emits them (the HTTP endpoint's "stream": true
+        path)."""
+        if name in self._fleets:
+            return self._fleets[name].submit(
+                np.asarray(prompt_ids, np.int32), max_new_tokens,
+                eos_id=eos_id, seed=seed)
         if name not in self._continuous:
             raise KeyError(f"no continuous batcher {name!r}")
         return self._continuous[name].submit(
@@ -347,6 +415,9 @@ class InferenceServer:
         if self._continuous:
             out["_continuous"] = {n: b.stats()
                                   for n, b in sorted(self._continuous.items())}
+        if self._fleets:
+            out["_fleet"] = {n: r.stats()
+                             for n, r in sorted(self._fleets.items())}
         if self._elastic_events is not None:
             out["_elastic"] = self._elastic_events.counts()
         analysis = self._analysis_counters()
@@ -407,11 +478,35 @@ class InferenceServer:
                 "Elastic runtime events by kind", labels=("kind",))
             for kind, n in self._elastic_events.counts().items():
                 c.set_total(n, kind=kind)
-        return self.registry.render() + REGISTRY.render()
+        if not self._fleets:
+            return self.registry.render() + REGISTRY.render()
+        # fleet observability fan-in: ONE exposition document over every
+        # source — this server's registry, the process-wide default, each
+        # fleet router's own families (ff_fleet_*), and EVERY replica's
+        # private registry stamped with a `replica` label. A single
+        # render_labeled pass emits one TYPE header per family name even
+        # when the default registry carries the same ff_serving_*/
+        # ff_kvpool_* families (a non-fleet batcher in the same process)
+        # — concatenating per-registry renders would duplicate the
+        # headers, which scrapers and validate_exposition reject.
+        from ..obs.registry import render_labeled
+
+        multi = len(self._fleets) > 1
+        members = [((), self.registry), ((), REGISTRY)]
+        for fname in sorted(self._fleets):
+            router = self._fleets[fname]
+            members.append(
+                (((("fleet", fname),) if multi else ()), router.registry))
+            for rname, reg in sorted(
+                    router.replica_registries().items()):
+                pairs = (("fleet", fname), ("replica", rname)) if multi \
+                    else (("replica", rname),)
+                members.append((pairs, reg))
+        return render_labeled(members)
 
     def shutdown(self):
         for name in (list(self._models) + list(self._generative)
-                     + list(self._continuous)):
+                     + list(self._continuous) + list(self._fleets)):
             self.unregister(name)
 
     # -- optional HTTP endpoint ---------------------------------------
@@ -441,17 +536,32 @@ class InferenceServer:
                     self._reply(200, {"models": server_ref.models()})
                 elif self.path == "/healthz":
                     # liveness + readiness in one: 200 with the serving
-                    # inventory; a registered-but-empty server is still
-                    # healthy (Triton's /v2/health/ready role)
-                    self._reply(200, {
-                        "status": "ok",
+                    # inventory (Triton's /v2/health/ready role). With a
+                    # fleet registered the status AGGREGATES per-replica
+                    # health — "degraded" while any replica is draining
+                    # or failed to load (the ff_model_load_failures_total
+                    # leg), "down" when a fleet has nothing ready.
+                    fleets = {n: r.health()
+                              for n, r in sorted(server_ref._fleets.items())}
+                    status = "ok"
+                    if server_ref._load_failures or any(
+                            f["status"] == "degraded"
+                            for f in fleets.values()):
+                        status = "degraded"
+                    if any(f["status"] == "down" for f in fleets.values()):
+                        status = "down"
+                    payload = {
+                        "status": status,
                         "models": server_ref.models(),
                         "generative": sorted(server_ref._generative),
                         "continuous": sorted(server_ref._continuous),
                         "load_failures": sorted(server_ref._load_failures),
                         "uptime_s": round(
                             time.time() - server_ref._start_time, 3),
-                    })
+                    }
+                    if fleets:
+                        payload["fleets"] = fleets
+                    self._reply(200, payload)
                 elif self.path == "/metrics":
                     body = server_ref.prometheus_text().encode()
                     self.send_response(200)
@@ -516,7 +626,8 @@ class InferenceServer:
                 if (len(parts) == 4 and parts[0] == "v2"
                         and parts[1] == "models"
                         and parts[3] == "generate"):
-                    continuous = parts[2] in server_ref._continuous
+                    continuous = (parts[2] in server_ref._continuous
+                                  or parts[2] in server_ref._fleets)
                     if not continuous and parts[2] not in server_ref._generative:
                         self._reply(
                             404, {"error": f"no generative session "

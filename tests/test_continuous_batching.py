@@ -22,7 +22,11 @@ from flexflow_tpu.serving.sched import (AdmissionController,
                                         QueueFull, RequestState,
                                         RequestTooLarge, derive_num_slots,
                                         kv_bytes_per_token)
+from tests.conftest import module_xla_cache
 from tests.test_generate import _build_lm
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
 
 
 @pytest.fixture(scope="module")

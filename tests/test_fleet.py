@@ -1,0 +1,503 @@
+"""Serving fleet (ISSUE 12): prefix-affine Router, SLO admission,
+drain handoff, Autoscaler, and the server/observability fan-in.
+
+The decisive properties:
+ - routing is a pure function of the PrefixCache's own page-block hash
+   addresses — a follower lands on the replica that owns its prefix;
+ - SLO admission sheds by PREDICTED TTFT (measured rate model), typed
+   as the same 429 family the queue/pool rejections use;
+ - drain re-homes queued requests with zero drops and the caller's
+   handle follows transparently;
+ - the autoscaler grows and shrinks replica meshes through
+   `request_resize` (zero drops, deferred shrink) and can add/retire
+   whole replicas;
+ - per-replica registries merge into ONE exposition under a `replica`
+   label, and /healthz aggregates replica health.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs.registry import validate_exposition
+from flexflow_tpu.serving.fleet import (Autoscaler, FleetUnavailable,
+                                        Replica, ReplicaState, Router)
+from flexflow_tpu.serving.sched import SLOExceeded
+from tests.conftest import module_xla_cache
+from tests.test_generate import _build_lm
+
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm(2, 12)
+
+
+def _mk_replica(lm, name, slots=2, max_len=48, page_size=4, max_queue=32,
+                **kw):
+    return Replica(name, lm, max_len=max_len, num_slots=slots,
+                   page_size=page_size, max_queue=max_queue, **kw)
+
+
+def _mk_fleet(lm, n=2, **kw):
+    router = Router(**{k: v for k, v in kw.items()
+                       if k in ("policy", "slo_ttft_s", "route_depth")})
+    rep_kw = {k: v for k, v in kw.items()
+              if k not in ("policy", "slo_ttft_s", "route_depth")}
+    for i in range(n):
+        router.add_replica(f"r{i}", _mk_replica(lm, f"r{i}", **rep_kw))
+    return router
+
+
+def _prompt(n, seed=0, vocab=50):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, vocab, size=(n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------
+def test_affine_routing_lands_on_prefix_owner(lm):
+    router = _mk_fleet(lm, 2)
+    try:
+        prefix = _prompt(8, seed=1)  # two full pages at page_size=4
+        lead = router.submit(np.concatenate([prefix, _prompt(3, seed=2)]),
+                             3)
+        lead.result(timeout=300)
+        home = lead.replica
+        # follower shares the prefix: must land on the owner, affine, hit
+        f = router.submit(np.concatenate([prefix, _prompt(3, seed=3)]), 3)
+        f.result(timeout=300)
+        assert f.replica == home
+        assert f.route == "affine"
+        assert f.cache_hit and f.prefix_tokens >= 8
+        # a different tenant spreads to the OTHER replica (cold -> least
+        # loaded with affinity-home tie-break)
+        other = router.submit(
+            np.concatenate([_prompt(8, seed=9), _prompt(3, seed=4)]), 3)
+        other.result(timeout=300)
+        assert other.replica != home
+    finally:
+        router.shutdown()
+
+
+def test_sticky_routing_before_cache_is_warm(lm):
+    router = _mk_fleet(lm, 2)
+    try:
+        prefix = _prompt(8, seed=5)
+        suffix = _prompt(3, seed=6)
+        lead = router.submit(np.concatenate([prefix, suffix]), 2)
+        # submitted back-to-back: the leader is still prefilling, so no
+        # cache pages exist yet — the key must still pin the follower to
+        # the leader's replica instead of spraying a duplicate prefill
+        follow = router.submit(np.concatenate([prefix, _prompt(3, 7)]), 2)
+        assert follow.route in ("sticky", "affine")
+        assert follow.replica == lead.replica
+        lead.result(timeout=300)
+        follow.result(timeout=300)
+    finally:
+        router.shutdown()
+
+
+def test_cold_short_prompts_route_least_loaded(lm):
+    router = _mk_fleet(lm, 2)
+    try:
+        # < 1 full page: no routing key at all
+        a = router.submit(_prompt(3, seed=10), 2)
+        b = router.submit(_prompt(3, seed=11), 2)
+        assert a.route == "least_loaded" and b.route == "least_loaded"
+        a.result(timeout=300)
+        b.result(timeout=300)
+    finally:
+        router.shutdown()
+
+
+def test_round_robin_policy_cycles(lm):
+    router = _mk_fleet(lm, 2, policy="round_robin")
+    try:
+        reqs = [router.submit(_prompt(4, seed=20 + i), 2)
+                for i in range(4)]
+        for r in reqs:
+            r.result(timeout=300)
+        assert [r.route for r in reqs] == ["round_robin"] * 4
+        assert {r.replica for r in reqs} == {"r0", "r1"}
+    finally:
+        router.shutdown()
+
+
+def test_fleet_unavailable_when_all_draining(lm):
+    router = _mk_fleet(lm, 1)
+    try:
+        router.drain("r0")
+        with pytest.raises(FleetUnavailable) as ei:
+            router.submit(_prompt(4), 2)
+        assert ei.value.http_status == 503
+    finally:
+        router.shutdown()
+
+
+def test_mismatched_page_size_rejected(lm):
+    router = _mk_fleet(lm, 1, page_size=4)
+    try:
+        with pytest.raises(ValueError, match="page geometry"):
+            router.add_replica("bad", _mk_replica(lm, "bad", page_size=8))
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# SLO admission
+# ---------------------------------------------------------------------
+def test_slo_sheds_by_predicted_ttft_only_after_measurement(lm):
+    router = _mk_fleet(lm, 1, slots=1, slo_ttft_s=1e-9)
+    try:
+        # COLD: no rate samples -> predicted 0 -> admitted despite the
+        # absurd budget (the estimate only sheds once it is backed by
+        # measurements)
+        first = router.submit(_prompt(6, seed=30), 2)
+        first.result(timeout=300)
+        rep = router.replica("r0")
+        assert rep.batcher.stats()["prefill_s_per_token"] is not None
+        # WARM: the measured model now predicts > 1e-9 s for any prompt
+        with pytest.raises(SLOExceeded) as ei:
+            router.submit(_prompt(6, seed=31), 2)
+        assert ei.value.http_status == 429
+        assert ei.value.reason == "slo_ttft"
+        assert router.registry.counter(
+            "ff_fleet_shed_total", labels=("reason",)).value(
+                reason="slo_ttft") == 1
+    finally:
+        router.shutdown()
+
+
+def test_predicted_ttft_grows_with_queue_backlog(lm):
+    rep = _mk_replica(lm, "solo", slots=1, max_queue=64)
+    try:
+        warm = rep.submit(_prompt(6, seed=32), 2)
+        warm.result(timeout=300)
+        base = rep.predicted_ttft_s(8)
+        assert base > 0
+        # a held queue inflates the backlog term
+        long_req = rep.submit(_prompt(6, seed=33), 40)
+        queued = [rep.submit(_prompt(8, seed=40 + i), 2)
+                  for i in range(4)]
+        loaded = rep.predicted_ttft_s(8)
+        assert loaded > base
+        assert rep.batcher.queued_prefill_tokens() > 0
+        for q in queued:
+            q.result(timeout=300)
+        long_req.result(timeout=300)
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------
+# drain / handoff
+# ---------------------------------------------------------------------
+def test_drain_hands_off_queued_requests_zero_drop(lm):
+    router = _mk_fleet(lm, 2, slots=1, max_queue=16)
+    try:
+        # pin both replicas' single slots with long decodes, then queue
+        # more work everywhere
+        pin = [router.submit(_prompt(5, seed=50 + i), 40)
+               for i in range(2)]
+        deadline = time.monotonic() + 120
+        while not all(p.tokens for p in pin):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        queued = [router.submit(_prompt(5, seed=60 + i), 3)
+                  for i in range(4)]
+        victim = queued[0].replica
+        stats = router.drain(victim)
+        assert router.replica(victim).state is ReplicaState.DRAINING
+        # every queued request on the victim either re-homed or stayed
+        # (sibling full) — and ALL of them finish with full token counts
+        assert stats["handed_off"] + stats["kept"] >= 1
+        for q in queued:
+            assert q.result(timeout=300).size == 3
+        for p in pin:
+            assert p.result(timeout=300).size == 40
+        handed = [q for q in queued if q.handoffs]
+        assert len(handed) == stats["handed_off"]
+        for q in handed:
+            assert q.replica != victim
+    finally:
+        router.shutdown()
+
+
+def test_second_drain_rehomes_the_callers_handle_again(lm):
+    """Regression: after a handoff the router must track the CALLER's
+    FleetRequest on the new home (not its internal duplicate wrapper),
+    or draining the new home re-homes the wrapper while the caller's
+    handle dies with RequestCancelled — a dropped request under the
+    zero-drop contract."""
+    router = _mk_fleet(lm, 3, slots=1, max_queue=16)
+    try:
+        pin = [router.submit(_prompt(5, seed=150 + i), 40)
+               for i in range(3)]
+        deadline = time.monotonic() + 120
+        while not all(p.tokens for p in pin):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        q = router.submit(_prompt(5, seed=160), 3)
+        first_home = q.replica
+        s1 = router.drain(first_home)
+        assert s1["handed_off"] == 1 and q.handoffs == 1
+        second_home = q.replica
+        assert second_home != first_home
+        s2 = router.drain(second_home)
+        assert s2["handed_off"] == 1 and q.handoffs == 2
+        assert q.replica not in (first_home, second_home)
+        assert q.result(timeout=300).size == 3
+        for p in pin:
+            assert p.result(timeout=300).size == 40
+    finally:
+        router.shutdown()
+
+
+def test_affinity_lru_is_bounded_and_homes_stay_consistent(lm):
+    """The affinity table must not grow with lifetime-unique tenants:
+    past max_affinity_keys the coldest key evicts, and the per-replica
+    homes counter the least-loaded tie-break reads stays in step."""
+    router = _mk_fleet(lm, 2)
+    router.max_affinity_keys = 4
+    try:
+        for i in range(10):
+            router.submit(
+                np.concatenate([_prompt(4, seed=200 + i),
+                                _prompt(2, seed=300 + i)]),
+                2).result(timeout=300)
+        with router._lock:
+            assert len(router._affinity) == 4
+            homes = dict(router._homes)
+        assert sum(homes.values()) == 4
+        assert set(homes) <= {"r0", "r1"}
+    finally:
+        router.shutdown()
+
+
+def test_remove_waits_for_drain_and_stops(lm):
+    router = _mk_fleet(lm, 2)
+    try:
+        r = router.submit(_prompt(5, seed=70), 3)
+        r.result(timeout=300)
+        name = r.replica
+        router.remove(name, timeout=120)
+        assert name not in router.replica_names()
+        assert len(router.replica_names()) == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------
+def test_autoscaler_grow_and_shrink_cycle(lm):
+    router = _mk_fleet(lm, 1, slots=2, max_queue=64)
+    asc = Autoscaler(router, min_slots=1, max_slots=4, grow_step=2,
+                     shrink_step=3, queue_hi=1, util_lo=0.9,
+                     idle_ticks_before_shrink=2,
+                     idle_ticks_before_drain=10**9)
+    try:
+        rep = router.replica("r0")
+        flood = [router.submit(_prompt(5, seed=80 + i), 6)
+                 for i in range(8)]
+        acts = asc.tick()
+        assert any(a["action"] == "grow" and a["to"] == 4 for a in acts)
+        for h in flood:
+            assert h.result(timeout=300).size == 6
+        deadline = time.monotonic() + 120
+        while asc.pending_resizes():
+            assert time.monotonic() < deadline
+            asc.tick()
+            time.sleep(0.02)
+        assert rep.num_slots() == 4
+        # idle now: shrink fires after the hysteresis ticks
+        shrunk = []
+        while rep.num_slots() != 1:
+            assert time.monotonic() < deadline
+            shrunk += [a for a in asc.tick() if a["action"] == "shrink"]
+            time.sleep(0.02)
+        assert shrunk and shrunk[0]["to"] == 1
+        # nothing was dropped by the whole cycle
+        assert rep.batcher.stats()["failed"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_adds_then_retires_replicas(lm):
+    router = _mk_fleet(lm, 1, slots=1, max_queue=64)
+    asc = Autoscaler(
+        router, min_slots=1, max_slots=1,  # mesh pinned: overload must
+        queue_hi=0, util_lo=0.9,           # add a REPLICA instead
+        replica_factory=lambda: _mk_replica(lm, "auto", slots=1),
+        max_replicas=2, min_replicas=1, idle_ticks_before_drain=2)
+    try:
+        flood = [router.submit(_prompt(5, seed=90 + i), 4)
+                 for i in range(4)]
+        acts = asc.tick()
+        assert any(a["action"] == "add_replica" for a in acts)
+        assert len(router.replica_names()) == 2
+        for h in flood:
+            h.result(timeout=300)
+        # sustained idleness retires one replica (drain + remove runs in
+        # the background; poll until the membership shrinks back)
+        deadline = time.monotonic() + 120
+        drained = False
+        while len(router.replica_names()) > 1:
+            assert time.monotonic() < deadline
+            drained = drained or any(a["action"] == "drain_replica"
+                                     for a in asc.tick())
+            time.sleep(0.02)
+        assert drained
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_ttft_slo_is_windowed_not_lifetime(lm):
+    """Regression: the TTFT SLO signal must read a sliding window, not
+    the lifetime-cumulative histogram — one historic slow burst would
+    otherwise read as overload forever (grow forever, shrink dead)."""
+    router = _mk_fleet(lm, 1, slots=2)
+    asc = Autoscaler(router, min_slots=1, max_slots=4, queue_hi=10**9,
+                     util_hi=2.0, util_lo=0.9, ttft_p99_slo_ms=50.0,
+                     idle_ticks_before_shrink=1,
+                     idle_ticks_before_drain=10**9)
+    try:
+        rep = router.replica("r0")
+        fam = rep.registry.get("ff_serving_ttft_ms")
+        fam.observe(5000.0, cache="miss")  # historic slow burst
+        assert rep.ttft_p99_ms() > 50.0   # lifetime read IS over the SLO
+        acts = asc.tick() + asc.tick()
+        # idle replica, burst outside the window: shrink, never grow
+        assert any(a["action"] == "shrink" for a in acts)
+        assert not any(a["action"] == "grow" for a in acts)
+        deadline = time.monotonic() + 120
+        while asc.pending_resizes():
+            assert time.monotonic() < deadline
+            asc.tick()
+            time.sleep(0.02)
+        # a FRESH breach (inside the window) still reads as overload
+        fam.observe(5000.0, cache="miss")
+        grown = []
+        while not grown:
+            assert time.monotonic() < deadline
+            grown = [a for a in asc.tick() if a["action"] == "grow"]
+            time.sleep(0.01)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# observability fan-in
+# ---------------------------------------------------------------------
+def test_merged_exposition_has_replica_label_and_validates(lm):
+    router = _mk_fleet(lm, 2)
+    try:
+        for i in range(3):
+            router.submit(_prompt(6, seed=100 + i), 2).result(timeout=300)
+        from flexflow_tpu.obs.registry import render_merged
+
+        text = router.registry.render() + render_merged(
+            router.replica_registries())
+        fams = validate_exposition(text)
+        ttft = fams["ff_serving_ttft_ms"]
+        assert all("replica" in lbls for _, lbls, _ in ttft["samples"])
+        assert {lbls["replica"] for _, lbls, _ in ttft["samples"]} \
+            <= {"r0", "r1"}
+        # the router's own families render exactly once
+        assert text.count("# TYPE ff_fleet_requests_total counter") == 1
+        assert text.count("# TYPE ff_serving_ttft_ms histogram") == 1
+    finally:
+        router.shutdown()
+
+
+def test_server_fleet_fanin_healthz_and_load_failures(lm):
+    import json
+    from urllib.request import urlopen
+
+    from flexflow_tpu.serving import InferenceServer
+
+    server = InferenceServer()
+    router = _mk_fleet(lm, 2)
+    server.register_fleet("lm", router)
+    # regression: a NON-fleet batcher in the same process registers the
+    # serving families in the process-wide default registry; the fleet
+    # /metrics must still render ONE exposition document with a single
+    # TYPE header per family (naive concatenation of the default render
+    # and the replica-merged render duplicated them)
+    from flexflow_tpu.obs.registry import REGISTRY
+
+    REGISTRY.gauge("ff_kvpool_pages_used", "KV pages in use",
+                   labels=("pool",)).set(1, pool="solo")
+    httpd = server.serve_http(port=0)
+    try:
+        port = httpd.server_address[1]
+        out = server.generate("lm", [[1, 2, 3], [4, 5]], 3)
+        assert [len(t) for t in out] == [3, 3]
+        with urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["fleets"]["lm"]["ready"] == 2
+        # a failed replica load flows into ff_model_load_failures_total
+        # and degrades /healthz
+        router.add_replica("bad", lambda: (_ for _ in ()).throw(
+            RuntimeError("no checkpoint")))
+        router.drain("r1")
+        with urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            health = json.loads(r.read())
+        assert health["status"] == "degraded"
+        assert health["fleets"]["lm"]["failed_loads"]
+        text = server.prometheus_text()
+        validate_exposition(text)
+        assert 'ff_model_load_failures_total{model="lm/bad"} 1' in text
+        assert 'replica="r0"' in text and "ff_fleet_requests_total" in text
+        # full-fleet failure -> "down"
+        router.drain("r0")
+        with urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            assert json.loads(r.read())["status"] == "down"
+    finally:
+        httpd.shutdown()
+        server.shutdown()
+
+
+def test_repository_fleet_entry_registers_router(lm):
+    from flexflow_tpu.serving import InferenceServer
+    from flexflow_tpu.serving.repository import ModelRepository
+
+    server = InferenceServer()
+    try:
+        ModelRepository._register_fleet(
+            server, "lm", lm,
+            {"mode": "fleet", "replicas": 2, "max_len": 48,
+             "num_slots": 2, "page_size": 4, "slo_ttft_ms": 60000.0})
+        router = server._fleets["lm"]
+        assert router.replica_names() == ["r0", "r1"]
+        assert router.slo_ttft_s == 60.0
+        out = server.generate("lm", [[1, 2, 3]], 2)
+        assert [len(t) for t in out] == [2]
+        # one serving mode per name
+        with pytest.raises(ValueError, match="serving mode"):
+            server.register_fleet("lm2", router) or \
+                server.register_continuous("lm", object())
+    finally:
+        server.shutdown()
+
+
+def test_stream_through_fleet(lm):
+    from flexflow_tpu.serving import InferenceServer
+
+    server = InferenceServer()
+    router = _mk_fleet(lm, 1)
+    server.register_fleet("lm", router)
+    try:
+        gen = server.generate_stream("lm", [1, 2, 3, 4], 4)
+        toks = list(gen.stream(timeout=300))
+        assert len(toks) == 4
+        assert toks == list(gen.tokens)
+    finally:
+        server.shutdown()

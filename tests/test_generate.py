@@ -1,10 +1,15 @@
 """KV-cache autoregressive generation (serving/generate.py): incremental
 decoding must reproduce the naive recompute-everything loop."""
 import numpy as np
+import pytest
 
 import flexflow_tpu as ff
 from flexflow_tpu.ffconst import CompMode
 from flexflow_tpu.serving.generate import GenerativeSession
+from tests.conftest import module_xla_cache
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
 
 
 def _build_lm(batch, window, vocab=50, hidden=32, heads=4, layers=2,

@@ -9,6 +9,7 @@ The decisive properties:
    applies immediately;
  - in-flight requests decode token-identically across a resize.
 """
+import threading
 import time
 
 import numpy as np
@@ -16,7 +17,11 @@ import pytest
 
 from flexflow_tpu.serving.sched import (ContinuousBatcher, PagedKVPool,
                                         PoolExhausted)
+from tests.conftest import module_xla_cache
 from tests.test_generate import _build_lm
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
 
 
 @pytest.fixture(scope="module")
@@ -219,6 +224,58 @@ def test_shrink_defers_until_live_fits_and_holds_admissions(lm):
         res = ticket.wait(timeout=300)
         assert res["to"] == 1 and b.num_slots == 1
         assert d.result(timeout=300).size == 2
+
+
+def test_concurrent_admissions_during_deferred_shrink_queue_not_429(lm):
+    """Regression (ISSUE 12 satellite): while a shrink DEFERS (live >
+    target), concurrent submits must be ADMITTED and held queued — the
+    admission gate only meters queue count and backlog pages, so a
+    pending resize must surface as waiting, never as a 429 — and every
+    held request must run once capacity returns."""
+    from flexflow_tpu.serving.sched import AdmissionError
+
+    b = ContinuousBatcher(lm, max_len=96, num_slots=3, page_size=4,
+                          max_queue=16)
+    with b:
+        # long enough that the deferred window is seconds wide — the
+        # mid-shrink asserts below must run while both are still live
+        long_a = b.submit(_prompts([5], seed=20)[0], 80)
+        long_b = b.submit(_prompts([5], seed=21)[0], 80)
+        deadline = time.monotonic() + 120
+        while not (long_a.tokens and long_b.tokens):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        ticket = b.request_resize(1)  # defers: 2 live > 1
+        errors = []
+        held = [None] * 4
+
+        def _submit(i):
+            try:
+                held[i] = b.submit(_prompts([4], seed=30 + i)[0], 2)
+            except AdmissionError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=_submit, args=(i,))
+                   for i in range(len(held))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # all admitted — zero 429s — but none scheduled while the shrink
+        # is pending (admissions are held, not rejected)
+        assert not errors
+        time.sleep(0.2)  # a buggy scheduler would run them right away
+        assert not ticket.done()
+        assert all(not h.tokens for h in held)
+        assert b.admission.queue_depth() == len(held)
+        assert b.queued_prefill_tokens() == sum(4 for _ in held)
+        # the decoders finish -> shrink applies -> the held queue drains
+        long_a.result(timeout=300)
+        long_b.result(timeout=300)
+        assert ticket.wait(timeout=300)["to"] == 1
+        for h in held:
+            assert h.result(timeout=300).size == 2
+        assert all(h.error is None for h in held)
 
 
 def test_resize_rejected_while_pending_and_after_stop(lm):

@@ -75,6 +75,107 @@ def test_histogram_bucket_mismatch_rejected():
     assert reg.histogram("ff_hb_ms", "h") is h
 
 
+def test_histogram_quantile_interpolates_and_clamps():
+    reg = MetricsRegistry()
+    h = reg.histogram("ff_q_ms", "q", buckets=(10.0, 100.0, 1000.0))
+    assert h.quantile(0.99) == 0.0  # nothing observed
+    for v in (5.0, 5.0, 50.0, 50.0):
+        h.observe(v)
+    # p50 lands on the 10ms bucket boundary (2 of 4 samples <= 10)
+    assert h.quantile(0.5) == pytest.approx(10.0)
+    # p75 interpolates inside (10, 100]
+    assert 10.0 < h.quantile(0.75) <= 100.0
+    # +Inf bucket clamps to the last finite boundary
+    h.observe(10_000.0)
+    assert h.quantile(1.0) == pytest.approx(1000.0)
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        h.quantile(1.5)
+    # labeled histograms quantile per labelset
+    lab = reg.histogram("ff_ql_ms", "q", labels=("cache",),
+                        buckets=(10.0, 100.0))
+    lab.observe(5.0, cache="hit")
+    assert lab.quantile(0.9, cache="hit") <= 10.0
+    assert lab.quantile(0.9, cache="miss") == 0.0
+
+
+def test_histogram_windowed_quantile_since_snapshot():
+    """quantile(since=snapshot) covers only observations AFTER the
+    snapshot — the windowed read the fleet autoscaler's TTFT SLO signal
+    uses (the buckets themselves never decay)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("ff_w_ms", "w", buckets=(10.0, 100.0, 1000.0))
+    # an unseen labelset snapshots as all-zero
+    base0 = h.snapshot()
+    assert set(base0) == {0.0}
+    h.observe(900.0)          # historic slow burst
+    snap = h.snapshot()
+    assert h.quantile(0.99) > 100.0              # lifetime sees it
+    assert h.quantile(0.99, since=snap) == 0.0   # window is empty
+    h.observe(5.0)
+    h.observe(5.0)
+    assert h.quantile(0.99, since=snap) <= 10.0  # window: fast only
+    assert h.quantile(0.99, since=base0) > 100.0  # pre-burst baseline
+    assert h.quantile(0.99) > 100.0              # lifetime unchanged
+
+
+def test_render_merged_stamps_replica_label_and_rejects_collisions():
+    from flexflow_tpu.obs import render_merged
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for reg, n in ((a, 3), (b, 5)):
+        reg.counter("ff_m_total", "c", labels=("outcome",)).inc(
+            n, outcome="ok")
+        reg.histogram("ff_m_ms", "h", buckets=(1.0, 10.0)).observe(n)
+        reg.gauge("ff_only_a" if reg is a else "ff_only_b", "g").set(n)
+    text = render_merged({"r0": a, "r1": b})
+    fams = validate_exposition(text)
+    # ONE TYPE header per family, every sample stamped
+    assert text.count("# TYPE ff_m_total counter") == 1
+    samples = fams["ff_m_total"]["samples"]
+    assert {(s[1]["replica"], s[1]["outcome"], s[2]) for s in samples} \
+        == {("r0", "ok", 3.0), ("r1", "ok", 5.0)}
+    assert all("replica" in s[1] for s in fams["ff_m_ms"]["samples"])
+    # families present in only one registry still render, stamped
+    assert 'ff_only_a{replica="r0"} 3' in text
+    # kind collision -> loud error, never a silent sum
+    c = MetricsRegistry()
+    c.gauge("ff_m_total", "now a gauge", labels=("outcome",))
+    with pytest.raises(ValueError, match="collision"):
+        render_merged({"r0": a, "r2": c})
+    # histogram bucket mismatch is a collision too
+    d = MetricsRegistry()
+    d.histogram("ff_m_ms", "h", buckets=(500.0,)).observe(1)
+    with pytest.raises(ValueError, match="collision"):
+        render_merged({"r0": a, "r3": d})
+    # a family already carrying the merge label is ambiguous
+    e = MetricsRegistry()
+    e.counter("ff_r_total", "c", labels=("replica",)).inc(replica="x")
+    with pytest.raises(ValueError, match="ambiguous"):
+        render_merged({"r0": e})
+
+
+def test_render_labeled_mixes_bare_and_stamped_members():
+    # the fleet /metrics fan-in shape: an UNSTAMPED member (the server /
+    # default registry) sharing a family name with replica-stamped
+    # members must render under ONE TYPE header, bare samples first-class
+    # alongside the labeled ones.
+    from flexflow_tpu.obs import render_labeled
+
+    base, r0, r1 = (MetricsRegistry() for _ in range(3))
+    for reg, v in ((base, 1), (r0, 2), (r1, 3)):
+        reg.gauge("ff_pages", "g", labels=("pool",)).set(v, pool="p")
+    text = render_labeled([((), base),
+                           ((("replica", "r0"),), r0),
+                           ((("replica", "r1"), ("fleet", "f")), r1)])
+    fams = validate_exposition(text)
+    assert text.count("# TYPE ff_pages gauge") == 1
+    got = {(s[1].get("replica"), s[1].get("fleet"), s[2])
+           for s in fams["ff_pages"]["samples"]}
+    assert got == {(None, None, 1.0), ("r0", None, 2.0), ("r1", "f", 3.0)}
+    with pytest.raises(ValueError, match="invalid merge label"):
+        render_labeled([((("bad-name!", "x"),), base)])
+
+
 def test_validate_exposition_rejects_garbage():
     with pytest.raises(ValueError):
         validate_exposition("ff_bad{unterminated 1\n")

@@ -17,8 +17,14 @@ import pytest
 from flexflow_tpu.serving.generate import GenerativeSession
 from flexflow_tpu.serving.sched import (AdmissionController,
                                         ContinuousBatcher, PagedKVPool,
-                                        PrefixCache, RequestTooLarge)
+                                        PrefixCache, RequestTooLarge,
+                                        prefix_route_chain,
+                                        prefix_route_key)
+from tests.conftest import module_xla_cache
 from tests.test_generate import _build_lm
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
 
 
 @pytest.fixture(scope="module")
@@ -30,6 +36,42 @@ def lm():
 def _prompts(lens, seed=0, vocab=50):
     rng = np.random.RandomState(seed)
     return [rng.randint(1, vocab, size=(n,)).astype(np.int32) for n in lens]
+
+
+# ---------------------------------------------------------------------
+# prefix_route_key: the fleet routing address (ISSUE 12 satellite)
+# ---------------------------------------------------------------------
+def test_prefix_route_key_identical_across_replicas():
+    """Routing correctness rests on every replica (and the router)
+    computing the SAME key for the same prompt, with no shared state:
+    the chain must equal the PrefixCache's own internal addresses, so a
+    routed request really does find its pages on the target replica."""
+    toks = np.arange(1, 15, dtype=np.int32)  # 3 full pages at size 4
+    chain = prefix_route_chain(toks, page_size=4)
+    assert len(chain) == 3
+    # two independent "replicas": separate cache instances, same prompt
+    c1 = PrefixCache(capacity_pages=8, page_size=4)
+    c2 = PrefixCache(capacity_pages=8, page_size=4)
+    for c in (c1, c2):
+        assert c.insert(toks, toks.size, lambda pairs: None) == 3
+    _, e1 = c1.match(toks)
+    _, e2 = c2.match(toks)
+    assert [e.key.hex() for e in e1] == chain
+    assert [e.key.hex() for e in e2] == chain
+    # pure function: recomputation and an independent caller agree
+    assert prefix_route_chain(toks, page_size=4) == chain
+    assert prefix_route_key(toks, page_size=4) == chain[0]
+    assert prefix_route_key(toks, page_size=4, depth=2) == chain[1]
+    assert prefix_route_key(toks, page_size=4, depth=99) == chain[-1]
+    # prompts sharing a page-aligned prefix share exactly that chain
+    other = np.concatenate([toks[:8], np.array([99, 98, 97, 96], np.int32)])
+    assert prefix_route_chain(other, page_size=4)[:2] == chain[:2]
+    assert prefix_route_chain(other, page_size=4)[2] != chain[2]
+    # no full page -> no key (route by load instead)
+    assert prefix_route_key(toks[:3], page_size=4) == ""
+    # geometry is part of the address: a different page size must not
+    # alias (the router enforces one fleet-wide page_size)
+    assert prefix_route_key(toks, page_size=8) != chain[0]
 
 
 # ---------------------------------------------------------------------
