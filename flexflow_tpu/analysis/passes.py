@@ -64,6 +64,14 @@ class AnalysisContext:
     # analysis of an explicit-lowered plan must describe the executed
     # schedule, not the record (docs/analysis.md).
     executed_reductions: Optional[Dict[str, str]] = None
+    # the executed BUCKET schedule ({op name: bucket id or None},
+    # GradSyncLowering.executed_buckets()) — the extended FFTA072 check
+    # compares it against the priced plan's bucket assignment
+    # (docs/machine.md "Overlap"): a lowering that regrouped, split, or
+    # dropped a priced bucket executes a schedule the overlap term
+    # never priced. None = no bucket comparison (GSPMD, or a
+    # pre-bucketing caller).
+    executed_buckets: Optional[Dict[str, Optional[int]]] = None
 
     def strategy_of(self, op):
         if not self.strategies:
@@ -414,9 +422,10 @@ DCN_STEP_BYTES_WARN = 64e6
 def check_executed_reductions(ctx: AnalysisContext) -> List[Diagnostic]:
     """FFTA072: with an explicit collective lowering active, the priced
     reduction plan and the executed schedule must describe the same
-    tensors the same way — an op the lowering dropped or renamed, or a
-    strategy it substituted, means every FFTA07x verdict (and the cost
-    model's grad-sync price) talks about a schedule that never ran."""
+    tensors the same way — an op the lowering dropped or renamed, a
+    strategy it substituted, or a BUCKET it regrouped (docs/machine.md
+    "Overlap"), means every FFTA07x verdict (and the cost model's
+    grad-sync/overlap price) talks about a schedule that never ran."""
     import math as _math
 
     diags: List[Diagnostic] = []
@@ -427,6 +436,17 @@ def check_executed_reductions(ctx: AnalysisContext) -> List[Diagnostic]:
     for name, entry in ctx.reduction_strategies.items():
         planned = (entry or {}).get("strategy", "flat")
         ran = executed.get(name)
+        # the lowering's DOCUMENTED conservative fallback is legal: when
+        # the plan's tier groups do not multiply to the sync degree
+        # (tier_path's round-up on a non-factoring mesh), the entry
+        # cannot be expressed as axis groups and syncs flat, un-bucketed
+        # — that is the lowering working as specified, not
+        # plan<->execution drift
+        groups = [int(t.get("group", 0))
+                  for t in (entry or {}).get("tiers", [])]
+        degree = int((entry or {}).get("degree") or 0)
+        expressible = bool(groups) and degree > 0 \
+            and _math.prod(groups) == degree
         if ran is None:
             diags.append(make_diag(
                 "FFTA072",
@@ -437,18 +457,8 @@ def check_executed_reductions(ctx: AnalysisContext) -> List[Diagnostic]:
                 hint="recompile so the lowering and the plan come from"
                      " the same graph; a rewrite that renames ops must"
                      " re-synthesize the reduction plan"))
-        elif ran != planned:
-            # the lowering's DOCUMENTED conservative fallback is legal:
-            # when the plan's tier groups do not multiply to the sync
-            # degree (tier_path's round-up on a non-factoring mesh),
-            # the entry cannot be expressed as axis groups and syncs
-            # flat — that is the lowering working as specified, not
-            # plan<->execution drift
-            groups = [int(t.get("group", 0))
-                      for t in (entry or {}).get("tiers", [])]
-            degree = int((entry or {}).get("degree") or 0)
-            expressible = bool(groups) and degree > 0 \
-                and _math.prod(groups) == degree
+            continue
+        if ran != planned:
             if ran == "flat" and not expressible:
                 continue
             diags.append(make_diag(
@@ -456,6 +466,30 @@ def check_executed_reductions(ctx: AnalysisContext) -> List[Diagnostic]:
                 f"reduction plan prices {name!r} as {planned} but the"
                 f" lowering executed {ran} — the analysis would judge a"
                 " schedule that never ran", ops_by_name.get(name)))
+            continue
+        # bucket-schedule check (docs/machine.md "Overlap"): the bucket
+        # the overlap term priced this tensor into must be the bucket
+        # the lowering fuses it into — a regrouped/split/dropped bucket
+        # overlaps differently than priced
+        if ctx.executed_buckets is not None:
+            planned_b = (entry or {}).get("bucket")
+            ran_b = ctx.executed_buckets.get(name)
+            # the ONLY legal divergence is the non-factoring flat
+            # fallback, which drops the bucket to None along with the
+            # decomposition — a non-expressible entry regrouped into a
+            # DIFFERENT bucket is still drift
+            if planned_b != ran_b and not (ran_b is None
+                                           and not expressible):
+                diags.append(make_diag(
+                    "FFTA072",
+                    f"reduction plan buckets {name!r} into"
+                    f" {planned_b!r} but the lowering fused it into"
+                    f" {ran_b!r} — the executed bucket schedule"
+                    " diverges from the priced overlap schedule",
+                    ops_by_name.get(name),
+                    hint="recompile so plan and lowering derive the"
+                         " bucket schedule from the same graph and"
+                         " --grad-bucket-bytes"))
     return diags
 
 

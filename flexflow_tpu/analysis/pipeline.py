@@ -49,6 +49,7 @@ def analyze_plan(graph: Graph,
                  final_guid: Optional[int] = None,
                  reduction_strategies: Optional[Dict[str, dict]] = None,
                  executed_reductions: Optional[Dict[str, str]] = None,
+                 executed_buckets: Optional[Dict[str, Optional[int]]] = None,
                  passes: Optional[Sequence[str]] = None) -> DiagnosticReport:
     """Run the pass pipeline; returns the DiagnosticReport (never raises).
 
@@ -62,7 +63,8 @@ def analyze_plan(graph: Graph,
                           config=config, batch_size=batch_size,
                           n_devices=n_devices, final_guid=final_guid,
                           reduction_strategies=reduction_strategies,
-                          executed_reductions=executed_reductions)
+                          executed_reductions=executed_reductions,
+                          executed_buckets=executed_buckets)
     names = list(passes) if passes is not None else list(ALL_PASSES)
     report = DiagnosticReport(passes_run=names)
     for name in names:

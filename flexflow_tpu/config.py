@@ -83,6 +83,17 @@ class FFConfig:
     # lowers explicitly only when supported AND the plan crosses a tier
     # boundary — otherwise it falls back to gspmd.
     collective_lowering: str = "gspmd"
+    # Gradient-sync bucket size target in bytes (docs/machine.md
+    # "Overlap"): on a multi-tier hierarchical machine, synced gradients
+    # are grouped into size-targeted buckets issued in backward
+    # production order, so each bucket's per-tier collective can overlap
+    # the remaining backward compute — the cost model prices the
+    # overlapped/exposed split and the explicit lowering executes the
+    # same bucket schedule (FFTA072 checks they agree). 0 disables
+    # bucketing (per-tensor issue, the pre-bucketing behavior); the
+    # knob is inert on flat machines and under
+    # search_overlap_backward_update=False (blocking pricing).
+    grad_bucket_bytes: int = 25 * 1024 * 1024
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     # Device pool. num_devices=None -> all visible JAX devices.
@@ -239,6 +250,13 @@ class FFConfig:
                         "--collective-lowering must be one of "
                         f"{COLLECTIVE_LOWERINGS}, got {v!r}")
                 self.collective_lowering = v
+            elif a == "--grad-bucket-bytes":
+                v = int(take())
+                if v < 0:
+                    raise ValueError(
+                        "--grad-bucket-bytes must be >= 0 (bytes; 0 "
+                        f"disables bucketing), got {v}")
+                self.grad_bucket_bytes = v
             elif a == "--kernel-residual-threshold":
                 v = float(take())
                 if not v > 0:

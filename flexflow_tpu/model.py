@@ -692,11 +692,22 @@ class FFModel:
         # the FFTA07x gate below, the executor, and any exported artifact
         # all see the one decomposition the simulator priced
         self._reduction_plan = None
+        # predicted grad-sync overlap split of the compiled plan
+        # (docs/machine.md "Overlap"): {total/overlapped/exposed_sync_us,
+        # buckets} — exported on the ff_grad_sync_overlap_us gauge
+        self._sync_overlap = None
         if (self.search_result is not None
                 and self.search_result.reduction_strategies):
             # the Unity search already synthesized the plan for these
             # exact strategies — reuse it rather than re-pricing
             self._reduction_plan = self.search_result.reduction_strategies
+            if self.search_result.exposed_sync_us is not None:
+                self._sync_overlap = {
+                    "overlapped_sync_us":
+                        self.search_result.overlapped_sync_us,
+                    "exposed_sync_us": self.search_result.exposed_sync_us,
+                    "buckets": self.search_result.sync_buckets,
+                }
         elif n_dev > 1:
             from .search.machine_model import make_machine_model as _mk
 
@@ -710,6 +721,34 @@ class FFModel:
                 self._reduction_plan = CostModel(
                     _machine, self.config).reduction_plan(self.graph,
                                                           _strats)
+                if any(e.get("bucket") is not None
+                       for e in self._reduction_plan.values()):
+                    # a bucketed plan's overlap split is a property of
+                    # the schedule, not just the record — simulate the
+                    # pinned strategies once so the gauge and the bench
+                    # surfaces report the split this compile priced
+                    from .search.simulator import Simulator as _Sim
+
+                    _sim = _Sim(_machine, self.config)
+                    _sim.simulate(self.graph, _strats)
+                    _st = _sim.last_sync_stats or {}
+                    self._sync_overlap = {
+                        "overlapped_sync_us":
+                            _st.get("overlapped_sync_us"),
+                        "exposed_sync_us": _st.get("exposed_sync_us"),
+                        "buckets": len(_st.get("buckets") or []),
+                    }
+        if self._sync_overlap is not None:
+            from .obs.registry import REGISTRY as _REG
+
+            _g = _REG.gauge(
+                "ff_grad_sync_overlap_us",
+                "Predicted grad-sync overlap split of the compiled plan",
+                labels=("kind",))
+            _g.set(float(self._sync_overlap["overlapped_sync_us"] or 0.0),
+                   kind="overlapped")
+            _g.set(float(self._sync_overlap["exposed_sync_us"] or 0.0),
+                   kind="exposed")
 
         # pre-flight plan sanitizer (analysis/): statically prove the chosen
         # plan legal before any XLA trace sees it — errors reject the plan,
@@ -873,6 +912,8 @@ class FFModel:
             reduction_strategies=getattr(self, "_reduction_plan", None),
             executed_reductions=(lowering.executed_plan()
                                  if lowering is not None else None),
+            executed_buckets=(lowering.executed_buckets()
+                              if lowering is not None else None),
             passes=passes,
         )
 
@@ -894,7 +935,8 @@ class FFModel:
         ctx = AnalysisContext(
             graph=self.graph,
             reduction_strategies=self._reduction_plan,
-            executed_reductions=lowering.executed_plan())
+            executed_reductions=lowering.executed_plan(),
+            executed_buckets=lowering.executed_buckets())
         report = DiagnosticReport(passes_run=["tiers"])
         report.extend(check_executed_reductions(ctx))
         if not report.diagnostics:

@@ -207,6 +207,57 @@ def max_pipeline_stages(graph: Graph) -> int:
     return find_isomorphic_run(graph)[0]
 
 
+def stage_placement_options(machine, dp: int, pp: int) -> List[Dict]:
+    """Candidate nestings of a (data, stage) mesh on `machine`, for the
+    Unity search's pipeline candidates (docs/machine.md "Overlap").
+
+    Mesh axes are row-major (core/machine.make_mesh), so the FIRST axis
+    varies slowest and owns contiguous device blocks:
+
+     - ``stage_inner`` (the historical layout): ``(data, stage)`` — a
+       stage's members are strided `pp` apart across the whole machine;
+       its dp groups stride across every tier `dp * pp` spans.
+     - ``stage_outer`` (tiered machines only): ``(stage, data)`` — each
+       stage owns a contiguous `dp`-device block, so when the innermost
+       tier's degree divides `dp` the stage CUT lands on a tier (pod)
+       boundary: the slow outer tier carries only the thin inter-stage
+       activation hop while each stage's dp weight syncs stay inside
+       the fast tier.
+
+    Each option reports `hop_inner` (the stage axis's device stride —
+    what tier_path prices the boundary hop with), `dp_inner` (the dp
+    sync group's stride inside a stage), `hop_tier` (the outermost tier
+    the hop crosses; None on non-tiered machines), and
+    `cut_on_tier_boundary`. One-tier hierarchies return only the
+    legacy nesting so they keep pricing bit-for-bit like the flat
+    models."""
+    tiered = hasattr(machine, "tier_path")
+    tiers = getattr(machine, "tiers", ())
+    multi = tiered and len(tiers) > 1
+
+    def info(order: str, axes, hop_inner: int, dp_inner: int) -> Dict:
+        d = {"order": order, "axes": axes, "hop_inner": hop_inner,
+             "dp_inner": dp_inner, "hop_tier": None,
+             "cut_on_tier_boundary": False}
+        if tiered:
+            path = machine.tier_path(pp, inner=hop_inner)
+            d["hop_tier"] = (path[-1][0].name if path
+                             else tiers[0].name)
+            if multi:
+                d["cut_on_tier_boundary"] = (
+                    order == "stage_outer"
+                    and dp % tiers[0].degree == 0)
+        return d
+
+    legacy = info("stage_inner", (("data", dp), ("stage", pp)),
+                  hop_inner=1, dp_inner=pp)
+    if not multi:
+        return [legacy]
+    outer = info("stage_outer", (("stage", pp), ("data", dp)),
+                 hop_inner=dp, dp_inner=1)
+    return [outer, legacy]
+
+
 def find_pipeline_plan(graph: Graph, n_stages: int) -> PipelinePlan:
     """Validated plan for `n_stages` stages, or a loud ValueError explaining
     why this graph cannot pipeline at that degree."""

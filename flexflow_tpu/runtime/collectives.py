@@ -66,6 +66,17 @@ def lowered_counter():
         labels=("strategy", "tier"))
 
 
+def overlap_bucket_counter():
+    """Bucketed grad-sync collectives lowered (docs/machine.md
+    "Overlap", docs/observability.md ff_grad_sync_overlap_*): one per
+    bucket — each bucket is ONE fused per-tier collective over its
+    concatenated tensors."""
+    return REGISTRY.counter(
+        "ff_grad_sync_overlap_buckets_total",
+        "Bucketed grad-sync collectives lowered, by reduction strategy",
+        labels=("strategy",))
+
+
 def tier_axis_groups(n: int, group_sizes: List[int]
                      ) -> List[List[List[int]]]:
     """Per-tier ``axis_index_groups`` along one mesh axis of size `n`.
@@ -149,7 +160,8 @@ class GradSyncLowering:
 
     axis_name: str
     degree: int
-    # op name -> {"strategy", "sizes": [inner..outer], "tiers": [names]}
+    # op name -> {"strategy", "sizes": [inner..outer], "tiers": [names],
+    # "bucket": priced bucket id or None (per-tensor), "bytes"}
     entries: Dict[str, Dict[str, Any]]
     mode: str = "explicit"
 
@@ -157,6 +169,25 @@ class GradSyncLowering:
         """{op name: strategy} as lowered — what the FFTA072 analysis
         check compares the priced reduction_plan against."""
         return {name: e["strategy"] for name, e in self.entries.items()}
+
+    def executed_buckets(self) -> Dict[str, Optional[int]]:
+        """{op name: bucket id (None = per-tensor)} as lowered — the
+        executed BUCKET schedule the extended FFTA072 check compares
+        against the priced plan's bucket assignment
+        (docs/analysis.md)."""
+        return {name: e.get("bucket")
+                for name, e in self.entries.items()}
+
+    def bucket_map(self) -> Dict[int, List[str]]:
+        """{bucket id: [op names]} over the bucketed entries, in entry
+        (topo) order — each bucket lowers as ONE fused collective over
+        its members' concatenated gradients."""
+        out: Dict[int, List[str]] = {}
+        for name, e in self.entries.items():
+            bid = e.get("bucket")
+            if bid is not None:
+                out.setdefault(bid, []).append(name)
+        return out
 
     def strategy_counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -176,12 +207,31 @@ class GradSyncLowering:
     def sync_tree(self, grads):
         """Reduce a {op: {weight: grad}} tree to the data-group MEAN with
         each op's planned strategy (ops absent from the plan sync flat —
-        the conservative legal default)."""
-        import jax
+        the conservative legal default).
 
-        out = {}
+        Bucketed entries (docs/machine.md "Overlap") lower as ONE fused
+        collective per bucket: the members' gradients are flattened and
+        concatenated, reduced with the bucket's per-tier strategy, and
+        split back. Buckets are independent of each other and each
+        depends only on its OWN members' gradients, so the issue order
+        is dependency-ordered: XLA's latency-hiding scheduler can fire
+        a bucket as soon as its last gradient is produced and overlap
+        it with the remaining backward. Tensors of distinct dtypes
+        inside one bucket reduce in per-dtype sub-collectives (no
+        casts, so numerics match the per-tensor path)."""
+        import jax
+        import jax.numpy as jnp
+
+        out: Dict[str, Dict[str, Any]] = {}
+        bucket_members: Dict[int, List[Tuple[str, str, Any]]] = {}
         for op_name, sub in grads.items():
             e = self.entries.get(op_name)
+            if e is not None and e.get("bucket") is not None:
+                out[op_name] = {}
+                for w_name, g in sub.items():
+                    bucket_members.setdefault(e["bucket"], []).append(
+                        (op_name, w_name, g))
+                continue
             strategy = e["strategy"] if e else "flat"
             sizes = tuple(e["sizes"]) if e else (self.degree,)
             groups = self._groups_for(sizes)
@@ -189,12 +239,34 @@ class GradSyncLowering:
                 lambda g: lower_allreduce(
                     g, self.axis_name, strategy, list(sizes), groups)
                 / self.degree, sub)
+        for bid in sorted(bucket_members):
+            members = bucket_members[bid]
+            # bucket mates share one sync key, hence one strategy and
+            # tier decomposition (simulator.plan_sync_buckets)
+            e0 = self.entries[members[0][0]]
+            strategy, sizes = e0["strategy"], tuple(e0["sizes"])
+            groups = self._groups_for(sizes)
+            by_dtype: Dict[Any, List[Tuple[str, str, Any]]] = {}
+            for m in members:
+                by_dtype.setdefault(jnp.asarray(m[2]).dtype, []).append(m)
+            for _dt, ms in by_dtype.items():
+                flat = jnp.concatenate([g.reshape(-1) for _, _, g in ms])
+                red = lower_allreduce(flat, self.axis_name, strategy,
+                                      list(sizes), groups) / self.degree
+                off = 0
+                for op_name, w_name, g in ms:
+                    n = int(g.size)
+                    out[op_name][w_name] = red[off:off + n].reshape(
+                        g.shape)
+                    off += n
         return out
 
     def record(self) -> None:
         """Count every lowered tensor on
-        ff_collective_lowered_total{strategy,tier} and emit the
-        exec.grad_sync span carrying the executed schedule. Once per
+        ff_collective_lowered_total{strategy,tier} (plus each bucket on
+        ff_grad_sync_overlap_buckets_total{strategy}) and emit the
+        exec.grad_sync span carrying the executed schedule, with one
+        exec.grad_sync.bucket child span per fused bucket. Once per
         lowering: the train/multi/accumulation step builders share one
         schedule — the counter reflects the schedule, not the number of
         jitted entry points built over it."""
@@ -202,13 +274,26 @@ class GradSyncLowering:
             return
         self._recorded = True
         c = lowered_counter()
-        with get_tracer().span(
+        buckets = self.bucket_map()
+        tracer = get_tracer()
+        with tracer.span(
                 "exec.grad_sync", mode=self.mode, axis=self.axis_name,
                 degree=self.degree, tensors=len(self.entries),
+                buckets=len(buckets),
                 strategies=self.strategy_counts()):
             for e in self.entries.values():
                 for tier in (e["tiers"] or ["mesh"]):
                     c.inc(strategy=e["strategy"], tier=tier)
+            bc = overlap_bucket_counter()
+            for bid, names in sorted(buckets.items()):
+                e0 = self.entries[names[0]]
+                bc.inc(strategy=e0["strategy"])
+                with tracer.span("exec.grad_sync.bucket", bucket=bid,
+                                 tensors=len(names),
+                                 strategy=e0["strategy"],
+                                 bytes=sum(self.entries[n].get("bytes")
+                                           or 0 for n in names)):
+                    pass
 
     def wrap_gstep(self, executor, gstep):
         """Wrap the executor's unjitted gradient core so it computes
@@ -345,7 +430,7 @@ def plan_grad_sync_lowering(config, graph, mesh, reduction_plan,
         if not op.weights:
             continue
         e = plan.get(op.name)
-        strategy, sizes, tiers = "flat", [dp], []
+        strategy, sizes, tiers, bucket = "flat", [dp], [], None
         if e:
             tier_list = e.get("tiers") or []
             cand = [int(t["group"]) for t in tier_list]
@@ -353,11 +438,18 @@ def plan_grad_sync_lowering(config, graph, mesh, reduction_plan,
                 strategy = str(e.get("strategy", "flat"))
                 sizes = cand
                 tiers = [str(t["tier"]) for t in tier_list]
+                # the priced bucket schedule rides along (docs/machine.md
+                # "Overlap"): bucket mates fuse into one collective in
+                # sync_tree; a non-expressible entry drops its bucket
+                # with the rest of the decomposition (the documented
+                # flat fallback FFTA072 tolerates)
+                bucket = e.get("bucket")
             # a decomposition that does not multiply to the axis degree
             # (conservative tier_path round-up) stays flat — legal, just
             # not decomposed
         entries[op.name] = {"strategy": strategy, "sizes": sizes,
-                            "tiers": tiers}
+                            "tiers": tiers, "bucket": bucket,
+                            "bytes": float((e or {}).get("bytes") or 0.0)}
     if mode == "auto" and not any(len(e["sizes"]) > 1
                                   for e in entries.values()):
         return None, ("auto: no cross-tier reduction to decompose — the"

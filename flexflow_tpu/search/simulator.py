@@ -217,6 +217,31 @@ def sp_shardable(op: Op, sp: int) -> bool:
     return op.outputs[0].dims[1] % sp == 0
 
 
+def plan_sync_buckets(items: List[Tuple[Op, "OpStrategy", Tuple, float]],
+                      bucket_bytes: float) -> List[Dict[str, Any]]:
+    """Greedy size-targeted bucketing of grad-sync tensors in issue
+    order (docs/machine.md "Overlap"): tensors share a bucket only when
+    their sync `key` (degree, inner stride, comm channels) matches; a
+    bucket closes once it reaches `bucket_bytes` (a single tensor larger
+    than the target gets a bucket of its own). Returns
+    [{key, ops: [(op, strategy)], bytes}] in issue order — bucket ids
+    are list positions. Deterministic and timing-free, so the simulator,
+    the reduction plan, and the runtime lowering derive the SAME
+    schedule from the same items."""
+    buckets: List[Dict[str, Any]] = []
+    pending: Dict[Tuple, Dict[str, Any]] = {}
+    for op, s, key, bytes_ in items:
+        cur = pending.get(key)
+        if cur is None:
+            cur = pending[key] = {"key": key, "ops": [], "bytes": 0.0}
+            buckets.append(cur)
+        cur["ops"].append((op, s))
+        cur["bytes"] += bytes_
+        if cur["bytes"] >= bucket_bytes:
+            del pending[key]  # full: the next same-key tensor opens anew
+    return buckets
+
+
 class CostModel:
     """Analytic per-op + per-edge costs under a strategy."""
 
@@ -591,6 +616,97 @@ class CostModel:
                 strategy=self.reduction_mode)
         return self.machine.allreduce_time_us(wb, sync)
 
+    # -- bucketed/async gradient reduction (docs/machine.md "Overlap") ----
+    def bucket_target(self) -> float:
+        """Byte target of grad-sync bucketing, or 0 when pricing stays
+        per-tensor. Bucketing is active only where it is executed and
+        where it cannot disturb pinned pricing parities: a MULTI-tier
+        hierarchical machine (one-tier hierarchies price bit-for-bit
+        like the flat models, and the flat models must keep agreeing
+        with the native core), auto reduction synthesis (a flat-repriced
+        plan carries no bucket schedule), and
+        search_overlap_backward_update on (False = the legacy blocking
+        pricing, bit-identical to the pre-bucketing overlap=False
+        path)."""
+        if not self.tiered or len(getattr(self.machine, "tiers", ())) <= 1:
+            return 0.0
+        if self.reduction_mode != "auto":
+            return 0.0
+        cfg = self.config
+        if cfg is None or not getattr(cfg, "search_overlap_backward_update",
+                                      True):
+            return 0.0
+        return float(getattr(cfg, "grad_bucket_bytes", 0) or 0)
+
+    def sync_items(self, graph: Graph, strategies: Dict[int, OpStrategy],
+                   order: Optional[List[Op]] = None
+                   ) -> List[Tuple[Op, OpStrategy, Tuple, float]]:
+        """(op, strategy, key, bytes) for every synced tensor in backward
+        PRODUCTION order (reverse topo) — the issue order the bucket
+        schedule groups over. key = (sync degree, inner stride, comm
+        channels, grad dtypes): tensors only share a bucket when their
+        collective rides the same group over the same rings and reduces
+        in one dtype."""
+        default = OpStrategy()
+        out: List[Tuple[Op, OpStrategy, Tuple, float]] = []
+        for op in reversed(order if order is not None
+                           else graph.topo_order()):
+            s = strategies.get(op.guid, default)
+            sync = s.dp * (s.ap if op.op_type in AP_CAPABLE else 1)
+            if sync <= 1 or not op.weights:
+                continue
+            chans = (("dp", "ap") if (s.ap > 1
+                                      and op.op_type in AP_CAPABLE)
+                     else ("dp",))
+            # grad dtype is part of the key: the lowering reduces per
+            # dtype with no casts, so a mixed-dtype bucket would execute
+            # as more collectives than the ONE the schedule prices
+            dts = tuple(sorted({w.dtype.value for w in op.weights}))
+            key = (sync, self._sync_inner(op, s), chans, dts)
+            out.append((op, s, key, self._grad_sync_bytes(op, s)))
+        return out
+
+    def sync_bucket_schedule(self, graph: Graph,
+                             strategies: Dict[int, OpStrategy],
+                             order: Optional[List[Op]] = None
+                             ) -> Optional[List[Dict[str, Any]]]:
+        """The priced bucket schedule ([{key, ops, bytes}] in issue
+        order, plan_sync_buckets) or None when bucketing is inactive.
+        ONE grouping rule shared by simulate(), reduction_plan(), and
+        the memory model, so the schedule the search prices is the
+        schedule the lowering executes (FFTA072)."""
+        target = self.bucket_target()
+        if not target:
+            return None
+        # memoized like the per-op costs: simulate() and memory_bytes()
+        # both derive the schedule per candidate per lambda probe, and
+        # it is a pure function of (graph, strategies, target) — the
+        # mesh context sync_items reads is itself set from `strategies`
+        memo = getattr(self, "_bucket_sched_memo", None)
+        if memo is None:
+            memo = self._bucket_sched_memo = {}
+        key = (id(graph), target,
+               tuple(sorted(strategies.items())))
+        if key in memo:
+            return memo[key]
+        self.set_mesh_context(strategies)
+        items = self.sync_items(graph, strategies, order=order)
+        out = plan_sync_buckets(items, target) if items else None
+        memo[key] = out
+        return out
+
+    def sync_bucket_scratch_bytes(self, graph: Graph,
+                                  strategies: Dict[int, OpStrategy]
+                                  ) -> float:
+        """Per-chip scratch of the largest grad-sync bucket (the fused
+        collective concatenates its tensors into one buffer) — the
+        memory the search trades overlap against. 0 when bucketing is
+        inactive."""
+        buckets = self.sync_bucket_schedule(graph, strategies)
+        if not buckets:
+            return 0.0
+        return max(b["bytes"] for b in buckets)
+
     def reduction_plan(self, graph: Graph,
                        strategies: Dict[int, OpStrategy]
                        ) -> Dict[str, Dict[str, Any]]:
@@ -600,23 +716,53 @@ class CostModel:
         decomposition carried on the plan — the Unity search stores it on
         SearchResult.reduction_strategies, export_strategy serializes it,
         the FFTA07x analysis family checks it, and the executor surfaces
-        it (docs/machine.md). Empty on flat machines."""
+        it (docs/machine.md). Empty on flat machines.
+
+        With bucketing active (docs/machine.md "Overlap"), entries
+        additionally carry the bucket schedule the simulator priced:
+        "bucket" (issue-ordered id), "bucket_bytes", "bucket_time_us" —
+        the op's strategy/tiers are its BUCKET's (one fused collective
+        per bucket), and "time_us" is its byte-share of that collective.
+        The explicit lowering executes the same schedule and FFTA072
+        rejects divergence."""
         if not self.tiered:
             return {}
         self.set_mesh_context(strategies)
         out: Dict[str, Dict[str, Any]] = {}
         default = OpStrategy()
+        buckets = self.sync_bucket_schedule(graph, strategies)
+        bucket_of: Dict[int, int] = {}
+        bucket_info: Dict[int, Tuple[str, float, List[Dict[str, Any]],
+                                     float]] = {}
+        if buckets:
+            for bid, b in enumerate(buckets):
+                sync, inner = b["key"][:2]
+                strat, t_us, tiers = self.machine.reduction_choice(
+                    b["bytes"], sync, inner=inner)
+                bucket_info[bid] = (strat, t_us, tiers, b["bytes"])
+                for op_b, _s in b["ops"]:
+                    bucket_of[op_b.guid] = bid
         for op in graph.ops.values():
             s = strategies.get(op.guid, default)
             sync = s.dp * (s.ap if op.op_type in AP_CAPABLE else 1)
             if sync <= 1 or not op.weights:
                 continue
             wb = self._grad_sync_bytes(op, s)
-            strat, t_us, tiers = self.machine.reduction_choice(
-                wb, sync, inner=self._sync_inner(op, s))
-            out[op.name] = {"strategy": strat, "degree": sync,
-                            "bytes": wb, "tiers": tiers,
-                            "time_us": t_us}
+            bid = bucket_of.get(op.guid)
+            if bid is not None:
+                strat, bt_us, tiers, bb = bucket_info[bid]
+                out[op.name] = {
+                    "strategy": strat, "degree": sync, "bytes": wb,
+                    "tiers": tiers,
+                    "time_us": bt_us * (wb / bb if bb else 0.0),
+                    "bucket": bid, "bucket_bytes": bb,
+                    "bucket_time_us": bt_us}
+            else:
+                strat, t_us, tiers = self.machine.reduction_choice(
+                    wb, sync, inner=self._sync_inner(op, s))
+                out[op.name] = {"strategy": strat, "degree": sync,
+                                "bytes": wb, "tiers": tiers,
+                                "time_us": t_us}
         return out
 
     # outputs of these op types never materialize as saved-for-backward
@@ -952,6 +1098,11 @@ class Simulator:
         self.cost = CostModel(machine, config)
         self.measured = measured
         self.analytic_fallbacks = 0
+        # grad-sync overlap accounting of the LAST simulate() call
+        # (docs/machine.md "Overlap"): {total_sync_us,
+        # overlapped_sync_us, exposed_sync_us, buckets: [...]} — what
+        # the Unity search copies onto SearchResult
+        self.last_sync_stats: Optional[Dict[str, Any]] = None
         self._fwd_bwd_memo: Dict[Tuple, Tuple[float, float]] = {}
         self._step_memo: Dict[Tuple, float] = {}
         # (data-axis reshard us, model-axis boundary us) per edge key
@@ -1037,6 +1188,27 @@ class Simulator:
         t_compute = 0.0
         t_comm = 0.0
         t_ch = {"dp": 0.0, "tp": 0.0, "sp": 0.0, "ep": 0.0, "ap": 0.0}
+        # bucketed/async gradient reduction (docs/machine.md "Overlap"):
+        # on a multi-tier machine, synced gradients group into
+        # size-targeted buckets that issue when their LAST member's
+        # gradient is produced, so each bucket's per-tier collective
+        # overlaps the remaining backward. Inactive (None) under
+        # blocking pricing, flat repricing, per-tensor mode
+        # (grad_bucket_bytes=0), and on flat/one-tier machines — those
+        # paths keep the historical per-op issue bit-for-bit.
+        buckets = (self.cost.sync_bucket_schedule(graph, strategies,
+                                                  order=order)
+                   if overlap else None)
+        bucket_of: Dict[int, int] = {}
+        bucket_state: List[Dict[str, Any]] = []
+        if buckets:
+            for b in buckets:
+                bucket_state.append({"key": b["key"], "bytes": b["bytes"],
+                                     "left": len(b["ops"]), "ready": 0.0})
+                for op_b, _s in b["ops"]:
+                    bucket_of[op_b.guid] = len(bucket_state) - 1
+        sync_total = 0.0
+        issued_buckets: List[Dict[str, Any]] = []
 
         def run_comm(dur: float, ready: float, ch: Optional[str] = None) -> float:
             nonlocal t_comm, t_compute
@@ -1174,13 +1346,50 @@ class Simulator:
             # last one (this is where dp overlap with the remaining
             # backward is won — and why it must not queue behind model-axis
             # activation collectives)
-            gs = self.cost.grad_sync_time_us(op, s)
-            gs_chans = (("dp", "ap") if (s.ap > 1
-                                         and op.op_type in AP_CAPABLE)
-                        else ("dp",))
-            update_ready = max(update_ready,
-                               run_comm_group(gs, fin, gs_chans))
+            bid = bucket_of.get(op.guid)
+            if bid is not None:
+                # bucketed issue: the bucket's ONE fused collective fires
+                # when its last member's gradient is produced here
+                st = bucket_state[bid]
+                st["ready"] = max(st["ready"], fin)
+                st["left"] -= 1
+                if st["left"] == 0:
+                    b_sync, b_inner, b_chans = st["key"][:3]
+                    strat, dur, _tiers = self.machine.reduction_choice(
+                        st["bytes"], b_sync, inner=b_inner)
+                    sync_total += dur
+                    update_ready = max(
+                        update_ready,
+                        run_comm_group(dur, st["ready"], b_chans))
+                    issued_buckets.append(
+                        {"bytes": st["bytes"], "strategy": strat,
+                         "time_us": dur,
+                         "tensors": len(buckets[bid]["ops"])})
+            else:
+                gs = self.cost.grad_sync_time_us(op, s)
+                sync_total += gs
+                gs_chans = (("dp", "ap") if (s.ap > 1
+                                             and op.op_type in AP_CAPABLE)
+                            else ("dp",))
+                update_ready = max(update_ready,
+                                   run_comm_group(gs, fin, gs_chans))
 
+        # grad-sync overlap split (docs/machine.md "Overlap"): exposed =
+        # the sync tail extending the step past the compute stream's end
+        # (under blocking pricing every sync is exposed by definition);
+        # overlapped = the rest. Replaces the all-or-nothing
+        # search_overlap_backward_update discount as the search's
+        # overlap quantity.
+        if overlap:
+            exposed = min(sync_total, max(0.0, update_ready - t_compute))
+        else:
+            exposed = sync_total
+        self.last_sync_stats = {
+            "total_sync_us": sync_total,
+            "overlapped_sync_us": max(0.0, sync_total - exposed),
+            "exposed_sync_us": exposed,
+            "buckets": issued_buckets,
+        }
         # step_time_scale: fitted whole-step bias multiplier (1.0 unless a
         # fitted profile overlays it). Applied HERE only — per-op costs stay
         # unscaled, and being uniform it cannot change a plan ranking.
@@ -1189,10 +1398,16 @@ class Simulator:
 
     def memory_bytes(self, graph: Graph, strategies: Dict[int, OpStrategy]) -> float:
         default = OpStrategy()
-        return sum(
+        total = sum(
             self.cost.op_memory_bytes(op, strategies.get(op.guid, default))
             for op in graph.ops.values()
         )
+        # bucketed grad sync concatenates each bucket into one fused
+        # buffer: the largest bucket is live scratch during backward —
+        # the memory the search trades overlap against (0 when
+        # bucketing is inactive)
+        return total + self.cost.sync_bucket_scratch_bytes(graph,
+                                                           strategies)
 
 
 def reshard_cost_us(schedule, machine) -> float:

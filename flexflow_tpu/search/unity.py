@@ -230,9 +230,27 @@ class SearchResult:
     # hierarchical machine (CostModel.reduction_plan, docs/machine.md):
     # {op name: {strategy, degree, bytes, tiers, time_us}} — exported in
     # the strategy JSON ("reductions") and checked by the FFTA07x family.
-    # Empty on flat machine models.
+    # Empty on flat machine models. With bucketing active the entries
+    # additionally carry the priced bucket schedule (bucket /
+    # bucket_bytes / bucket_time_us — docs/machine.md "Overlap").
     reduction_strategies: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # grad-sync overlap split of the selected plan's predicted step
+    # (docs/machine.md "Overlap"): overlapped = bucketed/async reduction
+    # time the two-stream schedule hid under the remaining backward,
+    # exposed = the tail that extends the step past compute. Replaces
+    # the all-or-nothing search_overlap_backward_update discount as the
+    # search's overlap quantity (the legacy knob=False forces
+    # exposed == total, the blocking pricing). None when the plan was
+    # never simulated python-side (plain native path).
+    overlapped_sync_us: Optional[float] = None
+    exposed_sync_us: Optional[float] = None
+    sync_buckets: int = 0
+    # tier-aware placement of a pipeline ('stage') candidate:
+    # {"order": stage_outer|stage_inner, "hop_tier", "hop_us",
+    # "cut_on_tier_boundary", "sync_us"} (pipeline_plan
+    # .stage_placement_options); None for non-pipeline plans
+    pipeline_placement: Optional[Dict[str, Any]] = None
 
 
 class GraphSearchHelper:
@@ -577,6 +595,17 @@ class GraphSearchHelper:
         if not candidates:
             raise ValueError("no feasible mesh factorization")
         best = min(candidates, key=lambda r: r.cost_us + lam * r.memory_bytes)
+        # grad-sync overlap split of the winner (docs/machine.md
+        # "Overlap"): pipeline candidates computed theirs inline;
+        # re-simulate mesh winners once (memoized op costs — cheap) so
+        # the recorded stats describe THIS strategy set, not whichever
+        # candidate the simulator priced last
+        if best.exposed_sync_us is None and "stage" not in best.mesh_axes:
+            self.sim.simulate(graph, best.strategies)
+            st = self.sim.last_sync_stats or {}
+            best.overlapped_sync_us = st.get("overlapped_sync_us")
+            best.exposed_sync_us = st.get("exposed_sync_us")
+            best.sync_buckets = len(st.get("buckets") or [])
         if not quiet:
             self.log.extend(c.log[0] for c in candidates)
         return best
@@ -589,11 +618,26 @@ class GraphSearchHelper:
         as region_cost * (M+S-1)/(M*S) — the bubble-inclusive GPipe
         schedule length — plus 2(M+S-1) activation ppermute hops, with
         region weights/optimizer state sharded S-ways (the memory win the
-        lambda search can buy when dp replication does not fit)."""
+        lambda search can buy when dp replication does not fit).
+
+        Tier-aware placement (docs/machine.md "Overlap"): on a
+        multi-tier hierarchical machine each (dp, pp) split is priced
+        under BOTH stage-axis nestings (pipeline_plan
+        .stage_placement_options) — stage OUTERMOST puts every stage on
+        a contiguous device block, so when dp covers whole inner-tier
+        groups the stage cut lands on a pod edge: DCN carries only the
+        thin inter-stage activation hops while each stage's dp
+        weight syncs stay on ICI. Stage-boundary hops are priced on
+        the tier path (ring_hop_time_us with the placement's stage
+        stride), not the flat innermost p2p term, and weight-gradient
+        syncs (region: per-stage dp group; rest: the whole mesh) are
+        priced with the bucket/overlap model — only the exposed tail
+        is charged when overlap is on."""
         if (not getattr(self.config, "enable_pipeline_parallel", False)
                 or self.config.only_data_parallel):
             return []
-        from ..parallel.pipeline_plan import find_isomorphic_run
+        from ..parallel.pipeline_plan import (find_isomorphic_run,
+                                              stage_placement_options)
 
         # the lambda search re-enters per probe with an unchanged graph:
         # cache the run finder rather than re-scanning. Only the REAL graph
@@ -623,6 +667,20 @@ class GraphSearchHelper:
 
         act_elems = int(np.prod(entry.dims[1:]))  # per-sample activation
         act_bytes_el = 2 if self.config.allow_mixed_precision else 4
+        overlap = bool(self.config is None
+                       or self.config.search_overlap_backward_update)
+        bucket_bytes = (float(getattr(self.config, "grad_bucket_bytes", 0)
+                              or 0) if overlap else 0.0)
+        # the weight-sync term below is priced only where the overlap
+        # model is active at all: a MULTI-tier machine with the overlap
+        # knob on. Flat and one-tier machines keep the historical
+        # compute+hop pipeline pricing bit-for-bit, and
+        # search_overlap_backward_update=False keeps the legacy
+        # blocking path untouched (config.grad_bucket_bytes is
+        # documented inert in both cases)
+        multi = (hasattr(self.machine, "tier_path")
+                 and len(getattr(self.machine, "tiers", ())) > 1)
+        sync_active = multi and overlap
         out: List[SearchResult] = []
         for dp, pp in _divisor_pairs(n_devices):
             if pp <= 1 or pp > run_len:
@@ -637,28 +695,95 @@ class GraphSearchHelper:
             strategies = {guid: OpStrategy(dp=dp, tp=1)
                           for guid in graph.ops}
             region_cost = rest_cost = 0.0
-            mem = 0.0
+            mem = region_w = rest_w = 0.0
+            region_wbs: List[float] = []
+            rest_wbs: List[float] = []
             for guid, op in graph.ops.items():
                 t = self.sim.op_step_time_us(op, strategies[guid])
                 om = self.sim.cost.op_memory_bytes(op, strategies[guid])
+                wb = sum(w.num_elements() * w.dtype.np_dtype.itemsize
+                         for w in op.weights)
                 if guid in region:
                     region_cost += t
                     mem += om / pp
+                    region_w += wb
+                    if wb:
+                        region_wbs.append(wb)
                 else:
                     rest_cost += t
                     mem += om
+                    rest_w += wb
+                    if wb:
+                        rest_wbs.append(wb)
             hop_bytes = (batch_size // m // dp) * act_elems * act_bytes_el
-            hop_us = self.machine.p2p_time_us(hop_bytes)
             ticks = m + pp - 1
-            cost = (rest_cost
-                    + region_cost * ticks / (m * pp)
-                    + 2.0 * ticks * hop_us)
-            axes = ({"data": dp} if dp > 1 else {})
-            axes["stage"] = pp
-            out.append(SearchResult(
-                strategies, axes, cost, mem,
-                [f"dp={dp} pp={pp} m={m} "
-                 f"cost={cost:.1f}us mem={mem/1e9:.2f}GB"]))
+            compute_us = rest_cost + region_cost * ticks / (m * pp)
+            for place in stage_placement_options(self.machine, dp, pp):
+                if self.sim.cost.tiered:
+                    # the stage hop crosses the tiers the stage axis
+                    # actually spans at this nesting — a pod-aligned cut
+                    # pays DCN for the thin activation, never the
+                    # innermost p2p price
+                    hop_us = self.machine.ring_hop_time_us(
+                        hop_bytes, pp, inner=place["hop_inner"])
+                else:
+                    hop_us = self.machine.p2p_time_us(hop_bytes)
+                # weight-gradient sync: region weights sync over each
+                # stage's OWN dp group (concurrent across stages -> one
+                # stage's 1/pp share at the placement's dp stride); rest
+                # weights replicate across stages and sync mesh-wide.
+                # Bucketed into grad_bucket_bytes chunks; with overlap
+                # on, the backward window (bwd = 2x fwd -> 2/3 of
+                # compute) hides what fits and only the exposed tail is
+                # charged — blocking pricing charges it all.
+                sync_us = 0.0
+                n_buckets = 0
+                if sync_active:
+                    # region weights sync concurrently across stages
+                    # (conc=pp, one stage's share); rest weights sync
+                    # mesh-wide. grad_bucket_bytes=0 prices true
+                    # per-tensor issue — one latency payment per
+                    # tensor, matching simulate()'s un-bucketed path —
+                    # not one fused collective
+                    for wbs, total, n, inner, conc in (
+                            (region_wbs, region_w, dp,
+                             place["dp_inner"], pp),
+                            (rest_wbs, rest_w, dp * pp, 1, 1)):
+                        if n <= 1 or total <= 0:
+                            continue
+                        if bucket_bytes:
+                            share = total / conc
+                            k = max(1, int(-(-share // bucket_bytes)))
+                            sync_us += k * self.sim.cost._allreduce_us(
+                                share / k, n, inner)
+                            n_buckets += k
+                        else:
+                            sync_us += sum(
+                                self.sim.cost._allreduce_us(wb, n, inner)
+                                for wb in wbs) / conc
+                            n_buckets += len(wbs)
+                window = (2.0 / 3.0) * compute_us if sync_active else 0.0
+                exposed = max(0.0, sync_us - window)
+                cost = compute_us + 2.0 * ticks * hop_us + exposed
+                axes = {name: size for name, size in place["axes"]
+                        if name != "data" or dp > 1}
+                placement = {"order": place["order"],
+                             "hop_tier": place["hop_tier"],
+                             "hop_us": hop_us,
+                             "cut_on_tier_boundary":
+                                 place["cut_on_tier_boundary"],
+                             "sync_us": sync_us}
+                out.append(SearchResult(
+                    dict(strategies), axes, cost, mem,
+                    [f"dp={dp} pp={pp} m={m} place={place['order']}"
+                     + (f" hop={place['hop_tier']}"
+                        if place["hop_tier"] else "")
+                     + f" cost={cost:.1f}us mem={mem/1e9:.2f}GB"],
+                    overlapped_sync_us=(sync_us - exposed
+                                        if sync_active else None),
+                    exposed_sync_us=exposed if sync_active else None,
+                    sync_buckets=n_buckets,
+                    pipeline_placement=placement))
         return out
 
     def _boundary_ops(self, graph: Graph) -> List[Op]:
@@ -1006,6 +1131,10 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                     f" reprice: native {result.cost_us:.1f}"
                     f"us -> {repriced:.1f}us predicted")
                 result.predicted_step_us = repriced
+                st = sim.last_sync_stats or {}
+                result.overlapped_sync_us = st.get("overlapped_sync_us")
+                result.exposed_sync_us = st.get("exposed_sync_us")
+                result.sync_buckets = len(st.get("buckets") or [])
             return result
     helper = GraphSearchHelper(graph, config, machine, simulator)
     budget = None
@@ -1052,6 +1181,16 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
         # artifact (docs/machine.md)
         **({"reductions": result.reduction_strategies}
            if result.reduction_strategies else {}),
+        # overlap split of the predicted step (docs/machine.md
+        # "Overlap") — informational, like "reductions": compile()
+        # re-derives it for the machine the plan lands on
+        **({"overlap": {
+            "overlapped_sync_us": result.overlapped_sync_us,
+            "exposed_sync_us": result.exposed_sync_us,
+            "sync_buckets": result.sync_buckets,
+            **({"pipeline_placement": result.pipeline_placement}
+               if result.pipeline_placement else {}),
+        }} if result.exposed_sync_us is not None else {}),
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
                                    "ap": s.ap, "sp": s.sp,

@@ -99,13 +99,17 @@ def test_lower_allreduce_three_tiers():
 
 # -- end-to-end parity: explicit vs GSPMD vs 1-dev -------------------------
 
-def _train(lowering, n_dev, mixed=False, spec=SPEC_4x2, epochs=2):
+def _train(lowering, n_dev, mixed=False, spec=SPEC_4x2, epochs=2,
+           bucket_bytes=None, overlap=True):
     cfg = ff.FFConfig()
     cfg.num_devices = n_dev
     cfg.batch_size = 16
     cfg.allow_mixed_precision = mixed
     cfg.seed = 7
     cfg.collective_lowering = lowering
+    if bucket_bytes is not None:
+        cfg.grad_bucket_bytes = bucket_bytes
+    cfg.search_overlap_backward_update = overlap
     if n_dev > 1 and spec is not None:
         cfg.machine_model_file = spec
     m = ff.FFModel(cfg)
@@ -585,3 +589,114 @@ def test_fit_collective_coefficients_round_trip():
     machine2.apply_overlay(coeffs)
     assert machine2.tier_scales["ici"] == pytest.approx(0.5, rel=0.1)
     assert machine2.tier_scales["dcn"] == pytest.approx(2.0, rel=0.1)
+
+
+# -- bucketed/async grad-sync lowering (docs/machine.md "Overlap") ---------
+
+def test_bucketed_lowering_parity_and_executed_schedule():
+    """A tiny bucket target forces SEVERAL fused buckets; the bucketed
+    schedule must be loss-parity with the per-tensor explicit path and
+    GSPMD, and the executed bucket assignment must equal the priced
+    plan's (the extended FFTA072 contract)."""
+    losses_b, m_b = _train("explicit", 8, bucket_bytes=4096)
+    losses_p, _ = _train("explicit", 8, bucket_bytes=0)
+    losses_g, _ = _train("gspmd", 8)
+    lowering = m_b.executor.grad_sync_lowering
+    assert lowering is not None
+    buckets = lowering.bucket_map()
+    assert len(buckets) >= 2, buckets
+    planned = {name: e.get("bucket")
+               for name, e in m_b._reduction_plan.items()}
+    assert lowering.executed_buckets() == {**lowering.executed_buckets(),
+                                           **planned}
+    for lb, lp, lg in zip(losses_b, losses_p, losses_g):
+        assert abs(lb - lp) / max(abs(lp), 1e-8) < 1e-5, (losses_b,
+                                                          losses_p)
+        assert abs(lb - lg) / max(abs(lg), 1e-8) < 1e-5, (losses_b,
+                                                          losses_g)
+
+
+def test_bucket_zero_and_blocking_disable_bucketing():
+    # per-tensor mode and the legacy blocking knob must both produce an
+    # un-bucketed plan (every entry bucket-less, the pre-bucketing
+    # schedule)
+    _, m_p = _train("explicit", 8, bucket_bytes=0, epochs=1)
+    assert m_p.executor.grad_sync_lowering.bucket_map() == {}
+    assert all(e.get("bucket") is None
+               for e in m_p._reduction_plan.values())
+    _, m_k = _train("explicit", 8, overlap=False, epochs=1)
+    assert m_k.executor.grad_sync_lowering.bucket_map() == {}
+    assert m_k._sync_overlap is None
+
+
+def test_bucket_counter_and_span():
+    from flexflow_tpu.obs import enable_tracing, get_tracer
+    from flexflow_tpu.obs.registry import REGISTRY
+    from flexflow_tpu.runtime.collectives import overlap_bucket_counter
+
+    enable_tracing()
+    _, m = _train("explicit", 8, epochs=1, bucket_bytes=4096)
+    lowering = m.executor.grad_sync_lowering
+    buckets = lowering.bucket_map()
+    assert buckets
+    c = overlap_bucket_counter()
+    total = sum(v for _, v in c.items())
+    assert total >= len(buckets)
+    spans = get_tracer().events("exec.grad_sync")
+    assert spans and spans[0]["args"]["buckets"] == len(buckets)
+    bspans = get_tracer().events("exec.grad_sync.bucket")
+    assert len(bspans) >= len(buckets)
+    assert {s["args"]["bucket"] for s in bspans} >= set(buckets)
+    # the predicted overlap split landed on the gauge
+    g = REGISTRY.get("ff_grad_sync_overlap_us")
+    assert g is not None
+    assert g.value(kind="exposed") >= 0.0
+
+
+def test_ffta072_bucket_schedule_divergence():
+    from flexflow_tpu.analysis.passes import (AnalysisContext,
+                                              check_executed_reductions)
+
+    _, m = _train("explicit", 8, epochs=1, bucket_bytes=4096)
+    rep = m.analyze_plan()
+    assert not rep.by_code("FFTA072"), rep.format()
+    lowering = m.executor.grad_sync_lowering
+    # regroup one tensor into a different bucket: the extended FFTA072
+    # check must reject the divergent bucket schedule
+    bad = dict(lowering.executed_buckets())
+    name = next(n for n, b in bad.items() if b is not None)
+    bad[name] = (bad[name] or 0) + 97
+    ctx = AnalysisContext(
+        graph=m.graph,
+        reduction_strategies=m._reduction_plan,
+        executed_reductions=lowering.executed_plan(),
+        executed_buckets=bad)
+    diags = check_executed_reductions(ctx)
+    assert diags and all(d.code == "FFTA072" for d in diags), diags
+    # matching buckets stay clean
+    ctx_ok = AnalysisContext(
+        graph=m.graph,
+        reduction_strategies=m._reduction_plan,
+        executed_reductions=lowering.executed_plan(),
+        executed_buckets=lowering.executed_buckets())
+    assert not check_executed_reductions(ctx_ok)
+
+
+def test_compile_gate_rejects_bucket_divergence(monkeypatch):
+    from flexflow_tpu.analysis import PlanAnalysisError
+    from flexflow_tpu.runtime.collectives import GradSyncLowering
+
+    orig = GradSyncLowering.executed_buckets
+
+    def regrouped(self):
+        out = orig(self)
+        for k, v in out.items():
+            if v is not None:
+                out[k] = v + 1
+                break
+        return out
+
+    monkeypatch.setattr(GradSyncLowering, "executed_buckets", regrouped)
+    with pytest.raises(PlanAnalysisError) as ei:
+        _train("explicit", 8, epochs=1, bucket_bytes=4096)
+    assert ei.value.report.by_code("FFTA072")

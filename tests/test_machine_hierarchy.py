@@ -563,3 +563,184 @@ def test_kernel_residual_threshold_gates_selection(tmp_path):
         assert sel.impl == "reference"
     finally:
         KERNELS.configure(ff.FFConfig())
+
+
+# -- tier-aware pipeline placement + overlap (docs/machine.md "Overlap") ---
+
+def _transformer_graph(cfg, layers=8):
+    from flexflow_tpu.models import TransformerConfig, build_bert_encoder
+
+    m = ff.FFModel(cfg)
+    tokens = m.create_tensor([cfg.batch_size, 64], ff.DataType.DT_INT32)
+    c = TransformerConfig(hidden_size=256, embedding_size=256,
+                          num_heads=4, num_layers=layers,
+                          sequence_length=64, vocab_size=1000)
+    build_bert_encoder(m, tokens, c)
+    return Graph(m.ops)
+
+
+def _pp_config(n=16, batch=64):
+    cfg = ff.FFConfig()
+    cfg.num_devices = n
+    cfg.batch_size = batch
+    cfg.search_budget = 4
+    cfg.enable_pipeline_parallel = True
+    cfg.pipeline_microbatches = 4
+    cfg.use_native_search = False
+    return cfg
+
+
+def test_pipeline_candidate_places_stage_cut_on_pod_boundary():
+    """On the 2-pod x 8-chip spec the best pipeline candidate must nest
+    the stage axis OUTERMOST with dp covering a whole pod: the stage
+    cut lands on the pod edge, DCN carries only the inter-stage
+    activation hop, and each stage's dp weight syncs stay on ICI."""
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    cfg = _pp_config()
+    graph = _transformer_graph(cfg)
+    machine = multipod(ici=8, pods=2)
+    helper = GraphSearchHelper(graph, cfg, machine)
+    cands = helper._pipeline_candidates(graph, cfg.batch_size, 16)
+    assert cands
+    best = min(cands, key=lambda r: r.cost_us)
+    pl = best.pipeline_placement
+    assert best.mesh_axes == {"stage": 2, "data": 8}, best.log
+    assert list(best.mesh_axes)[0] == "stage"  # outermost: pod blocks
+    assert pl["order"] == "stage_outer"
+    assert pl["cut_on_tier_boundary"], pl
+    assert pl["hop_tier"] == "dcn", pl
+    # the same (dp, pp) under the legacy strided nesting must cost more:
+    # its dp sync groups stride across the DCN
+    legacy = [r for r in cands
+              if r.mesh_axes.get("stage") == 2
+              and r.pipeline_placement["order"] == "stage_inner"]
+    assert legacy and legacy[0].cost_us > best.cost_us
+    assert legacy[0].pipeline_placement["sync_us"] > pl["sync_us"]
+
+
+def test_pipeline_stage_hop_priced_on_dcn_tier_not_p2p():
+    """The priced stage-boundary transfer of a pod-aligned candidate
+    uses the DCN tier via tier_path — not the innermost p2p term the
+    flat pricing used."""
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    cfg = _pp_config()
+    graph = _transformer_graph(cfg)
+    machine = multipod(ici=8, pods=2)
+    helper = GraphSearchHelper(graph, cfg, machine)
+    cands = helper._pipeline_candidates(graph, cfg.batch_size, 16)
+    best = min(cands, key=lambda r: r.cost_us)
+    m = cfg.pipeline_microbatches
+    # hop bytes: per-microbatch per-dp-shard activation (seq x hidden,
+    # bf16 under the default mixed precision)
+    hop_bytes = (cfg.batch_size // m // 8) * 64 * 256 * 2
+    want = machine.ring_hop_time_us(hop_bytes, 2, inner=8)
+    assert best.pipeline_placement["hop_us"] == pytest.approx(want)
+    # DCN-priced: strictly slower than the innermost-tier p2p price
+    assert want > machine.p2p_time_us(hop_bytes)
+
+
+def test_one_tier_pipeline_candidates_match_flat_pod_bit_for_bit():
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    cfg = _pp_config()
+    graph = _transformer_graph(cfg)
+    h_one = GraphSearchHelper(graph, cfg, one_tier(16))
+    h_flat = GraphSearchHelper(graph, cfg, TpuPodModel(16, CHIP))
+    c_one = h_one._pipeline_candidates(graph, cfg.batch_size, 16)
+    c_flat = h_flat._pipeline_candidates(graph, cfg.batch_size, 16)
+    assert [r.cost_us for r in c_one] == [r.cost_us for r in c_flat]
+    assert [r.mesh_axes for r in c_one] == [r.mesh_axes for r in c_flat]
+    # one-tier machines keep the legacy nesting only
+    assert all(r.pipeline_placement["order"] == "stage_inner"
+               for r in c_one)
+
+
+def test_search_result_reports_overlap_split():
+    """The searched multipod plan carries the overlapped/exposed
+    grad-sync split; the legacy blocking knob zeroes the overlap term
+    (satellite: docs/machine.md "Overlap")."""
+    cfg = ff.FFConfig()
+    cfg.num_devices = 16
+    cfg.batch_size = 512
+    cfg.search_budget = 4
+    cfg.use_native_search = False
+    m = mlp_model(cfg, layers=3, width=512)
+    graph = Graph(m.ops)
+    res = unity_optimize(graph, cfg, multipod(ici=8, pods=2), 512, 16)
+    assert res.exposed_sync_us is not None
+    assert res.overlapped_sync_us is not None
+    assert res.exposed_sync_us >= 0 and res.overlapped_sync_us >= 0
+    cfg2 = ff.FFConfig()
+    cfg2.num_devices = 16
+    cfg2.batch_size = 512
+    cfg2.search_budget = 4
+    cfg2.use_native_search = False
+    cfg2.search_overlap_backward_update = False
+    m2 = mlp_model(cfg2, layers=3, width=512)
+    res2 = unity_optimize(Graph(m2.ops), cfg2, multipod(ici=8, pods=2),
+                          512, 16)
+    assert res2.overlapped_sync_us == 0.0
+    assert res2.sync_buckets == 0
+
+
+def test_reduction_plan_carries_bucket_schedule():
+    """Bucketed entries record the priced schedule: bucket mates share
+    one strategy and bucket totals; blocking/per-tensor modes stay
+    bucket-less (the pre-bucketing plan format)."""
+    cfg = ff.FFConfig()
+    cfg.batch_size = 64
+    cfg.grad_bucket_bytes = 600 * 1024  # several buckets at 512-width
+    m = mlp_model(cfg, layers=4, width=512)
+    graph = Graph(m.ops)
+    strategies = {op.guid: OpStrategy(dp=16) for op in m.ops}
+    cm = CostModel(multipod(ici=8, pods=2), cfg)
+    plan = cm.reduction_plan(graph, strategies)
+    buckets = {}
+    for name, e in plan.items():
+        assert e["bucket"] is not None
+        buckets.setdefault(e["bucket"], []).append(e)
+    assert len(buckets) >= 2, plan
+    for entries in buckets.values():
+        assert len({e["strategy"] for e in entries}) == 1
+        assert len({e["bucket_bytes"] for e in entries}) == 1
+        got = sum(e["bytes"] for e in entries)
+        assert got == pytest.approx(entries[0]["bucket_bytes"])
+        # per-op time is the byte share of the bucket's one collective
+        assert sum(e["time_us"] for e in entries) == pytest.approx(
+            entries[0]["bucket_time_us"])
+    cfg.search_overlap_backward_update = False
+    plan_blk = CostModel(multipod(ici=8, pods=2), cfg).reduction_plan(
+        graph, strategies)
+    assert all("bucket" not in e for e in plan_blk.values())
+    cfg.search_overlap_backward_update = True
+    cfg.grad_bucket_bytes = 0
+    plan_pt = CostModel(multipod(ici=8, pods=2), cfg).reduction_plan(
+        graph, strategies)
+    assert all("bucket" not in e for e in plan_pt.values())
+
+
+def test_pipeline_placement_stage_count_differs_from_pod_count():
+    """examples/machines/multipod_4x4.json (4 pods x 4 chips): stage
+    counts that do NOT equal the pod count still cut on pod edges when
+    dp covers whole pods — pp=2 puts two pods in each stage, pp=4 one —
+    while a half-pod dp lands mid-pod."""
+    import os
+
+    from flexflow_tpu.parallel.pipeline_plan import stage_placement_options
+
+    spec = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "machines",
+        "multipod_4x4.json")
+    cfg = ff.FFConfig()
+    cfg.machine_model_file = spec
+    machine = make_machine_model(cfg, 16)
+    assert hasattr(machine, "tier_path")
+    assert [t.degree for t in machine.tiers] == [4, 4]
+    outer2 = stage_placement_options(machine, dp=8, pp=2)[0]
+    assert outer2["cut_on_tier_boundary"] and outer2["hop_tier"] == "dcn"
+    outer4 = stage_placement_options(machine, dp=4, pp=4)[0]
+    assert outer4["cut_on_tier_boundary"] and outer4["hop_tier"] == "dcn"
+    outer8 = stage_placement_options(machine, dp=2, pp=8)[0]
+    assert not outer8["cut_on_tier_boundary"]

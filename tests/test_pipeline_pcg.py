@@ -279,3 +279,62 @@ def test_search_pp_compiles_end_to_end():
     x, y = _data()
     h = model.fit(x, y, epochs=1, verbose=False)
     assert np.isfinite(h[-1]["loss"])
+
+
+def test_stage_placement_options_tier_nesting():
+    """stage_placement_options (docs/machine.md "Overlap"): multi-tier
+    machines offer the stage-OUTER nesting (contiguous per-stage device
+    blocks, cut on the pod edge when dp covers whole inner groups);
+    flat and one-tier machines keep only the legacy strided nesting so
+    their pricing is unchanged bit-for-bit."""
+    from flexflow_tpu.parallel.pipeline_plan import stage_placement_options
+    from flexflow_tpu.search.machine_model import (CHIP_SPECS,
+                                                   HierarchicalMachineModel,
+                                                   TierSpec, TpuPodModel)
+
+    chip = CHIP_SPECS["tpu-v5e"]
+    hier = HierarchicalMachineModel(
+        [TierSpec("ici", 8, 45.0, 2),
+         TierSpec("dcn", 2, 3.125, 1, 10.0)], chip)
+    opts = stage_placement_options(hier, dp=8, pp=2)
+    assert [o["order"] for o in opts] == ["stage_outer", "stage_inner"]
+    outer, inner = opts
+    assert outer["axes"] == (("stage", 2), ("data", 8))
+    assert outer["hop_inner"] == 8 and outer["dp_inner"] == 1
+    assert outer["hop_tier"] == "dcn" and outer["cut_on_tier_boundary"]
+    assert inner["axes"] == (("data", 8), ("stage", 2))
+    assert inner["hop_inner"] == 1 and inner["dp_inner"] == 2
+    assert inner["hop_tier"] == "ici" and not inner["cut_on_tier_boundary"]
+    # dp=4 covers only half a pod: the cut lands mid-pod
+    assert not stage_placement_options(hier, 4, 4)[0]["cut_on_tier_boundary"]
+    # flat and one-tier machines: legacy nesting only
+    assert [o["order"] for o in stage_placement_options(
+        TpuPodModel(16, chip), 8, 2)] == ["stage_inner"]
+    one = HierarchicalMachineModel([TierSpec("ici", 16, 45.0, 2)], chip)
+    assert [o["order"] for o in stage_placement_options(one, 8, 2)] \
+        == ["stage_inner"]
+
+
+def test_pp_compiles_with_stage_outer_mesh():
+    """A stage-OUTERMOST mesh (the tier-aware placement's nesting)
+    compiles and trains: make_mesh preserves the axes order, so each
+    stage owns a contiguous device block."""
+    config = ff.FFConfig()
+    config.num_devices = 8
+    config.batch_size = BATCH
+    # per-microbatch batch must divide over the data axis (BATCH=8,
+    # m=2 -> 4 per microbatch over dp=4)
+    config.pipeline_microbatches = 2
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([BATCH, SEQ], ff.DataType.DT_INT32)
+    cfg = TransformerConfig(hidden_size=HID, embedding_size=HID,
+                            num_heads=4, num_layers=LAYERS,
+                            sequence_length=SEQ, vocab_size=50)
+    build_bert_encoder(model, tokens, cfg)
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[], parallel_axes={"stage": 2, "data": 4})
+    assert tuple(model.mesh.axis_names) == ("stage", "data")
+    x, y = _data()
+    h = model.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(h[-1]["loss"])

@@ -797,3 +797,51 @@ def test_unpruned_baseline_cannot_realize_infeasible_sp():
     result = unity_optimize(graph, config, TpuPodModel(8), 2, 8)
     assert result.mesh_axes.get("seq", 1) == 1
     assert all(s.sp == 1 for s in result.strategies.values())
+
+
+def test_legacy_overlap_knob_pins_blocking_pricing():
+    """search_overlap_backward_update=False must force the overlap term
+    to zero — blocking pricing, bit-identical to the pre-bucketing
+    overlap=False path (the plain sum of task durations) — regardless
+    of --grad-bucket-bytes (docs/machine.md "Overlap")."""
+    from flexflow_tpu.search.machine_model import (CHIP_SPECS,
+                                                   HierarchicalMachineModel,
+                                                   TierSpec)
+
+    chip = CHIP_SPECS["tpu-v5e"]
+    machine = HierarchicalMachineModel(
+        [TierSpec("ici", 8, chip.ici_link_gbps, 2),
+         TierSpec("dcn", 2, 3.125, 1, 10.0)], chip)
+    model = build_mlp(batch=64, din=512, hidden=2048, classes=10)
+    graph = Graph(model.ops)
+    strategies = {op.guid: OpStrategy(dp=16) for op in model.ops}
+    model.config.search_overlap_backward_update = False
+    costs = []
+    for bb in (0, 4096, 25 * 1024 * 1024):
+        model.config.grad_bucket_bytes = bb
+        sim = Simulator(machine, model.config)
+        costs.append(sim.simulate(graph, strategies))
+        st = sim.last_sync_stats
+        assert st["overlapped_sync_us"] == 0.0
+        assert st["exposed_sync_us"] == st["total_sync_us"] > 0
+        assert st["buckets"] == []
+    assert costs[0] == costs[1] == costs[2]
+    # blocking == the plain sum of all task durations (the historical
+    # overlap=False contract, same as the flat-machine pin above)
+    sim = Simulator(machine, model.config)
+    total = 0.0
+    for op in model.ops:
+        s = strategies[op.guid]
+        fwd, bwd = sim.fwd_bwd_time_us(op, s)
+        total += fwd + bwd + sim.cost.grad_sync_time_us(op, s)
+    assert costs[0] == pytest.approx(total)
+    # with the knob ON, the bucketed overlap term exists and buys time
+    model.config.search_overlap_backward_update = True
+    sim_o = Simulator(machine, model.config)
+    c_o = sim_o.simulate(graph, strategies)
+    assert c_o < costs[0]
+    st = sim_o.last_sync_stats
+    assert st["buckets"]
+    assert 0.0 <= st["exposed_sync_us"] <= st["total_sync_us"]
+    assert st["overlapped_sync_us"] == pytest.approx(
+        st["total_sync_us"] - st["exposed_sync_us"])
