@@ -6,7 +6,8 @@ family residuals `obs.calibrate()`/refit record. Every kernel also runs
 under the Pallas interpreter (`interpret=True`) so the CPU parity suite
 exercises fwd and bwd without a TPU.
 """
-from .decode import fused_decode_attention
+from .decode import (fused_decode_attention,
+                     fused_multiquery_decode_attention)
 from .norm import fused_layernorm, fused_rmsnorm, fused_softmax
 from .reduction import fused_cumsum, fused_reduce
 
@@ -17,4 +18,5 @@ __all__ = [
     "fused_reduce",
     "fused_cumsum",
     "fused_decode_attention",
+    "fused_multiquery_decode_attention",
 ]

@@ -57,8 +57,8 @@ from typing import Callable, Dict, Optional
 
 from ..ffconst import OpType
 
-FAMILIES = ("attention", "attention_decode", "layernorm", "rmsnorm",
-            "softmax", "reduction")
+FAMILIES = ("attention", "attention_decode", "attention_decode_mq",
+            "layernorm", "rmsnorm", "softmax", "reduction")
 
 # graph-op families the cost simulator can price (serving decode and the
 # loss reduction never appear as PCG ops)
@@ -70,9 +70,10 @@ OPTYPE_FAMILY = {
 }
 
 # families whose residual evidence comes from ANOTHER family's
-# calibration rows (attention_decode is the attention core over the KV
-# cache; it never appears as its own graph op)
-RESIDUAL_ALIAS = {"attention_decode": "attention"}
+# calibration rows (the decode steps are the attention core over the KV
+# cache; they never appear as their own graph ops)
+RESIDUAL_ALIAS = {"attention_decode": "attention",
+                  "attention_decode_mq": "attention"}
 
 # flash-attention auto policy, shared by ops/attention.py _use_flash and
 # CostModel.kernel_time_factor so search pricing can never de-sync from
@@ -99,6 +100,9 @@ def flash_crossover(batch: int, heads: int, q_len: int, k_len: int,
 PALLAS_COST_GAIN = {
     "attention": 0.89,
     "attention_decode": 0.80,
+    # the multi-query variant amortizes the cache stream over C queries
+    # on top of the single-query kernel's saved logits round-trip
+    "attention_decode_mq": 0.75,
     "layernorm": 0.70,
     "rmsnorm": 0.70,
     "softmax": 0.75,
@@ -107,12 +111,15 @@ PALLAS_COST_GAIN = {
 
 # a family whose calibration residual (measured/predicted, median over
 # its ops) reaches this is a fusion candidate: the backend is leaving
-# that much of the roofline on the table. This is only the DEFAULT of
-# the `--kernel-residual-threshold` config knob
-# (FFConfig.kernel_residual_threshold, docs/kernels.md) — selection
-# reads the knob of the config in hand (or the last configure()d one),
-# so the threshold can be fit from real before/after kernel
-# measurements instead of staying hand-set.
+# that much of the roofline on the table. This is only the NO-PROFILE
+# default: a FittedProfile carrying `kernel_residual_thresholds`
+# (obs/refit.fit_kernel_thresholds — derived from real before/after
+# kernel measurements: a family's threshold is the residual the FUSED
+# impl itself achieves, so reference-vs-roofline evidence past it means
+# switching pays) wins per family, then the
+# `--kernel-residual-threshold` config knob
+# (FFConfig.kernel_residual_threshold, docs/kernels.md), then this
+# constant.
 RESIDUAL_CANDIDATE_THRESHOLD = 1.10
 
 
@@ -135,11 +142,14 @@ class KernelRegistry:
         self._overrides: Dict[str, str] = {}
         self._residuals: Dict[str, float] = {}
         self._threshold: float = RESIDUAL_CANDIDATE_THRESHOLD
+        # per-family FITTED thresholds from the profile (measured
+        # before/after evidence); a family present here ignores the knob
+        self._fitted_thresholds: Dict[str, float] = {}
         self.residual_source: Optional[str] = None
         # per-call config resolution caches: spec string -> overrides,
-        # (profile path, mtime, size) -> residuals
+        # (profile path, mtime, size) -> (residuals, fitted thresholds)
         self._spec_cache: Dict[str, Dict[str, str]] = {}
-        self._residual_cache: Dict[tuple, Dict[str, float]] = {}
+        self._residual_cache: Dict[tuple, tuple] = {}
 
     # -- configuration -----------------------------------------------------
     @staticmethod
@@ -174,9 +184,11 @@ class KernelRegistry:
             hit = self._spec_cache[spec] = self.parse_spec(spec)
         return hit
 
-    def _profile_residuals(self, path: Optional[str]) -> Dict[str, float]:
+    def _profile_evidence(self, path: Optional[str]) -> tuple:
+        """(residuals, fitted thresholds) of the profile at `path` —
+        both {} when there is no usable profile."""
         if not path:
-            return {}
+            return {}, {}
         import os
 
         # cache keyed by file identity, not just path: a refit that
@@ -193,12 +205,16 @@ class KernelRegistry:
 
         try:
             prof = FittedProfile.load(path)
-            out = {k: float(v)
-                   for k, v in (prof.op_family_residuals or {}).items()}
+            out = (
+                {k: float(v)
+                 for k, v in (prof.op_family_residuals or {}).items()},
+                {k: float(v) for k, v in
+                 (prof.kernel_residual_thresholds or {}).items()},
+            )
         except FittedProfileError:
             # the machine-model load path raises this loudly; the
             # registry just declines the evidence
-            out = {}
+            out = ({}, {})
         self._residual_cache[key] = out
         return out
 
@@ -216,7 +232,8 @@ class KernelRegistry:
             getattr(config, "kernel_residual_threshold",
                     RESIDUAL_CANDIDATE_THRESHOLD))
         path = getattr(config, "fitted_profile_file", None)
-        self._residuals = self._profile_residuals(path)
+        self._residuals, self._fitted_thresholds = \
+            self._profile_evidence(path)
         self.residual_source = path if self._residuals else None
 
     def residual(self, family: str) -> Optional[float]:
@@ -279,13 +296,26 @@ class KernelRegistry:
             if be != "tpu":
                 choice = KernelChoice(family, "reference", "backend")
             else:
-                residuals = (self._profile_residuals(
-                    getattr(config, "fitted_profile_file", None))
-                    if config is not None else self._residuals)
-                threshold = (float(getattr(
-                    config, "kernel_residual_threshold", self._threshold))
-                    if config is not None else self._threshold)
-                r = residuals.get(RESIDUAL_ALIAS.get(family, family))
+                if config is not None:
+                    residuals, fitted = self._profile_evidence(
+                        getattr(config, "fitted_profile_file", None))
+                else:
+                    residuals, fitted = (self._residuals,
+                                         self._fitted_thresholds)
+                # threshold resolution: the profile's FITTED per-family
+                # threshold (measured before/after evidence,
+                # obs/refit.fit_kernel_thresholds) > the config knob >
+                # the hand-set default. The alias maps a derived family
+                # (attention_decode*) onto its evidence family for the
+                # residual AND the fitted threshold.
+                evidence_fam = RESIDUAL_ALIAS.get(family, family)
+                threshold = fitted.get(family, fitted.get(evidence_fam))
+                if threshold is None:
+                    threshold = (float(getattr(
+                        config, "kernel_residual_threshold",
+                        self._threshold))
+                        if config is not None else self._threshold)
+                r = residuals.get(evidence_fam)
                 # a family with a measured size policy (attention's
                 # crossover) keeps it as a GATE even under residual
                 # evidence: the residual says the family underperforms

@@ -327,16 +327,40 @@ class MultiHeadAttentionOp(Op):
         itself. That is what lets the continuous batcher split a long
         prompt into fixed-size chunks interleaved with decode iterations
         (serving/sched/continuous.py) instead of stalling every in-flight
-        decode behind one monolithic prefill. The vector form stays
-        single-token (one decode step per slot)."""
+        decode behind one monolithic prefill.
+
+        The vector form also takes C > 1 queries per slot — SPECULATIVE
+        decoding's verify step: slot i's C candidate tokens are written
+        at rows [pos[i], pos[i]+C) of ITS cache and query j attends rows
+        <= pos[i]+j. Rejected candidates are rolled back by the batcher
+        moving its write-back pointer, never by touching the cache —
+        the stale rows are masked out and rewritten before any later
+        query can attend them.
+
+        Every C > 1 entry (both forms) is the `attention_decode_mq`
+        kernel-tier family: selected, the chunk runs as ONE fused
+        multi-query kernel over the paged cache
+        (kernels/pallas/decode.py) instead of materializing the
+        (B, h, C, M) logits/probs in HBM; the einsum chain below is the
+        reference/parity oracle for both families."""
         pos = ctx.decode_pos
         kc = ctx.state[(self.name, "k_cache")]
         vc = ctx.state[(self.name, "v_cache")]
         vector = getattr(pos, "ndim", 0) == 1
+        c = q.shape[1]
         if vector:
             rows = jnp.arange(kc.shape[0])
-            kc = kc.at[rows, pos].set(k[:, 0].astype(kc.dtype))
-            vc = vc.at[rows, pos].set(v[:, 0].astype(vc.dtype))
+            if c == 1:
+                kc = kc.at[rows, pos].set(k[:, 0].astype(kc.dtype))
+                vc = vc.at[rows, pos].set(v[:, 0].astype(vc.dtype))
+            else:
+                # slot i's C candidate rows land at [pos[i], pos[i]+C);
+                # rows past max_len (speculation at the cache edge) are
+                # DROPPED by the scatter — those queries' outputs are
+                # never accepted, so the dropped writes are unreachable
+                cols = pos[:, None] + jnp.arange(c)[None, :]  # (B, C)
+                kc = kc.at[rows[:, None], cols].set(k.astype(kc.dtype))
+                vc = vc.at[rows[:, None], cols].set(v.astype(vc.dtype))
         else:
             kc = jax.lax.dynamic_update_slice(
                 kc, k.astype(kc.dtype), (0, pos, 0, 0))
@@ -345,27 +369,43 @@ class MultiHeadAttentionOp(Op):
         ctx.state_updates[(self.name, "k_cache")] = kc
         ctx.state_updates[(self.name, "v_cache")] = vc
 
-        if vector:
-            from ..kernels.registry import KERNELS
+        from ..kernels.registry import KERNELS
 
+        interpret = jax.default_backend() != "tpu"
+        block_k = getattr(ctx.config, "flash_block_k", 512)
+        if vector and c == 1:
             if KERNELS.select("attention_decode", config=ctx.config):
                 from ..kernels.pallas.decode import fused_decode_attention
 
                 ctxv = fused_decode_attention(
-                    q, kc, vc, pos, scale=scale,
-                    block_k=getattr(ctx.config, "flash_block_k", 512),
-                    interpret=jax.default_backend() != "tpu")
+                    q, kc, vc, pos, scale=scale, block_k=block_k,
+                    interpret=interpret)
                 return self._decode_project(ctxv, q.dtype, weights)
-            mask = jnp.arange(kc.shape[1])[None, :] <= pos[:, None]  # (B, M)
-            mask = mask[:, None, None, :]
+        elif KERNELS.select("attention_decode_mq", config=ctx.config):
+            from ..kernels.pallas.decode import (
+                fused_multiquery_decode_attention)
+
+            posv = pos if vector else jnp.full(
+                (kc.shape[0],), pos, jnp.int32)
+            ctxv = fused_multiquery_decode_attention(
+                q, kc, vc, posv, scale=scale, block_k=block_k,
+                interpret=interpret)
+            return self._decode_project(ctxv, q.dtype, weights)
+
+        if vector:
+            # (B, C, M): query j of slot i attends rows <= pos[i]+j
+            # (C == 1 degenerates to the plain <= pos decode mask)
+            qpos = pos[:, None] + jnp.arange(c)[None, :]
+            mask = (jnp.arange(kc.shape[1])[None, None, :]
+                    <= qpos[:, :, None])[:, None, :, :]  # (B, 1, C, M)
         else:
-            qpos = pos + jnp.arange(q.shape[1])  # (C,) absolute positions
+            qpos = pos + jnp.arange(c)  # (C,) absolute positions
             mask = (jnp.arange(kc.shape[1])[None, :]
                     <= qpos[:, None])[None, None, :, :]  # (1, 1, C, M)
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, kc.astype(q.dtype),
             preferred_element_type=jnp.float32,
-        ) * scale  # (B, h, 1, M)
+        ) * scale  # (B, h, C, M)
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),

@@ -335,6 +335,43 @@ class CostModel:
                 heuristic=lambda: key[2])
         return hit
 
+    def decode_step_time_us(self, op: Op, batch: int, cache_len: int,
+                            c_queries: int = 1) -> float:
+        """Price ONE continuous-batching decode dispatch of attention op
+        `op`: `c_queries` query tokens per slot against a `cache_len`-row
+        paged KV cache — the serving hot path, which never appears as a
+        graph op so `forward_time_us` cannot see it. Kernel-tier priced
+        like the rest of the Pallas tier: the registry's selection for
+        `attention_decode` (C = 1) / `attention_decode_mq` (C > 1,
+        chunked prefill and the speculative verify) multiplies the
+        roofline by PALLAS_COST_GAIN, so serving-rate predictions
+        (serve-bench's predicted speculative win, fleet sizing) rank
+        against the kernels the batcher will actually dispatch."""
+        from ..kernels.registry import KERNELS
+
+        heads = op.params.get("num_heads", 1)
+        embed = op.params.get("embed_dim", op.inputs[0].dims[-1])
+        kdim = op.params.get("kdim") or embed // heads
+        vdim = op.params.get("vdim") or embed // heads
+        b = max(1, int(batch))
+        m = max(1, int(cache_len))
+        c = max(1, int(c_queries))
+        e = op.inputs[0].dims[-1]
+        # q/k/v/out projections of the C new tokens + the attention core
+        # streaming the cache
+        proj = 2.0 * b * c * heads * (2 * e * kdim + e * vdim
+                                      + vdim * embed)
+        core = 2.0 * b * c * heads * m * (kdim + vdim)
+        dt_bytes = self.op_dtype_bytes(op)
+        # HBM traffic is the cache stream (the decode bottleneck); the
+        # reference path additionally round-trips the (b, h, c, m)
+        # logits+probs, which is exactly what the fused kernels save —
+        # modeled by the family's PALLAS_COST_GAIN, not double-counted
+        bytes_ = float(b) * m * heads * (kdim + vdim) * dt_bytes
+        t = self.machine.compute_time_us(proj + core, bytes_, dt_bytes)
+        fam = "attention_decode_mq" if c > 1 else "attention_decode"
+        return t * KERNELS.cost_factor(fam, config=self.config)
+
     def backward_time_us(self, op: Op, s: OpStrategy) -> float:
         if op.op_type in (OpType.INPUT, OpType.NOOP, OpType.WEIGHT):
             return 0.0

@@ -32,7 +32,14 @@ config.json fields:
                  page_size / prefill_chunk_tokens / prefix_cache_pages /
                  max_queue (per-replica batcher knobs), policy
                  (default "affine"), slo_ttft_ms (optional SLO shed
-                 budget). A replica that fails to construct is recorded
+                 budget), speculative (optional {"draft":
+                 "<model entry name>", "tokens": k}: the named entry is
+                 BUILT as the draft model — never registered by this
+                 reference — and every replica's batcher proposes k
+                 draft tokens per slot per iteration, verified by the
+                 target in one fused multi-query dispatch; greedy output
+                 stays token-identical, docs/serving.md).
+                 A replica that fails to construct is recorded
                  (ff_model_load_failures_total under "<name>/<replica>",
                  /healthz degraded) while the rest keep serving.
 """
@@ -146,7 +153,23 @@ class ModelRepository:
                 model = self.build(name, cfg)
                 serving = cfg.get("serving") or {}
                 if serving.get("mode") == "fleet":
-                    self._register_fleet(server, name, model, serving)
+                    # speculative decoding: the draft is its OWN model
+                    # entry (built, never registered here) scoring
+                    # alongside the target in every replica's batcher.
+                    # A broken draft entry fails THIS model's load —
+                    # silently serving non-speculative would mask a
+                    # config error
+                    draft = None
+                    spec = serving.get("speculative") or {}
+                    if spec:
+                        if "draft" not in spec:
+                            raise ValueError(
+                                f"{name}: serving.speculative needs"
+                                " 'draft' (the draft model's repository"
+                                " entry name)")
+                        draft = self.build(str(spec["draft"]))
+                    self._register_fleet(server, name, model, serving,
+                                         draft)
                     loaded.append(name)
                     continue
                 # batching defaults derive from the batch the model was
@@ -183,7 +206,8 @@ class ModelRepository:
         return loaded
 
     @staticmethod
-    def _register_fleet(server, name: str, model, serving: dict) -> None:
+    def _register_fleet(server, name: str, model, serving: dict,
+                        draft=None) -> None:
         """Build a serving fleet from one repository entry: N replicas of
         the built (generative) model behind a prefix-affine Router,
         registered through server.register_fleet so /metrics merges the
@@ -210,6 +234,12 @@ class ModelRepository:
                       "max_queue")
             if k in serving
         }
+        if draft is not None:
+            # replicas share ONE draft model the same way they share the
+            # target — each batcher carries its own draft KV caches
+            batcher_kw["draft_model"] = draft
+            batcher_kw["spec_tokens"] = int(
+                (serving.get("speculative") or {}).get("tokens", 3))
         # register FIRST so the router's load-failure hook is wired
         # before any replica factory can fail
         server.register_fleet(name, router)

@@ -33,6 +33,21 @@ and drives one of three workloads (``--workload``):
    grow+shrink cycle (and a mid-burst replica drain/handoff), token
    parity vs a no-resize run, affine p99 TTFT beating round-robin, and
    a valid `replica`-labeled merged exposition.
+ - ``speculative`` (ISSUE 14): the same workload through plain greedy
+   decode and through draft-verify speculative decoding
+   (``--spec-tokens`` proposals per slot per iteration, scored by the
+   target in ONE fused multi-query dispatch). The default draft shares
+   the target's weights (``--no-draft-tied`` + ``--draft-layers``/
+   ``--draft-hidden`` builds an independent smaller draft — acceptance
+   is then whatever the draft earns). HARD-ASSERTS every request's
+   greedy tokens identical to plain decode, nonzero draft acceptance,
+   tokens/s-per-chip >= ``--spec-speedup`` over plain, a short rerun
+   with the fused multi-query kernel FORCED (interpret mode on CPU)
+   still token-identical, and the CostModel pricing the
+   ``attention_decode_mq`` family (its fused/reference dispatch-price
+   ratio == PALLAS_COST_GAIN). On the CPU twin the measured win is
+   dispatch amortization (k tokens per fused dispatch vs one per plain
+   dispatch); the real draft-vs-target compute ratio needs hardware.
 
 Hard checks for every workload (exit 1 on violation), which is what the
 CI `serving-load` job runs:
@@ -132,10 +147,33 @@ def _pct(xs: List[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
 
 
+def _submit_with_backpressure(batcher, workload, deadline_s: float,
+                              t0: float):
+    """Submit the workload like a well-behaved client: 429-class
+    rejections (queue/pool saturation) retry with backoff — the load
+    generator drives the admission controller the way real traffic
+    would — giving up only past `deadline_s` after `t0`. Returns
+    (handles, backpressure_retries). Shared by every workload driver."""
+    from .admission import PoolSaturated, QueueFull
+
+    handles = []
+    backpressured = 0
+    for w in workload:
+        while True:
+            try:
+                handles.append(batcher.submit(w["prompt"], w["max_new"]))
+                break
+            except (QueueFull, PoolSaturated):
+                backpressured += 1
+                if time.monotonic() - t0 > deadline_s:
+                    raise
+                time.sleep(0.02)
+    return handles, backpressured
+
+
 def run_continuous(model, workload, max_len: int, slots: int,
                    page_size: int, deadline_s: float,
                    prefill_chunk=None) -> Dict:
-    from .admission import QueueFull, PoolSaturated
     from .continuous import ContinuousBatcher
 
     batcher = ContinuousBatcher(
@@ -143,8 +181,6 @@ def run_continuous(model, workload, max_len: int, slots: int,
         prefill_chunk_tokens=prefill_chunk,
         prefix_cache_pages=0 if prefill_chunk == 0 else None,
         max_queue=max(len(workload), 1))
-    handles = []
-    backpressured = 0
     with batcher:
         # warmup OUTSIDE the timed window: the first prefill + decode
         # dispatches trigger the jit compiles; both paths get the same
@@ -161,20 +197,8 @@ def run_continuous(model, workload, max_len: int, slots: int,
         batcher.submit(warm, 2).result(timeout=600.0)
         batcher.submit(warm, 2).result(timeout=600.0)
         t0 = time.monotonic()
-        for w in workload:
-            # a well-behaved client: 429-class rejections (queue/pool
-            # saturation) retry with backoff — the load generator drives
-            # the admission controller the way real traffic would
-            while True:
-                try:
-                    handles.append(
-                        batcher.submit(w["prompt"], w["max_new"]))
-                    break
-                except (QueueFull, PoolSaturated):
-                    backpressured += 1
-                    if time.monotonic() - t0 > deadline_s:
-                        raise
-                    time.sleep(0.02)
+        handles, backpressured = _submit_with_backpressure(
+            batcher, workload, deadline_s, t0)
         results = [h.result(timeout=600.0) for h in handles]
     wall = time.monotonic() - t0
     tokens = sum(len(r) for r in results)
@@ -415,10 +439,7 @@ def run_mesh_resize(model, workload, max_len: int, slots: int,
     token-identical across a topology change."""
     from .continuous import ContinuousBatcher
 
-    from .admission import PoolSaturated, QueueFull
-
     def drive(batcher, resize: bool) -> Dict:
-        handles = []
         resizes = []
         with batcher:
             warm = np.zeros(
@@ -426,18 +447,8 @@ def run_mesh_resize(model, workload, max_len: int, slots: int,
                 np.int32)
             batcher.submit(warm, 2).result(timeout=600.0)
             t0 = time.monotonic()
-            for w in workload:
-                # a well-behaved client: 429-class rejections retry with
-                # backoff (same contract as run_continuous)
-                while True:
-                    try:
-                        handles.append(
-                            batcher.submit(w["prompt"], w["max_new"]))
-                        break
-                    except (QueueFull, PoolSaturated):
-                        if time.monotonic() - t0 > deadline_s:
-                            raise
-                        time.sleep(0.02)
+            handles, _ = _submit_with_backpressure(
+                batcher, workload, deadline_s, t0)
             if resize:
                 # wait until decode is genuinely in flight, then resize
                 # under load: shrink (defers until live fits), grow back
@@ -489,13 +500,220 @@ def run_mesh_resize(model, workload, max_len: int, slots: int,
     return out
 
 
+def run_speculative_once(model, draft, workload, max_len: int, slots: int,
+                         page_size: int, spec_tokens: int,
+                         deadline_s: float) -> Dict:
+    """One timed pass of the workload: plain greedy when `draft` is None,
+    draft-verify speculative otherwise. Returns tokens/s, token lists
+    (the parity evidence), and the batcher's spec stats."""
+    from .continuous import ContinuousBatcher
+
+    batcher = ContinuousBatcher(
+        model, max_len=max_len, num_slots=slots, page_size=page_size,
+        prefix_cache_pages=0, max_queue=max(len(workload), 1),
+        draft_model=draft, spec_tokens=spec_tokens)
+    with batcher:
+        # warmup outside the timed window: compiles chunk/fused-final
+        # chunk (target AND draft) plus the spec dispatch, so the
+        # comparison measures scheduling, not compilation
+        warm = np.zeros(
+            max(1, min(page_size * 2 + 1, max_len - 4)), np.int32)
+        batcher.submit(warm, 3).result(timeout=600.0)
+        batcher.submit(warm, 3).result(timeout=600.0)
+        t0 = time.monotonic()
+        handles, backpressured = _submit_with_backpressure(
+            batcher, workload, deadline_s, t0)
+        results = [h.result(timeout=600.0) for h in handles]
+        wall = time.monotonic() - t0
+        stats = batcher.stats()
+    tokens = sum(len(r) for r in results)
+    return {
+        "wall_s": round(wall, 3),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "dropped": sum(
+            1 for h, w in zip(handles, workload)
+            if h.error is not None or len(h.tokens) != w["max_new"]),
+        "backpressure_retries": backpressured,
+        "token_lists": [[int(t) for t in h.tokens] for h in handles],
+        "spec": stats.get("spec"),
+        "decode_iter_s": stats.get("decode_iter_s"),
+    }
+
+
+def _spec_pricing(model, spec_tokens: int, max_len: int,
+                  slots: int) -> Dict:
+    """The CostModel's view of the two hot dispatches: one plain decode
+    step vs one C = k+1 multi-query verify, with and without the fused
+    tier selected — the predicted side of the speculative win."""
+    from ...ffconst import OpType
+    from ...kernels.registry import KERNELS, PALLAS_COST_GAIN
+    from ...search.machine_model import make_machine_model
+    from ...search.simulator import CostModel
+
+    attn = next(op for op in model.graph.ops.values()
+                if op.op_type == OpType.MULTIHEAD_ATTENTION)
+    machine = make_machine_model(model.config,
+                                 max(1, model.config.total_devices))
+    cost = CostModel(machine, model.config)
+    c = spec_tokens + 1
+    ref_plain = cost.decode_step_time_us(attn, slots, max_len, 1)
+    ref_mq = cost.decode_step_time_us(attn, slots, max_len, c)
+    with KERNELS.override("attention_decode", "pallas"), \
+            KERNELS.override("attention_decode_mq", "pallas"):
+        fused_plain = cost.decode_step_time_us(attn, slots, max_len, 1)
+        fused_mq = cost.decode_step_time_us(attn, slots, max_len, c)
+    return {
+        "decode_us_reference": round(ref_plain, 3),
+        "decode_us_fused": round(fused_plain, 3),
+        "verify_us_reference": round(ref_mq, 3),
+        "verify_us_fused": round(fused_mq, 3),
+        "mq_gain_priced": round(fused_mq / ref_mq, 4) if ref_mq else 0.0,
+        "mq_gain_expected": PALLAS_COST_GAIN["attention_decode_mq"],
+    }
+
+
+def _run_speculative_cli(args) -> int:
+    """Speculative vs plain greedy decode (ISSUE 14 acceptance:
+    token-identical output, nonzero acceptance, >= --spec-speedup
+    tokens/s per chip, fused multi-query kernel parity in interpret
+    mode, CostModel pricing the new family)."""
+    from ...kernels.registry import KERNELS, PALLAS_COST_GAIN
+
+    window = args.prompt_max
+    max_len = args.prompt_max + args.out_max
+    draft_layers = args.draft_layers or args.layers
+    draft_hidden = args.draft_hidden or args.hidden
+    tied = (not args.no_draft_tied and draft_layers == args.layers
+            and draft_hidden == args.hidden)
+    print(f"[serve-bench] speculative: {args.requests} requests,"
+          f" k={args.spec_tokens} draft tokens/iteration, draft"
+          f" layers={draft_layers} hidden={draft_hidden}"
+          f" ({'tied weights' if tied else 'independent weights'})")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    draft = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=draft_hidden, heads=args.heads,
+                          layers=draft_layers)
+    if tied:
+        # weight-tied draft: acceptance ~1.0 by construction, isolating
+        # the scheduling/dispatch win on the CPU twin (a real small
+        # draft's compute ratio needs hardware to show up in wall clock)
+        draft.params = model.params
+    workload = make_workload(args.requests, args.prompt_min,
+                             args.prompt_max, args.out_min, args.out_max,
+                             args.vocab, args.seed)
+
+    # best-of-N both sides: shared-runner outlier armor (same contract
+    # as the fleet bench's --repeats)
+    plain = spec = None
+    for _ in range(max(1, args.repeats)):
+        p = run_speculative_once(model, None, workload, max_len,
+                                 args.slots, args.page_size,
+                                 args.spec_tokens, args.deadline)
+        s = run_speculative_once(model, draft, workload, max_len,
+                                 args.slots, args.page_size,
+                                 args.spec_tokens, args.deadline)
+        if plain is None or p["tokens_per_s"] > plain["tokens_per_s"]:
+            plain = p
+        if spec is None or s["tokens_per_s"] > spec["tokens_per_s"]:
+            spec = s
+    speedup = (spec["tokens_per_s"] / plain["tokens_per_s"]
+               if plain["tokens_per_s"] else 0.0)
+    parity_bad = sum(1 for a, b in zip(spec["token_lists"],
+                                       plain["token_lists"]) if a != b)
+    acc = spec["spec"] or {}
+    print(f"[serve-bench] plain: {plain['tokens']} tokens in"
+          f" {plain['wall_s']}s = {plain['tokens_per_s']} tok/s |"
+          f" speculative: {spec['tokens']} tokens in {spec['wall_s']}s ="
+          f" {spec['tokens_per_s']} tok/s | speedup {speedup:.2f}x"
+          f" (require >= {args.spec_speedup}x)")
+    print(f"[serve-bench] acceptance: {acc.get('accepted', 0)}/"
+          f"{acc.get('proposed', 0)} = {acc.get('acceptance', 0.0):.3f} |"
+          f" parity mismatches {parity_bad} | dropped"
+          f" spec={spec['dropped']} plain={plain['dropped']}")
+
+    # fused multi-query leg: a short rerun with the Pallas kernels
+    # FORCED (interpret mode on CPU) must stay token-identical — the
+    # e2e proof the mq kernel computes what the reference einsum does
+    fused_workload = workload[:min(6, len(workload))]
+    fused_workload = [dict(w, max_new=min(8, w["max_new"]))
+                      for w in fused_workload]
+    fused_ref = run_speculative_once(model, None, fused_workload,
+                                     max_len, args.slots, args.page_size,
+                                     args.spec_tokens, args.deadline)
+    with KERNELS.override("attention_decode", "pallas"), \
+            KERNELS.override("attention_decode_mq", "pallas"):
+        fused = run_speculative_once(model, draft, fused_workload,
+                                     max_len, args.slots,
+                                     args.page_size, args.spec_tokens,
+                                     args.deadline)
+    fused_parity_bad = sum(
+        1 for a, b in zip(fused["token_lists"], fused_ref["token_lists"])
+        if a != b)
+    pricing = _spec_pricing(model, args.spec_tokens, max_len, args.slots)
+    print(f"[serve-bench] fused mq leg: parity mismatches"
+          f" {fused_parity_bad} ({len(fused_workload)} requests,"
+          " interpret mode) | CostModel mq gain"
+          f" {pricing['mq_gain_priced']} (expected"
+          f" {pricing['mq_gain_expected']})")
+
+    failures = []
+    if plain["dropped"] or spec["dropped"]:
+        failures.append(
+            f"dropped/short requests: spec {spec['dropped']}, plain"
+            f" {plain['dropped']}")
+    if parity_bad:
+        failures.append(
+            f"{parity_bad} requests' greedy tokens differ between"
+            " speculative and plain decode")
+    if not acc.get("accepted"):
+        failures.append("draft acceptance stayed zero")
+    if speedup < args.spec_speedup:
+        failures.append(
+            f"speculative speedup {speedup:.2f}x below required"
+            f" {args.spec_speedup}x")
+    if fused["dropped"] or fused_parity_bad:
+        failures.append(
+            f"fused multi-query leg: {fused_parity_bad} parity"
+            f" mismatches, {fused['dropped']} dropped")
+    if abs(pricing["mq_gain_priced"]
+           - PALLAS_COST_GAIN["attention_decode_mq"]) > 1e-6:
+        failures.append(
+            "CostModel does not price the attention_decode_mq family:"
+            f" gain {pricing['mq_gain_priced']}, expected"
+            f" {pricing['mq_gain_expected']}")
+    _check_exposition(failures, extra_required=(
+        "ff_spec_decode_proposed_total", "ff_spec_decode_accepted_total",
+        "ff_spec_decode_acceptance"))
+    report = {
+        "config": vars(args),
+        "speculative": {
+            "tokens_per_s_per_chip": spec["tokens_per_s"],
+            "plain_tokens_per_s_per_chip": plain["tokens_per_s"],
+            "speedup": round(speedup, 3),
+            "acceptance": round(acc.get("acceptance", 0.0), 4),
+            "proposed": acc.get("proposed", 0),
+            "accepted": acc.get("accepted", 0),
+            "spec_tokens": args.spec_tokens,
+            "draft_tied": tied,
+            "parity_mismatches": parity_bad,
+            "fused_parity_mismatches": fused_parity_bad,
+            "dropped": spec["dropped"] + plain["dropped"],
+            "pricing": pricing,
+        },
+    }
+    return _finish(args, report, failures)
+
+
 def run_bench(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="flexflow_tpu serve-bench",
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
                     choices=("mixed", "shared-prefix", "long-prefill",
-                             "mesh-resize", "fleet"))
+                             "mesh-resize", "fleet", "speculative"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -567,7 +785,25 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--repeats", type=int, default=3,
                     help="static routing runs per policy; the best"
                          " steady-state p99 of each is compared (fleet —"
-                         " outlier armor for shared runners)")
+                         " outlier armor for shared runners; speculative"
+                         " reuses it as best-of-N per decode mode)")
+    # speculative workload (draft-verify decoding, ISSUE 14)
+    ap.add_argument("--spec-tokens", type=int, default=3,
+                    help="draft proposals per slot per iteration"
+                         " (speculative)")
+    ap.add_argument("--spec-speedup", type=float, default=1.3,
+                    help="require speculative/plain tokens/s >= this"
+                         " (speculative)")
+    ap.add_argument("--draft-layers", type=int, default=None,
+                    help="draft model layers (speculative; default ="
+                         " target's)")
+    ap.add_argument("--draft-hidden", type=int, default=None,
+                    help="draft model hidden dim (speculative; default ="
+                         " target's)")
+    ap.add_argument("--no-draft-tied", action="store_true",
+                    help="keep the draft's own random weights instead of"
+                         " tying them to the target (speculative;"
+                         " acceptance is then whatever the draft earns)")
     args = ap.parse_args(argv)
 
     if args.workload == "shared-prefix":
@@ -576,6 +812,8 @@ def run_bench(argv=None) -> int:
         return _run_long_prefill_cli(args)
     if args.workload == "mesh-resize":
         return _run_mesh_resize_cli(args)
+    if args.workload == "speculative":
+        return _run_speculative_cli(args)
     if args.workload == "fleet":
         from ..fleet.bench import run_fleet_cli
 

@@ -204,7 +204,8 @@ class _Slot:
     """One active sequence bound to a pool slot."""
 
     __slots__ = ("req", "slot", "pos", "emitted", "last_tok", "key",
-                 "t_last_emit", "plen", "filled", "shared", "small")
+                 "t_last_emit", "plen", "filled", "shared", "small",
+                 "draft_small", "draft_filled")
 
     def __init__(self, req: GenRequest, slot: int, key: np.ndarray):
         self.req = req
@@ -221,6 +222,11 @@ class _Slot:
         #                       cache (pinned shared pages; CoW boundary)
         self.small = None     # per-prefill batch-1 caches, dropped at the
         #                       finish scatter
+        self.draft_small = None  # the DRAFT model's batch-1 prefill
+        #                       caches (speculative decoding only)
+        self.draft_filled = 0    # prompt tokens in the draft's cache —
+        #                       always from 0, even on a prefix-cache hit
+        #                       (the band holds TARGET-geometry pages)
 
 
 class ContinuousBatcher:
@@ -241,6 +247,19 @@ class ContinuousBatcher:
     band (default: two slots' worth when chunking; 0 disables reuse —
     see kvpool.PrefixCache for the sharing/CoW contract).
 
+    Speculative decoding (docs/serving.md): pass a compiled causal
+    `draft_model` (same vocab) and `spec_tokens=k`. Every decode
+    iteration then runs ONE fused dispatch — k unrolled greedy draft
+    steps over the draft's own slot-dense caches, then the target
+    scoring the pending token plus all k proposals through the
+    multi-query decode entry — and emits each slot's longest accepted
+    prefix (capped at k tokens/iteration; the classic k+1 bonus is
+    traded for fixed dispatch shapes). Greedy output is token-identical
+    to non-speculative greedy regardless of the draft. Greedy-only and
+    chunked-prefill-only; the draft prefills the full prompt through
+    its own chunk stream (prefix-cache hits install target-geometry
+    pages only).
+
     Metrics default to the PROCESS-WIDE obs registry (like ff_checkpoint_*
     and ff_watchdog_*), which every server's /metrics already concatenates
     — passing a per-server registry here would render duplicate families.
@@ -253,7 +272,8 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  registry=None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_cache_pages: Optional[int] = None):
+                 prefix_cache_pages: Optional[int] = None,
+                 draft_model=None, spec_tokens: int = 3):
         if getattr(model.executor, "mesh", None) is not None:
             # a mesh is fine as long as nothing is actually partitioned
             # (the common replicated case — e.g. a dp axis the batch does
@@ -303,6 +323,61 @@ class ContinuousBatcher:
                          if op.op_type == OpType.MULTIHEAD_ATTENTION]
         if not self.attn_ops:
             raise ValueError("generation needs multihead_attention ops")
+
+        # speculative decoding (docs/serving.md): a draft model proposes
+        # `spec_tokens` greedy candidates per slot per iteration, the
+        # target scores all of them plus the pending token in ONE fused
+        # multi-query dispatch (ops/attention.py vector C>1 decode
+        # entry), and the longest matching prefix is emitted — greedy
+        # output stays token-identical to non-speculative greedy,
+        # rejected suffixes just roll the write-back pointer back.
+        self.draft_model = draft_model
+        self.spec_tokens = int(spec_tokens) if draft_model is not None else 0
+        self.draft_attn_ops = []
+        if draft_model is not None:
+            if self.spec_tokens < 2:
+                # the emission cap (m = min(n_acc+1, k), which keeps the
+                # draft exactly one token behind) means k=1 can emit at
+                # most one token per iteration — a guaranteed regression
+                # vs plain decode, so it is rejected rather than allowed
+                # to silently serve slower
+                raise ValueError(
+                    f"spec_tokens={spec_tokens}: need >= 2 — emission is"
+                    " capped at spec_tokens tokens/iteration, so k=1 can"
+                    " never beat plain decode")
+            if self.temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (temperature 0):"
+                    " sampled acceptance needs rejection sampling, which"
+                    " this batcher does not implement")
+            if self.prefill_chunk_tokens == 0:
+                raise ValueError(
+                    "speculative decoding requires chunked prefill"
+                    " (prefill_chunk_tokens > 0): the draft model"
+                    " prefills its own cache through the chunk entry")
+            if self.spec_tokens + 1 > self.window:
+                raise ValueError(
+                    f"spec_tokens={self.spec_tokens}: the verify dispatch"
+                    f" feeds {self.spec_tokens + 1} query tokens, more"
+                    f" than the target's declared window ({self.window})")
+            draft_window = draft_model.input_ops[0].outputs[0].dims[1]
+            if draft_window < self.prefill_chunk_tokens:
+                raise ValueError(
+                    f"draft window ({draft_window}) smaller than the"
+                    f" prefill chunk ({self.prefill_chunk_tokens}): the"
+                    " draft prefills through the same chunk entry")
+            self.draft_attn_ops = [
+                op for op in draft_model.graph.ops.values()
+                if op.op_type == OpType.MULTIHEAD_ATTENTION]
+            if not self.draft_attn_ops:
+                raise ValueError(
+                    "draft model needs multihead_attention ops")
+            tvocab = model.final_tensor.dims[-1]
+            dvocab = draft_model.final_tensor.dims[-1]
+            if tvocab != dvocab:
+                raise ValueError(
+                    f"draft vocab ({dvocab}) != target vocab ({tvocab}):"
+                    " proposals must be scoreable by the target")
         # prefix cache sizing: default two slots' worth of band pages when
         # chunked prefill is on (the hit path needs the chunk-offset entry
         # to prefill just the suffix); 0 disables reuse
@@ -328,9 +403,17 @@ class ContinuousBatcher:
         if num_slots is None:
             # the band lives in HBM next to the decode slots: carve it out
             # of the derived capacity so the memory model stays honest
-            num_slots = max(1, derive_num_slots(model, self.max_len,
-                                                machine=machine)
-                            - band_slots)
+            derived = derive_num_slots(model, self.max_len, machine=machine)
+            if draft_model is not None:
+                # the draft's slot-dense caches live beside the target's:
+                # scale the derived capacity by the combined per-token
+                # cache cost so the HBM estimate stays honest
+                from .kvpool import kv_bytes_per_token
+
+                tb = kv_bytes_per_token(model)
+                db = kv_bytes_per_token(draft_model)
+                derived = max(1, int(derived * tb / max(1, tb + db)))
+            num_slots = max(1, derived - band_slots)
         self.num_slots = int(num_slots)
 
         if registry is None:
@@ -363,6 +446,7 @@ class ContinuousBatcher:
         self._build_fns()
         self._caches = self._zero_caches()
         self._band = self._zero_band()
+        self._draft_caches = self._zero_draft_caches()
         self._rid = itertools.count()
         self._queue: List[GenRequest] = []
         self._slots: List[Optional[_Slot]] = [None] * self.num_slots
@@ -392,6 +476,21 @@ class ContinuousBatcher:
         self._g_decode_iter = registry.gauge(
             "ff_serving_decode_iter_ms",
             "Measured decode-iteration wall, EWMA", labels=("pool",))
+        # speculative decoding instrumentation (docs/observability.md)
+        self._ewma_spec_accept: Optional[float] = None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if self.draft_model is not None:
+            self._c_spec_proposed = registry.counter(
+                "ff_spec_decode_proposed_total",
+                "Draft tokens proposed for verification")
+            self._c_spec_accepted = registry.counter(
+                "ff_spec_decode_accepted_total",
+                "Draft tokens accepted by the target's greedy verify")
+            self._g_spec_accept = registry.gauge(
+                "ff_spec_decode_acceptance",
+                "EWMA draft-token acceptance rate (accepted/proposed)",
+                labels=("pool",))
 
     # -- jitted device functions ------------------------------------------
     def _zero_caches(self):
@@ -428,15 +527,35 @@ class ContinuousBatcher:
             for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
         }
 
-    def _zero_small(self):
-        """Fresh batch-1 caches for one chunked prefill: chunks attend and
-        write here (positions [0, filled)), and the finish step scatters
-        the first max_len rows into the sequence's pool slot in one
-        update. The extra chunk-1 SLACK rows absorb the final chunk's
-        fixed-width padded write: the last chunk always dispatches at full
-        chunk width starting as late as position plen-1 <= max_len-1, and
-        without the slack `dynamic_update_slice` would CLAMP that write at
-        the array edge, silently shifting real prompt K/V rows (pinned by
+    def _zero_draft_caches(self):
+        """The draft model's slot-dense KV caches, mirroring the target's
+        geometry slot-for-slot (row p of slot i holds the draft's K/V of
+        sequence i's token at position p). None without speculation."""
+        import jax.numpy as jnp
+
+        if self.draft_model is None:
+            return None
+        return {
+            name: {
+                "k_cache": jnp.zeros(
+                    (self.num_slots, self.max_len, heads, kdim), cdt),
+                "v_cache": jnp.zeros(
+                    (self.num_slots, self.max_len, heads, vdim), cdt),
+            }
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(
+                self.draft_model)
+        }
+
+    def _zero_small(self, model=None):
+        """Fresh batch-1 caches for one chunked prefill (of `model`,
+        default the target): chunks attend and write here (positions
+        [0, filled)), and the finish step scatters the first max_len rows
+        into the sequence's pool slot in one update. The extra chunk-1
+        SLACK rows absorb the final chunk's fixed-width padded write: the
+        last chunk always dispatches at full chunk width starting as late
+        as position plen-1 <= max_len-1, and without the slack
+        `dynamic_update_slice` would CLAMP that write at the array edge,
+        silently shifting real prompt K/V rows (pinned by
         tests/test_prefix_cache.py::test_chunked_prefill_last_chunk_never_clamps)."""
         import jax.numpy as jnp
 
@@ -446,7 +565,8 @@ class ContinuousBatcher:
                 "k_cache": jnp.zeros((1, rows, heads, kdim), cdt),
                 "v_cache": jnp.zeros((1, rows, heads, vdim), cdt),
             }
-            for name, heads, kdim, vdim, cdt in kv_cache_spec(self.model)
+            for name, heads, kdim, vdim, cdt in kv_cache_spec(
+                model if model is not None else self.model)
         }
 
     def _build_fns(self):
@@ -527,35 +647,36 @@ class ContinuousBatcher:
             }
             return next_tok, new_caches
 
-        def prefill_chunk(params, state, small, tokens, off):
-            """One chunked-prefill step for ONE request: tokens (1, C) at
-            prompt offset `off`, run through the chunk-offset decode entry
-            (ops/attention.py _decode_step, scalar pos, C queries) against
-            the request's batch-1 caches. Returns the chunk's (1, C, V)
-            probs and the updated caches. Padded tail positions of the
-            last chunk write garbage rows at positions >= plen — harmless,
-            because decode overwrites row p before any query can attend
-            it."""
+        def chunk_forward(executor_, input_name_, attn_names_, params,
+                          state, small, tokens, off):
+            """The chunk-offset forward shared by TARGET and DRAFT
+            prefill: run C tokens at prompt offset `off` through the
+            chunk-offset decode entry (ops/attention.py _decode_step,
+            scalar pos, C queries) against batch-1 caches; returns
+            (final-tensor values, updated caches). Padded tail positions
+            of the last chunk write garbage rows at positions >= plen —
+            harmless, because decode overwrites row p before any query
+            can attend it."""
             st = {**state, **small}
-            values, new_state, _ = executor.forward_values(
-                params, st, {input_name: tokens}, None,
+            values, new_state, _ = executor_.forward_values(
+                params, st, {input_name_: tokens}, None,
                 CompMode.COMP_MODE_INFERENCE, decode_pos=off)
-            probs = values[final_guid]  # (1, C, V)
-            new_small = {
+            return values, {
                 name: {"k_cache": new_state[name]["k_cache"],
                        "v_cache": new_state[name]["v_cache"]}
-                for name in attn_names
+                for name in attn_names_
             }
-            return probs, new_small
 
-        def _scatter_and_pick(caches, small, slot, probs, idx, pos, key):
-            # [:max_len]: the batch-1 caches carry chunk-1 slack rows (see
-            # _zero_small) that must not spill into the pool slot
-            new_caches = {}
-            for name in attn_names:
-                kc = caches[name]["k_cache"]
-                vc = caches[name]["v_cache"]
-                new_caches[name] = {
+        def scatter_span(pool_caches, small, slot, attn_names_):
+            """Batch-1 -> pool-slot cache-span scatter, shared by the
+            target's fused finish AND the draft's. [:max_len]: the
+            batch-1 caches carry chunk-1 slack rows (see _zero_small)
+            that must not spill into the pool slot."""
+            out = {}
+            for name in attn_names_:
+                kc = pool_caches[name]["k_cache"]
+                vc = pool_caches[name]["v_cache"]
+                out[name] = {
                     "k_cache": jax.lax.dynamic_update_slice(
                         kc,
                         small[name]["k_cache"][:, :max_len].astype(kc.dtype),
@@ -565,6 +686,18 @@ class ContinuousBatcher:
                         small[name]["v_cache"][:, :max_len].astype(vc.dtype),
                         (slot, 0, 0, 0)),
                 }
+            return out
+
+        def prefill_chunk(params, state, small, tokens, off):
+            """One chunked-prefill step for ONE request; returns the
+            chunk's (1, C, V) probs and the updated batch-1 caches."""
+            values, new_small = chunk_forward(
+                executor, input_name, attn_names, params, state, small,
+                tokens, off)
+            return values[final_guid], new_small
+
+        def _scatter_and_pick(caches, small, slot, probs, idx, pos, key):
+            new_caches = scatter_span(caches, small, slot, attn_names)
             row = jax.lax.dynamic_slice(
                 probs, (0, idx, 0), (1, 1, probs.shape[2]))[0, 0]  # (V,)
             tok = pick_row(row, pos, key)
@@ -577,18 +710,11 @@ class ContinuousBatcher:
             and pick the first output token — one dispatch, so a prompt
             that fits a single chunk prefills as cheaply as the one-shot
             path did."""
-            st = {**state, **small}
-            values, new_state, _ = executor.forward_values(
-                params, st, {input_name: tokens}, None,
-                CompMode.COMP_MODE_INFERENCE, decode_pos=off)
-            probs = values[final_guid]  # (1, C, V)
-            new_small = {
-                name: {"k_cache": new_state[name]["k_cache"],
-                       "v_cache": new_state[name]["v_cache"]}
-                for name in attn_names
-            }
-            return _scatter_and_pick(caches, new_small, slot, probs, idx,
-                                     pos, key)
+            values, new_small = chunk_forward(
+                executor, input_name, attn_names, params, state, small,
+                tokens, off)
+            return _scatter_and_pick(caches, new_small, slot,
+                                     values[final_guid], idx, pos, key)
 
         def install_prefix(small, band, src_slot, src_row, n_rows):
             """Prefix-cache HIT: gather the matched band pages' K/V rows
@@ -646,6 +772,101 @@ class ContinuousBatcher:
                                       donate_argnums=(2,))
         self._install_fn = jax.jit(install_prefix, donate_argnums=(0,))
         self._insert_fn = jax.jit(insert_pages, donate_argnums=(0,))
+
+        if self.draft_model is None:
+            return
+        # -- speculative decoding (draft + fused multi-query verify) ----
+        draft = self.draft_model
+        dexecutor = draft.executor
+        dfinal_guid = draft.final_tensor.guid
+        dinput_name = draft.input_ops[0].name
+        dattn_names = [op.name for op in self.draft_attn_ops]
+        k_spec = self.spec_tokens
+
+        def draft_chunk(dparams, dstate, small, tokens, off):
+            """One draft prefill chunk — `prefill_chunk` for the draft
+            model (its probs are discarded; only the K/V matter)."""
+            _, new_small = chunk_forward(
+                dexecutor, dinput_name, dattn_names, dparams, dstate,
+                small, tokens, off)
+            return new_small
+
+        def draft_last_chunk(dparams, dstate, dcaches, small, tokens,
+                             off, slot):
+            """The draft's FINAL prefill chunk fused with the scatter of
+            its whole batch-1 cache span into its pool slot — the
+            pick-free sibling of `prefill_last_chunk`."""
+            _, new_small = chunk_forward(
+                dexecutor, dinput_name, dattn_names, dparams, dstate,
+                small, tokens, off)
+            return scatter_span(dcaches, new_small, slot, dattn_names)
+
+        def spec_decode_all(params, state, caches, dparams, dstate,
+                            dcaches, toks, pos):
+            """One SPECULATIVE decode iteration over every slot, ONE
+            dispatch: the draft proposes `k_spec` greedy tokens per slot
+            (unrolled autoregressive steps over its own caches), the
+            target scores the pending token plus all proposals in one
+            fused multi-query decode (C = k_spec+1), and the longest
+            matching prefix is accepted.
+
+            Emission is CAPPED at k_spec tokens (the classic k+1 bonus
+            on full acceptance is traded away) so the draft's cache
+            stays exactly one token behind the target's: the next
+            iteration's first draft step consumes exactly `last_tok`,
+            keeping every dispatch shape fixed. Rejected proposals'
+            cache rows (target rows pos+m..pos+k, draft rows
+            pos+m..pos+k-1) are never cleaned: the write-back pointer
+            just does not advance over them, the causal mask hides them,
+            and the next iteration's writes land on top of them before
+            any query can attend that far.
+
+            Returns (emitted (S, k_spec) target tokens — first counts[i]
+            valid per slot, counts (S,), n_acc (S,) raw verify matches
+            BEFORE the emission cap — the acceptance-rate numerator,
+            new target caches, new draft caches)."""
+            cur = toks
+            dc = dcaches
+            props = []
+            for j in range(k_spec):
+                st = {**dstate,
+                      **{name: dict(dc[name]) for name in dattn_names}}
+                values, new_state, _ = dexecutor.forward_values(
+                    dparams, st, {dinput_name: cur[:, None]}, None,
+                    CompMode.COMP_MODE_INFERENCE, decode_pos=pos + j)
+                cur = jnp.argmax(values[dfinal_guid][:, 0, :],
+                                 axis=-1).astype(jnp.int32)
+                props.append(cur)
+                dc = {
+                    name: {"k_cache": new_state[name]["k_cache"],
+                           "v_cache": new_state[name]["v_cache"]}
+                    for name in dattn_names
+                }
+            props = jnp.stack(props, axis=1)                  # (S, k)
+            qtoks = jnp.concatenate([toks[:, None], props], axis=1)
+            st = {**state,
+                  **{name: dict(caches[name]) for name in attn_names}}
+            values, new_state, _ = executor.forward_values(
+                params, st, {input_name: qtoks}, None,
+                CompMode.COMP_MODE_INFERENCE, decode_pos=pos)
+            probs = values[final_guid]                        # (S, k+1, V)
+            tgt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            # greedy accept: proposal j survives while every proposal
+            # before it matched the target's own argmax at that position
+            match = (props == tgt[:, :k_spec]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            counts = jnp.minimum(n_acc + 1, k_spec)
+            new_caches = {
+                name: {"k_cache": new_state[name]["k_cache"],
+                       "v_cache": new_state[name]["v_cache"]}
+                for name in attn_names
+            }
+            return tgt[:, :k_spec], counts, n_acc, new_caches, dc
+
+        self._draft_chunk_fn = jax.jit(draft_chunk, donate_argnums=(2,))
+        self._draft_last_fn = jax.jit(draft_last_chunk,
+                                      donate_argnums=(2,))
+        self._spec_fn = jax.jit(spec_decode_all, donate_argnums=(2, 5))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -791,6 +1012,13 @@ class ContinuousBatcher:
             1.0 / self._ewma_prefill_s_per_tok, pool=self.pool.label)
 
     def _observe_decode_iter(self, dt: float) -> None:
+        """One decode iteration took `dt` seconds of wall (scheduler
+        thread only). The EWMA stays the RAW per-iteration wall — a
+        prefill chunk interleaved between decode iterations waits one
+        FULL iteration, so the `predicted_ttft_s` interference leg needs
+        walls; the speculative accepted-token accounting enters that
+        model as a cap on HOW MANY walls a prefill can collide with
+        (`_decode_drain_iterations`), never by shrinking the wall."""
         if dt <= 0:
             return
         old = self._ewma_decode_iter_s
@@ -863,14 +1091,55 @@ class ContinuousBatcher:
             if interleaved:
                 import math as _math
 
-                t += _math.ceil(total / chunk) * iter_s
+                iters = _math.ceil(total / chunk)
+                if self.spec_tokens:
+                    # speculative accounting: count ACCEPTED TOKENS per
+                    # iteration, not iterations. Each decode wall
+                    # retires ~k_eff tokens per slot, so decoders drain
+                    # up to spec_tokens x sooner and chunks past the
+                    # drain horizon pay no decode wall — without this
+                    # cap the fatter speculative iteration wall
+                    # over-predicts TTFT and sheds servable traffic
+                    iters = min(iters, self._decode_drain_iterations())
+                t += iters * iter_s
         return t
+
+    def _decode_drain_iterations(self) -> int:
+        """Decode iterations left before every live request's token
+        budget drains at the MEASURED accepted-token rate (k_eff =
+        1 + acceptance x spec_tokens, capped at spec_tokens — the
+        per-iteration emission ceiling). Queued and prefilling requests
+        count at their full budget: they will be decoding inside the
+        prediction window — and because they SERIALIZE through the slot
+        pool, the horizon is bounded below by the TOTAL remaining work
+        over the pool's per-iteration throughput (slots x k_eff), not
+        just the longest single budget. The cap for
+        `predicted_ttft_s`'s chunk-interleave leg under speculation."""
+        import math as _math
+
+        k_eff = 1.0
+        if self.spec_tokens:
+            acc = self._ewma_spec_accept or 0.0
+            k_eff = min(float(self.spec_tokens),
+                        1.0 + acc * self.spec_tokens)
+        k_eff = max(1.0, k_eff)
+        with self._cv:
+            budgets = [s.req.max_new_tokens - s.emitted
+                       for s in self._slots if s is not None]
+            budgets += [r.max_new_tokens for r in self._queue]
+        budgets = [b for b in budgets if b > 0]
+        if not budgets:
+            return 0
+        longest = _math.ceil(max(budgets) / k_eff)
+        pooled = _math.ceil(sum(budgets)
+                            / (max(1, self.num_slots) * k_eff))
+        return max(longest, pooled)
 
     def stats(self) -> Dict[str, object]:
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
             queued = len(self._queue)
-        return {
+        out = {
             "queue_depth": queued,
             "slots_active": active,
             "completed": self._completed,
@@ -884,6 +1153,16 @@ class ContinuousBatcher:
             "pool": self.pool.stats(),
             "admission": self.admission.stats(),
         }
+        if self.draft_model is not None:
+            out["spec"] = {
+                "tokens": self.spec_tokens,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance": (self._spec_accepted / self._spec_proposed
+                               if self._spec_proposed else 0.0),
+                "acceptance_ewma": self._ewma_spec_accept,
+            }
+        return out
 
     # -- scheduler loop ----------------------------------------------------
     def _loop(self) -> None:
@@ -946,6 +1225,10 @@ class ContinuousBatcher:
                     toks[s.slot] = s.last_tok
                     pos[s.slot] = s.pos
                     keys[s.slot] = s.key
+                if self.spec_tokens:
+                    self._spec_iterate(params, state, tracer, active,
+                                       toks, pos)
+                    continue
                 with tracer.span("serve.decode", slots=len(active)):
                     t0 = time.monotonic()
                     next_tok, self._caches = self._decode_fn(
@@ -964,6 +1247,69 @@ class ContinuousBatcher:
             self._fail_all(e)
         finally:
             self._g_active.set(0, pool=self.pool.label)
+
+    def _spec_iterate(self, params, state, tracer, active, toks,
+                      pos) -> None:
+        """One SPECULATIVE decode iteration (scheduler thread only):
+        draft-propose + fused multi-query verify in ONE dispatch
+        (`spec_decode_all`), then host-side emission of each slot's
+        accepted prefix. The write-back pointer (`s.pos`) advances only
+        over accepted tokens — a rejected suffix is rolled back by NOT
+        advancing it, never by touching the cache (its rows are masked
+        out and rewritten before any later query can attend them), so
+        other slots' pages are never involved."""
+        import jax.numpy as jnp
+
+        draft = self.draft_model
+        with tracer.span("serve.spec_verify", slots=len(active),
+                         k=self.spec_tokens):
+            t0 = time.monotonic()
+            emitted, counts, n_acc, self._caches, self._draft_caches = \
+                self._spec_fn(params, state, self._caches, draft.params,
+                              draft.state, self._draft_caches,
+                              jnp.asarray(toks), jnp.asarray(pos))
+            emitted = np.asarray(emitted)
+            counts = np.asarray(counts)
+            n_acc = np.asarray(n_acc)  # sync
+            dt = time.monotonic() - t0
+        # acceptance counts RAW verify matches (draft quality, not the
+        # emission cap's m-1 — a perfect draft reads 1.0, not (k-1)/k),
+        # but only proposals that could still MATTER: a slot with r
+        # budget tokens left can use at most r-1 proposals, and queries
+        # past the budget (which is also the cache edge, plen+max_new <=
+        # max_len) are garbage whose argmax matches mean nothing
+        proposed = accepted = 0
+        for s in active:
+            useful = min(self.spec_tokens,
+                         s.req.max_new_tokens - s.emitted - 1)
+            if useful <= 0:
+                continue
+            proposed += useful
+            accepted += min(int(n_acc[s.slot]), useful)
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._c_spec_proposed.inc(proposed)
+        self._c_spec_accepted.inc(accepted)
+        if proposed:
+            rate = accepted / proposed
+            old = self._ewma_spec_accept
+            self._ewma_spec_accept = rate if old is None else \
+                (1 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * rate
+            self._g_spec_accept.set(self._ewma_spec_accept,
+                                    pool=self.pool.label)
+        self._observe_decode_iter(dt)
+        now = time.monotonic()
+        for s in active:
+            m = int(counts[s.slot])
+            for i in range(m):
+                self._h_itl.observe((now - s.t_last_emit) * 1e3)
+                s.t_last_emit = now
+                self.pool.extend(s.req.id, 1)
+                s.pos += 1
+                self._emit_token(s, int(emitted[s.slot, i]))
+                if s.req.state is not RequestState.DECODE:
+                    break  # retired (EOS/budget): the rest of the
+                    #        window is garbage past the sequence end
 
     def _maybe_resize(self, tracer) -> None:
         """Apply the pending resize (scheduler thread only). The
@@ -1000,10 +1346,17 @@ class ContinuousBatcher:
             from ...search.machine_model import make_machine_model
             from .kvpool import PoolExhausted
 
+            # the draft's slot-dense caches (speculative decoding) ride
+            # the same migration: same slot map, same owned-row spans
+            # (draft row p mirrors target row p), priced together
+            cache_sets = [("kv", self._caches)]
+            if self._draft_caches is not None:
+                cache_sets.append(("draft_kv", self._draft_caches))
             kv_shapes = {
-                f"kv/{name}/{part}": (tuple(int(d) for d in arr.shape),
-                                      leaf_itemsize(arr.dtype))
-                for name, pair in self._caches.items()
+                f"{tag}/{name}/{part}": (tuple(int(d) for d in arr.shape),
+                                         leaf_itemsize(arr.dtype))
+                for tag, caches in cache_sets
+                for name, pair in caches.items()
                 for part, arr in pair.items()
             }
             live = [s for s in self._slots if s is not None]
@@ -1057,19 +1410,25 @@ class ContinuousBatcher:
             # lock (the cache arrays are touched only by this scheduler
             # thread); server threads keep submitting/reading stats while
             # the copy is in flight — only the pointer swap is locked
-            old_caches = self._caches
-            new_caches: Dict[str, Dict[str, object]] = {}
-            for name, pair in old_caches.items():
-                new_caches[name] = {}
-                for part, arr in pair.items():
-                    buf = jnp.zeros((target,) + tuple(arr.shape[1:]),
-                                    arr.dtype)
-                    if copied:
-                        buf = buf.at[c_dst_sl, c_dst_rw].set(
-                            arr[c_src_sl, c_src_rw])
-                    new_caches[name][part] = buf
+            def migrate(old_caches):
+                new_caches: Dict[str, Dict[str, object]] = {}
+                for name, pair in old_caches.items():
+                    new_caches[name] = {}
+                    for part, arr in pair.items():
+                        buf = jnp.zeros((target,) + tuple(arr.shape[1:]),
+                                        arr.dtype)
+                        if copied:
+                            buf = buf.at[c_dst_sl, c_dst_rw].set(
+                                arr[c_src_sl, c_src_rw])
+                        new_caches[name][part] = buf
+                return new_caches
+
+            new_caches = migrate(self._caches)
+            new_draft = (migrate(self._draft_caches)
+                         if self._draft_caches is not None else None)
             with self._cv:
                 self._caches = new_caches
+                self._draft_caches = new_draft
                 new_slot_list: List[Optional[_Slot]] = [None] * target
                 for s in live:
                     s.slot = slot_map[s.req.id]
@@ -1136,6 +1495,12 @@ class ContinuousBatcher:
                 continue
 
             s.small = self._zero_small()
+            if self.draft_model is not None:
+                # the draft prefills the WHOLE prompt through its own
+                # chunk stream — even on a prefix-cache hit (the band
+                # holds target-geometry pages the draft cannot install)
+                s.draft_small = self._zero_small(self.draft_model)
+                s.draft_filled = 0
             prefix = self.pool.prefix
             if prefix is not None:
                 # leave >= 1 suffix token: the first output token's logits
@@ -1172,11 +1537,20 @@ class ContinuousBatcher:
         chunk = self.prefill_chunk_tokens
         for s in [x for x in self._slots
                   if x is not None and x.req.state is RequestState.PREFILL]:
+            if self.draft_model is not None and s.draft_filled < s.plen:
+                self._step_draft_prefill(s, tracer)
             off = s.filled
             n = min(chunk, s.plen - off)
             tokens = np.zeros((1, chunk), np.int32)
             tokens[0, :n] = s.req.prompt[off:off + n]
             last = off + n >= s.plen
+            if (last and self.draft_model is not None
+                    and s.draft_filled < s.plen):
+                # hold the target's fused final chunk (which emits the
+                # first token and arms decode) until the draft's cache
+                # has the full prompt — the next spec iteration needs
+                # both sides of the sequence
+                continue
             with tracer.span("serve.prefill", request=s.req.id,
                              offset=off, tokens=n):
                 if not last:
@@ -1202,6 +1576,34 @@ class ContinuousBatcher:
             s.last_tok = tok
             self._insert_prefix(s, tracer)
             self._first_token(s, tok)
+
+    def _step_draft_prefill(self, s: _Slot, tracer) -> None:
+        """One DRAFT prefill chunk for a speculative slot (scheduler
+        thread only): same chunk stream as the target's, against the
+        draft's own batch-1 caches; the final chunk scatters the span
+        into the draft's pool slot (no token pick — only K/V matter)."""
+        import jax.numpy as jnp
+
+        chunk = self.prefill_chunk_tokens
+        draft = self.draft_model
+        doff = s.draft_filled
+        dn = min(chunk, s.plen - doff)
+        dtokens = np.zeros((1, chunk), np.int32)
+        dtokens[0, :dn] = s.req.prompt[doff:doff + dn]
+        dlast = doff + dn >= s.plen
+        with tracer.span("serve.draft_prefill", request=s.req.id,
+                         offset=doff, tokens=dn):
+            if not dlast:
+                s.draft_small = self._draft_chunk_fn(
+                    draft.params, draft.state, s.draft_small,
+                    jnp.asarray(dtokens), jnp.asarray(doff, jnp.int32))
+            else:
+                self._draft_caches = self._draft_last_fn(
+                    draft.params, draft.state, self._draft_caches,
+                    s.draft_small, jnp.asarray(dtokens),
+                    jnp.asarray(doff, jnp.int32), s.slot)
+                s.draft_small = None
+        s.draft_filled = doff + dn
 
     def _insert_prefix(self, s: _Slot, tracer) -> None:
         """Register the finished prefill's full prefix pages in the cache

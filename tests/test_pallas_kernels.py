@@ -593,3 +593,269 @@ def test_refit_persists_family_residuals(tmp_path):
     assert reg.residual_source == path
     for fam, r in profile.op_family_residuals.items():
         assert reg.residual(fam) == r
+
+# ---------------------------------------------------------------------
+# multi-query decode kernel (ISSUE 14)
+# ---------------------------------------------------------------------
+def _ref_mq_decode(q, kc, vc, pos, scale):
+    b, c = q.shape[0], q.shape[1]
+    m = kc.shape[1]
+    qpos = pos[:, None] + jnp.arange(c)[None, :]
+    mask = (jnp.arange(m)[None, None, :]
+            <= qpos[:, :, None])[:, None, :, :]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
+                      vc.astype(q.dtype))
+
+
+@pytest.mark.parametrize("block_k", [64, 8])  # single- and multi-block
+def test_fused_multiquery_decode_parity(block_k):
+    from flexflow_tpu.kernels.pallas import (
+        fused_multiquery_decode_attention)
+
+    rng = np.random.RandomState(12)
+    B, C, M, h, d = 5, 3, 24, 3, 8
+    q = _rand(rng, (B, C, h, d))
+    kc = _rand(rng, (B, M, h, d))
+    vc = _rand(rng, (B, M, h, d))
+    # ragged: pos 0 (the query window IS the live prefix) through M-C
+    # (the window ends at the last cache row)
+    pos = jnp.asarray([0, 3, 11, 21, 7], dtype=jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    out = fused_multiquery_decode_attention(
+        q, kc, vc, pos, scale=scale, block_k=block_k, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref_mq_decode(q, kc, vc, pos, scale)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_fused_multiquery_decode_bf16_cache():
+    from flexflow_tpu.kernels.pallas import (
+        fused_multiquery_decode_attention)
+
+    rng = np.random.RandomState(13)
+    B, C, M, h, d = 2, 4, 16, 2, 16
+    q = _rand(rng, (B, C, h, d))
+    kc = _rand(rng, (B, M, h, d), jnp.bfloat16)
+    vc = _rand(rng, (B, M, h, d), jnp.bfloat16)
+    pos = jnp.asarray([5, 12], dtype=jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    for block_k in (64, 8):
+        out = fused_multiquery_decode_attention(
+            q, kc, vc, pos, scale=scale, block_k=block_k, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(_ref_mq_decode(q, kc, vc, pos, scale), np.float32),
+            **BF16_TOL)
+
+
+def test_fused_multiquery_c1_matches_single_query():
+    """C = 1 through the multi-query entry is the single-query kernel's
+    exact math (shared body), in both block regimes."""
+    from flexflow_tpu.kernels.pallas import (
+        fused_multiquery_decode_attention)
+
+    rng = np.random.RandomState(14)
+    B, M, h, d = 3, 24, 2, 8
+    q = _rand(rng, (B, 1, h, d))
+    kc = _rand(rng, (B, M, h, d))
+    vc = _rand(rng, (B, M, h, d))
+    pos = jnp.asarray([0, 9, 23], dtype=jnp.int32)
+    for block_k in (64, 8):
+        a = fused_multiquery_decode_attention(
+            q, kc, vc, pos, scale=0.3, block_k=block_k, interpret=True)
+        b = fused_decode_attention(
+            q, kc, vc, pos, scale=0.3, block_k=block_k, interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_continuous_batcher_fused_decode_multiblock_token_parity():
+    """Satellite 3 (lifts the PR 9 docs caveat): greedy decode through
+    the continuous batcher with BOTH fused decode kernels forced and
+    flash_block_k SMALLER than the cache span — every decode streams
+    multiple KV blocks through the online softmax — stays
+    token-identical to the pure-reference run. Ragged prompts, slot
+    reuse (4 requests through 2 slots), chunked prefill through the
+    multi-query kernel."""
+    from flexflow_tpu.serving.sched import ContinuousBatcher
+    from tests.test_generate import _build_lm
+
+    rng = np.random.RandomState(15)
+    prompts = [rng.randint(1, 50, size=(n,)).astype(np.int32)
+               for n in (4, 9, 3, 7)]
+
+    def run(forced):
+        lm = _build_lm(2, 12)
+        lm.config.flash_block_k = 8  # cache span 24 -> 3 KV blocks
+        import contextlib
+        with contextlib.ExitStack() as st:
+            for fam in forced:
+                st.enter_context(KERNELS.override(fam, "pallas"))
+            with ContinuousBatcher(lm, max_len=24, num_slots=2,
+                                   page_size=4, max_queue=8) as cb:
+                return [r.result(timeout=300).tolist()
+                        for r in [cb.submit(p, 10) for p in prompts]]
+
+    ref = run(())
+    fused = run(("attention_decode", "attention_decode_mq"))
+    assert fused == ref
+
+
+def test_chunk_offset_prefill_lowers_through_mq_kernel():
+    """The chunk-offset (scalar-pos) prefill entry lowers through the
+    multi-query kernel when selected: a chunked prefill with the kernel
+    forced produces the same first token and downstream stream as the
+    reference chunk path."""
+    from flexflow_tpu.serving.sched import ContinuousBatcher
+    from tests.test_generate import _build_lm
+
+    lm = _build_lm(2, 12)
+    prompt = np.random.RandomState(16).randint(
+        1, 50, size=(9,)).astype(np.int32)
+
+    def run(force):
+        import contextlib
+        with contextlib.ExitStack() as st:
+            if force:
+                st.enter_context(KERNELS.override("attention_decode_mq",
+                                                  "pallas"))
+            with ContinuousBatcher(lm, max_len=16, num_slots=2,
+                                   page_size=4, prefill_chunk_tokens=4,
+                                   max_queue=4) as cb:
+                return cb.submit(prompt, 5).result(timeout=300).tolist()
+
+    assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------
+# registry: mq family, fitted thresholds, decode pricing
+# ---------------------------------------------------------------------
+def test_registry_mq_family_aliases_attention_residual(tmp_path):
+    import json
+
+    from flexflow_tpu.obs.refit import FittedCoefficients, FittedProfile
+
+    prof = FittedProfile(chip="c", backend="cpu",
+                         coefficients=FittedCoefficients(),
+                         op_family_residuals={"attention": 1.5})
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    assert "attention" in json.load(open(path))["op_family_residuals"]
+    reg = KernelRegistry()
+
+    class Cfg:
+        kernel_impl = "auto"
+        fitted_profile_file = path
+        kernel_residual_threshold = 1.10
+
+    d = reg.select("attention_decode_mq", backend="tpu", config=Cfg(),
+                   record=False)
+    assert d and d.reason == "residual"
+    # no evidence -> reference
+    assert not reg.select("attention_decode_mq", backend="tpu",
+                          record=False)
+
+
+def test_registry_fitted_threshold_overrides_knob(tmp_path):
+    """A profile carrying kernel_residual_thresholds wins over the
+    hand-set --kernel-residual-threshold default: evidence below the
+    knob but above the FITTED threshold selects pallas, and a fitted
+    threshold ABOVE the knob demands the stronger evidence."""
+    from flexflow_tpu.obs.refit import FittedCoefficients, FittedProfile
+
+    def mk(residual, fitted):
+        prof = FittedProfile(
+            chip="c", backend="cpu", coefficients=FittedCoefficients(),
+            op_family_residuals={"attention": residual},
+            kernel_residual_thresholds=(
+                {"attention": fitted} if fitted else {}))
+        path = str(tmp_path / f"p_{residual}_{fitted}.json")
+        prof.save(path)
+
+        class Cfg:
+            kernel_impl = "auto"
+            fitted_profile_file = path
+            kernel_residual_threshold = 1.10
+
+        return Cfg()
+
+    reg = KernelRegistry()
+    # residual 1.05 < knob 1.10: reference without a fitted threshold...
+    assert not reg.select("attention_decode", backend="tpu",
+                          config=mk(1.05, None), record=False)
+    # ...but pallas when the PALLAS impl measured at 1.02
+    assert reg.select("attention_decode", backend="tpu",
+                      config=mk(1.05, 1.03), record=False)
+    # a fitted threshold above the knob demands more evidence
+    assert not reg.select("attention_decode", backend="tpu",
+                          config=mk(1.15, 1.30), record=False)
+    assert reg.select("attention_decode", backend="tpu",
+                      config=mk(1.35, 1.30), record=False)
+
+
+def test_fit_kernel_thresholds_from_pallas_rows():
+    """The fitted threshold is the fused impl's own median residual x
+    margin, floored at 1.0 — derived from before/after measurement rows,
+    replacing the hand-set 1.10 constant."""
+    from flexflow_tpu.obs.calibration import OpCalibration
+    from flexflow_tpu.obs.refit import fit_kernel_thresholds
+
+    rows = [
+        OpCalibration("a1", "multihead_attention", "dp=1", 10.0, 10.4),
+        OpCalibration("a2", "multihead_attention", "dp=1", 10.0, 10.6),
+        OpCalibration("a3", "multihead_attention", "dp=1", 10.0, 10.4),
+        # a fused impl BEATING the roofline still floors at 1.0
+        OpCalibration("ln", "layernorm", "dp=1", 10.0, 7.0),
+        # degenerate rows are excluded
+        OpCalibration("sm", "softmax", "dp=1", 5.0, float("nan"),
+                      error="x"),
+    ]
+    th = fit_kernel_thresholds(rows, margin=1.02)
+    assert th["attention"] == pytest.approx(1.04 * 1.02)
+    assert th["layernorm"] == pytest.approx(1.02)
+    assert "softmax" not in th
+
+
+def test_fitted_thresholds_profile_roundtrip(tmp_path):
+    from flexflow_tpu.obs.refit import (FittedCoefficients, FittedProfile)
+
+    prof = FittedProfile(
+        chip="c", backend="cpu", coefficients=FittedCoefficients(),
+        kernel_residual_thresholds={"attention": 1.07, "layernorm": 1.0})
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    assert (FittedProfile.load(path, expect_backend="cpu")
+            .kernel_residual_thresholds
+            == {"attention": 1.07, "layernorm": 1.0})
+
+
+def test_cost_model_prices_decode_dispatches():
+    """decode_step_time_us prices the serving hot dispatches through the
+    kernel tier: fused/reference ratio is exactly the family's
+    PALLAS_COST_GAIN, the multi-query dispatch costs more than the
+    single-query one, and C rides through the mq family."""
+    from flexflow_tpu.ffconst import OpType
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.simulator import CostModel
+    from tests.test_generate import _build_lm
+
+    lm = _build_lm(2, 12)
+    attn = next(op for op in lm.graph.ops.values()
+                if op.op_type == OpType.MULTIHEAD_ATTENTION)
+    machine = make_machine_model(lm.config, 1)
+    cost = CostModel(machine, lm.config)
+    ref1 = cost.decode_step_time_us(attn, 4, 64, 1)
+    ref4 = cost.decode_step_time_us(attn, 4, 64, 4)
+    # the mq dispatch streams the SAME cache once for all C queries —
+    # at decode sizes the roofline is bytes-bound, so C is (near) free:
+    # that amortization is the whole speculative-decoding win
+    assert ref4 >= ref1 > 0
+    with force_pallas("attention_decode", "attention_decode_mq"):
+        cost2 = CostModel(machine, lm.config)
+        assert cost2.decode_step_time_us(attn, 4, 64, 1) / ref1 == \
+            pytest.approx(PALLAS_COST_GAIN["attention_decode"])
+        assert cost2.decode_step_time_us(attn, 4, 64, 4) / ref4 == \
+            pytest.approx(PALLAS_COST_GAIN["attention_decode_mq"])

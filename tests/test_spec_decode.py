@@ -1,0 +1,271 @@
+"""Speculative draft-verify decoding through the continuous batcher
+(ISSUE 14): greedy output is TOKEN-IDENTICAL to non-speculative greedy
+regardless of the draft (tied, untied, at EOS, under slot reuse, on
+prefix-cache hits, across a mesh resize), acceptance accounting, the
+accepted-token EWMA normalization, and the repository's draft entry.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu.serving.sched import ContinuousBatcher
+from tests.conftest import module_xla_cache
+from tests.test_generate import _build_lm
+
+# module-scoped XLA compilation cache — see conftest.module_xla_cache
+_xla_cache = pytest.fixture(scope="module", autouse=True)(module_xla_cache)
+
+MAX_LEN = 40
+SLOTS = 3
+
+
+@pytest.fixture(scope="module")
+def target():
+    return _build_lm(SLOTS, 12)
+
+
+@pytest.fixture(scope="module")
+def tied_draft(target):
+    """Same architecture, the TARGET's weights: acceptance ~1.0 by
+    construction."""
+    d = _build_lm(SLOTS, 12)
+    d.params = target.params
+    return d
+
+
+@pytest.fixture(scope="module")
+def untied_draft():
+    """A genuinely different (smaller) draft: low/zero acceptance, but
+    parity must hold anyway — the verify step, not the draft, decides
+    every emitted token."""
+    return _build_lm(SLOTS, 12, hidden=16, heads=2, layers=1)
+
+
+def _prompts(lens, seed=0, vocab=50):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(n,)).astype(np.int32) for n in lens]
+
+
+def _run(model, draft, work, k=3, eos_id=None, registry=None,
+         prefix_pages=0, **kw):
+    b = ContinuousBatcher(model, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, max_queue=16,
+                          prefix_cache_pages=prefix_pages,
+                          draft_model=draft, spec_tokens=k,
+                          registry=registry, **kw)
+    with b:
+        hs = [b.submit(p, n, eos_id=eos_id) for p, n in work]
+        outs = [h.result(timeout=300.0).tolist() for h in hs]
+    return outs, b.stats(), hs
+
+
+def test_spec_parity_tied_draft_slot_reuse(target, tied_draft):
+    """7 requests through 3 slots: parity under slot reuse, and a tied
+    draft verifies at acceptance 1.0 (the raw verify matches, not the
+    emission cap's m-1)."""
+    work = [(p, 8) for p in _prompts((4, 7, 3, 9, 5, 6, 2), seed=1)]
+    plain, _, _ = _run(target, None, work)
+    spec, st, _ = _run(target, tied_draft, work)
+    assert spec == plain
+    assert st["spec"]["acceptance"] == 1.0
+    assert st["spec"]["proposed"] > 0
+
+
+def test_spec_parity_untied_draft(target, untied_draft):
+    work = [(p, 8) for p in _prompts((4, 7, 3, 9), seed=2)]
+    plain, _, _ = _run(target, None, work)
+    spec, st, _ = _run(target, untied_draft, work)
+    assert spec == plain
+    # acceptance is whatever the draft earns — only the ACCOUNTING is
+    # pinned (proposed counts k per active slot per iteration)
+    assert st["spec"]["proposed"] >= st["spec"]["accepted"] >= 0
+
+
+def test_spec_eos_early_stop(target, tied_draft):
+    """EOS inside an accepted speculation window retires the request at
+    the same token as plain greedy — the rest of the window is
+    discarded."""
+    work = [(p, 12) for p in _prompts((5, 3), seed=3)]
+    plain, _, _ = _run(target, None, work)
+    # pick an EOS that plain decode actually emits mid-stream
+    eos = plain[0][2]
+    plain_eos, _, _ = _run(target, None, work, eos_id=eos)
+    spec_eos, _, _ = _run(target, tied_draft, work, eos_id=eos)
+    assert spec_eos == plain_eos
+    assert len(plain_eos[0]) < 12  # it genuinely stopped early
+
+
+def test_spec_prefix_cache_hit_parity(target, tied_draft):
+    """Prefix-cache hits under speculation: the TARGET installs cached
+    pages (only the suffix prefills), the draft re-prefills the whole
+    prompt, and the output stays token-identical to plain greedy with
+    the same cache. Followers must actually hit."""
+    rng = np.random.RandomState(4)
+    prefix = rng.randint(1, 50, size=(8,)).astype(np.int32)
+    work = [(np.concatenate([prefix,
+                             rng.randint(1, 50, size=(n,)).astype(
+                                 np.int32)]), 6)
+            for n in (3, 2, 4)]
+    pages = 24
+
+    def run(draft):
+        b = ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                              page_size=4, max_queue=16,
+                              prefix_cache_pages=pages,
+                              draft_model=draft, spec_tokens=3)
+        with b:
+            # leader first (cold, inserts the prefix pages), then the
+            # followers — who must hit
+            lead = b.submit(*work[0])
+            first = lead.result(timeout=300.0).tolist()
+            hs = [b.submit(p, n) for p, n in work[1:]]
+            outs = [first] + [h.result(timeout=300.0).tolist()
+                              for h in hs]
+        return outs, lead, hs
+
+    plain, _, _ = run(None)
+    spec, lead, hs = run(tied_draft)
+    assert spec == plain
+    # the leader misses, the followers hit (page-aligned prefix = 2
+    # pages of 4)
+    assert not lead.cache_hit
+    assert all(h.cache_hit for h in hs)
+
+
+def test_spec_resize_parity_migrates_draft_caches(target, tied_draft):
+    """A mid-decode shrink + grow-back under speculation: the draft's
+    slot-dense caches migrate with the target's (same owned-row spans),
+    and every request's greedy tokens survive the topology change."""
+    work = [(p, 14) for p in _prompts((4, 6, 3), seed=5)]
+    ref, _, _ = _run(target, tied_draft, work)
+
+    b = ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, max_queue=16, prefix_cache_pages=0,
+                          draft_model=tied_draft, spec_tokens=3)
+    import time
+
+    with b:
+        hs = [b.submit(p, n) for p, n in work]
+        deadline = time.monotonic() + 300.0
+        while not any(h.tokens for h in hs):
+            if time.monotonic() > deadline:
+                raise RuntimeError("no tokens before resize")
+            time.sleep(0.005)
+        shrink = b.request_resize(2).wait(timeout=300.0)
+        grow = b.request_resize(SLOTS).wait(timeout=300.0)
+        outs = [h.result(timeout=300.0).tolist() for h in hs]
+    assert outs == ref
+    assert shrink["direction"] == "shrink" and grow["direction"] == "grow"
+    assert shrink["migrated_rows"] > 0
+
+
+def test_spec_metrics_and_predicted_ttft_drain_horizon(target,
+                                                       tied_draft):
+    """The new ff_spec_decode_* families render, and predicted_ttft_s
+    counts ACCEPTED TOKENS per iteration (satellite): the interleave leg
+    charges full decode walls, but no more of them than the decode
+    drain horizon — budgets retire at k_eff = 1 + acceptance*k tokens
+    per wall, so a speculative batcher must not over-predict TTFT and
+    shed servable traffic."""
+    import math
+
+    from flexflow_tpu.obs.registry import MetricsRegistry
+    from flexflow_tpu.serving.sched.continuous import GenRequest
+
+    reg = MetricsRegistry()
+    work = [(p, 8) for p in _prompts((4, 5), seed=6)]
+    _, st, _ = _run(target, tied_draft, work, registry=reg)
+    text = reg.render()
+    assert "ff_spec_decode_proposed_total" in text
+    assert "ff_spec_decode_accepted_total" in text
+    assert "ff_spec_decode_acceptance" in text
+    assert st["spec"]["accepted"] > 0
+
+    # unit: a not-started speculative batcher with a fabricated queued
+    # request and measured EWMAs. Full acceptance -> k_eff = k = 3, so
+    # a 30-token budget drains in 10 walls: the interleave leg charges
+    # min(ceil(total/chunk), 10) * RAW wall, where plain accounting
+    # would charge every chunk a wall.
+    def mk(draft, k_eff_expect):
+        b = ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                              page_size=4, registry=MetricsRegistry(),
+                              draft_model=draft, spec_tokens=3,
+                              max_queue=8)
+        b._ewma_prefill_s_per_tok = 0.01
+        b._observe_decode_iter(0.3)
+        assert b.stats()["decode_iter_s"] == pytest.approx(0.3)  # RAW
+        b._ewma_spec_accept = 1.0
+        b._queue.append(GenRequest(0, np.zeros(4, np.int32), 30,
+                                   None, 0))
+        assert b._decode_drain_iterations() == math.ceil(
+            30 / k_eff_expect)
+        return b
+
+    b = mk(tied_draft, 3.0)
+    total = 60 + 4  # queued backlog 4-token prompt + own 60... own only
+    own = 60
+    total = own + 4
+    chunk = b.prefill_chunk_tokens
+    want = own * 0.01 + 4 * 0.01 + min(
+        math.ceil(total / chunk), 10) * 0.3
+    assert b.predicted_ttft_s(own) == pytest.approx(want)
+
+    # plain batcher: every chunk pays a wall (historical semantics)
+    p = ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, registry=MetricsRegistry(),
+                          max_queue=8)
+    p._ewma_prefill_s_per_tok = 0.01
+    p._observe_decode_iter(0.3)
+    p._queue.append(GenRequest(0, np.zeros(4, np.int32), 30, None, 0))
+    want_plain = (own + 4) * 0.01 + math.ceil((own + 4) / chunk) * 0.3
+    assert p.predicted_ttft_s(own) == pytest.approx(want_plain)
+
+
+def test_spec_constructor_validation(target, tied_draft):
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, temperature=0.7,
+                          draft_model=tied_draft, spec_tokens=3)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, prefill_chunk_tokens=0,
+                          draft_model=tied_draft, spec_tokens=3)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, draft_model=tied_draft,
+                          spec_tokens=0)
+    with pytest.raises(ValueError, match="window"):
+        ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, draft_model=tied_draft,
+                          spec_tokens=99)
+    bad_vocab = _build_lm(SLOTS, 12, vocab=17, hidden=16, heads=2,
+                          layers=1)
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
+                          page_size=4, draft_model=bad_vocab,
+                          spec_tokens=3)
+
+
+def test_repository_speculative_entry(target, tied_draft):
+    """A fleet entry with serving.speculative wires the draft into every
+    replica's batcher (draft shared, per-replica draft caches)."""
+    from flexflow_tpu.serving import InferenceServer
+    from flexflow_tpu.serving.repository import ModelRepository
+
+    server = InferenceServer()
+    try:
+        ModelRepository._register_fleet(
+            server, "lm", target,
+            {"mode": "fleet", "replicas": 2, "max_len": MAX_LEN,
+             "num_slots": 2, "page_size": 4,
+             "speculative": {"draft": "lm_draft", "tokens": 2}},
+            draft=tied_draft)
+        router = server._fleets["lm"]
+        assert router.replica_names() == ["r0", "r1"]
+        for name in router.replica_names():
+            batcher = router._replicas[name].batcher
+            assert batcher.draft_model is tied_draft
+            assert batcher.spec_tokens == 2
+        out = server.generate("lm", [[1, 2, 3]], 4)
+        assert [len(t) for t in out] == [4]
+    finally:
+        server.shutdown()
