@@ -68,19 +68,53 @@ def run_analyze(argv: Optional[List[str]] = None) -> int:
 
     strategies = None
     reductions = None
+    provenance_diags = []
     if strategy_path is not None:
         # the one shared preamble compile()'s --import path uses, so the
         # CLI's verdict matches what compile() will actually do (the file
         # is read ONCE here and the parsed spec threaded through)
         import json as _json
 
+        from ..search.plan_cache import (graph_fingerprint,
+                                         machine_fingerprint)
         from ..search.unity import rewrite_and_import_strategy
+        from .diagnostics import make_diag
 
         with open(strategy_path) as f:
             spec = _json.load(f)
+        # provenance check (docs/search.md): the file records which
+        # graph/machine produced it — a mismatch is the "silently
+        # applied to a different graph" hazard, surfaced in THIS
+        # report (the import preamble warns and counts it too)
+        prov = spec.get("provenance") or {}
+        if prov.get("graph_hash"):
+            here = graph_fingerprint(graph)
+            if prov["graph_hash"] != here:
+                provenance_diags.append(make_diag(
+                    "FFTA052",
+                    f"strategy {strategy_path!r} was produced for a"
+                    f" different graph (recorded"
+                    f" {prov['graph_hash'][:12]}..., this model"
+                    f" {here[:12]}...)",
+                    hint="re-export from the current model"))
+        if prov.get("machine_hash"):
+            here_m = machine_fingerprint(
+                make_machine_model(config, n_dev))
+            if prov["machine_hash"] != here_m:
+                provenance_diags.append(make_diag(
+                    "FFTA052",
+                    f"strategy {strategy_path!r} was priced on a"
+                    f" different machine (recorded"
+                    f" {prov['machine_hash'][:12]}..., this machine"
+                    f" {here_m[:12]}...)",
+                    hint="re-search under this --machine-spec/--chips"))
         try:
+            # check_provenance=False: the CLI ran its own check above so
+            # the mismatch lands in THIS report once, not twice in the
+            # process-wide counters
             strategies, axes = rewrite_and_import_strategy(
-                graph, config, strategy_path, spec=spec)
+                graph, config, strategy_path, spec=spec,
+                check_provenance=False)
         except PlanAnalysisError as exc:
             print(exc.report.to_json() if as_json else exc.report.format())
             return 1
@@ -100,6 +134,7 @@ def run_analyze(argv: Optional[List[str]] = None) -> int:
         batch_size=config.batch_size, n_devices=n_dev, mesh_axes=axes,
         reduction_strategies=reductions,
         final_guid=final.guid if final is not None else None)
+    report.extend(provenance_diags)
     record_report(report)
     print(report.to_json() if as_json else report.format())
     if report.ok:

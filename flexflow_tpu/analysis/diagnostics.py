@@ -65,6 +65,7 @@ CODE_CATALOG: Dict[str, tuple] = {
     # -- strategy files (FFTA05x) --
     "FFTA050": (Severity.ERROR, "malformed strategy-file entry"),
     "FFTA051": (Severity.WARNING, "strategy entry matches no op"),
+    "FFTA052": (Severity.WARNING, "strategy provenance mismatch"),
     # -- live resharding (FFTA06x, resharding/) --
     "FFTA060": (Severity.ERROR,
                 "redistribution collective illegal on the target mesh"),

@@ -115,6 +115,36 @@ class FFConfig:
     # refinement (the rest keep their segment-DP strategies); raise for
     # exhaustiveness, lower for compile latency on big graphs
     refine_top_k: int = 4
+    # Incremental search (search/plan_cache.py, docs/search.md): a
+    # content-addressed cache of SearchResults keyed by (pre-rewrite
+    # graph, overlaid machine, batch, devices, search knobs). An exact
+    # hit skips enumeration entirely (still re-validated through the
+    # analysis gate); a near-miss (same graph + knobs, moved machine /
+    # batch) seeds warm-started refinement. --no-plan-cache disables;
+    # --plan-cache-dir adds disk persistence across processes.
+    plan_cache: bool = True
+    plan_cache_dir: Optional[str] = None
+    plan_cache_capacity: int = 32
+    # Warm-started re-planning off a cached near-miss plan
+    # (--no-search-warm-start disables; cold enumeration always wins
+    # when no seed exists). The refined plan falls back to a cold
+    # search when its cost exceeds warm_fallback_tolerance x the warm
+    # sweep's cost floor.
+    search_warm_start: bool = True
+    warm_fallback_tolerance: float = 1.05
+    # Reshard-aware re-planning: weight on the plan-distance term — the
+    # predicted cost (resharding/cost.py) of redistributing the LIVE
+    # weights onto each warm candidate — added to the candidate ranking
+    # when a live plan is present (elastic recovery / drift re-plans).
+    # 0 disables the term.
+    replan_distance_weight: float = 1.0
+    # The LIVE plan (resharding.plan_of of the running model) a re-plan
+    # is moving away from — set by the elastic coordinator on the
+    # configs it hands the rebuild, never from the CLI. Excluded from
+    # the plan-cache key; a warm result the distance term biased beyond
+    # the cost tolerance is NOT cached (SearchResult.cache_store), so a
+    # live-less lookup can never adopt a reshard-biased plan as a hit.
+    replan_live_plan: Optional[object] = None
     # Joint substitution x parallelization search: graph rewrites are
     # best-first search actions costed by their optimal parallelization
     # (reference: base_optimize over candidate graphs, substitution.cc:2229).
@@ -276,6 +306,32 @@ class FFConfig:
                 self.base_optimize_threshold = int(take())
             elif a == "--refine-top-k":
                 self.refine_top_k = int(take())
+            elif a == "--plan-cache-dir":
+                self.plan_cache_dir = take()
+            elif a == "--plan-cache-capacity":
+                v = int(take())
+                if v < 1:
+                    raise ValueError(
+                        f"--plan-cache-capacity must be >= 1, got {v}")
+                self.plan_cache_capacity = v
+            elif a == "--no-plan-cache":
+                self.plan_cache = False
+            elif a == "--no-search-warm-start":
+                self.search_warm_start = False
+            elif a == "--warm-fallback-tolerance":
+                v = float(take())
+                if not v >= 1.0:
+                    raise ValueError(
+                        "--warm-fallback-tolerance must be >= 1.0 (a"
+                        f" refined/floor cost ratio), got {v}")
+                self.warm_fallback_tolerance = v
+            elif a == "--replan-distance-weight":
+                v = float(take())
+                if v < 0:
+                    raise ValueError(
+                        "--replan-distance-weight must be >= 0"
+                        f" (0 disables the term), got {v}")
+                self.replan_distance_weight = v
             elif a == "--strategy-search":
                 v = take()
                 if v not in ("unity", "mcmc"):

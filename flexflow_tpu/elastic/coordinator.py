@@ -64,7 +64,8 @@ from ..runtime.checkpoint import CheckpointError
 from ..runtime.durability import DurableCheckpointer
 from .detector import FailureDetector
 from .events import (CHECKPOINT, DRIFT_BREACH, DRIFT_REFIT, DRIFT_REPLAN,
-                     PLAN_ANALYSIS, RECOVERY_DONE, RECOVERY_LIVE_FALLBACK,
+                     DRIFT_SEARCH, PLAN_ANALYSIS, PLAN_PRECOMPUTE,
+                     RECOVERY_DONE, RECOVERY_LIVE_FALLBACK,
                      RECOVERY_RESTORE, RECOVERY_SEARCH, RECOVERY_START,
                      EventLog)
 from .faults import FaultInjector, FaultPlan, TopologyLoss
@@ -166,8 +167,26 @@ class ElasticCoordinator:
                  drift_detector=None,
                  drift_refit=None,
                  live_resharding: bool = True,
-                 reshard_peak_bytes: Optional[int] = None):
+                 reshard_peak_bytes: Optional[int] = None,
+                 preplan="auto"):
         self.model_builder = model_builder
+        # background pre-planning (docs/search.md): after every (re)build
+        # a worker thread pre-computes plans for ANTICIPATED topologies
+        # (a whole outermost-tier group dropping off a tiered spec, the
+        # last chip of a flat one) into the plan cache, so at event time
+        # the recovery's re-plan is a cache HIT and the search leaves the
+        # recovery pause. "auto" = on whenever the search runs at all
+        # (search_budget > 0) and the plan cache is enabled; an
+        # unanticipated loss just misses and searches cold as before.
+        if preplan == "auto":
+            preplan = (getattr(config, "search_budget", 0) > 0
+                       and getattr(config, "plan_cache", True))
+        self.preplan = bool(preplan)
+        self.planner = None
+        if self.preplan:
+            from ..search.plan_cache import BackgroundPlanner
+
+            self.planner = BackgroundPlanner()
         # zero-disk recovery (resharding/): when the survivors still hold
         # verified live state, recover by redistributing the live arrays
         # onto the re-planned mesh instead of reading a checkpoint.
@@ -245,6 +264,90 @@ class ElasticCoordinator:
         # strategies differ for cost-model reasons, not topology ones
         self.model = self.model_builder(self._config_for(
             self.device_ids, self._write_spec("topology_0.json")))
+        self._preplan_anticipated()
+
+    # -- background pre-planning (docs/search.md) --------------------------
+    def _anticipated_specs(self) -> List[tuple]:
+        """(tag, survivor spec) for the topologies worth pre-planning:
+        a tiered spec losing ONE whole outermost-tier group (any single
+        pod off the DCN shrinks to the same renumbered spec), a flat
+        spec losing its last chip. Unanticipated losses simply miss the
+        cache and search cold, exactly as before."""
+        spec = self._topo_spec
+        out: List[tuple] = []
+        if spec.get("tiers"):
+            if int(spec["tiers"][-1]["degree"]) > 1:
+                inner = 1
+                for t in spec["tiers"][:-1]:
+                    inner *= int(t["degree"])
+                n = int(spec["num_chips"])
+                out.append(("pod_loss", shrink_topology_spec(
+                    spec, list(range(n - inner, n)))))
+        elif int(spec.get("num_chips", len(self.device_ids))) > 1:
+            n = int(spec["num_chips"])
+            out.append(("chip_loss", shrink_topology_spec(spec, [n - 1])))
+        return out
+
+    def _preplan_anticipated(self) -> None:
+        """Queue background searches for the anticipated survivor
+        topologies. Each job runs unity_optimize on a CLONE of the
+        compiled graph, keyed under the original pre-rewrite graph hash
+        (SearchResult.graph_hash), so the recovery-time rebuild — a
+        fresh graph from the same builder — looks up exactly this
+        entry. The current LIVE plan rides along so a warm-started
+        precompute prices the plan-distance term against reality."""
+        if self.planner is None or self.model is None:
+            return
+        sr = getattr(self.model, "search_result", None)
+        if sr is None or sr.graph_hash is None:
+            return  # no searched plan to anticipate from
+        from ..resharding import plan_of
+        from ..search.machine_model import make_machine_model
+        from ..search.unity import unity_optimize
+
+        try:
+            live_plan = plan_of(self.model)
+        except Exception:  # noqa: BLE001 — distance term is optional
+            live_plan = None
+        for tag, spec in self._anticipated_specs():
+            n = int(spec["num_chips"])
+            spec_path = os.path.join(
+                self.checkpoint_dir,
+                f"anticipated_{tag}_{self._recoveries}.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
+            cfg = self._config_for(self.device_ids[:n], spec_path)
+            cfg.replan_live_plan = live_plan
+            # compile() sets this from the real optimizer BEFORE its
+            # search (Adam 3, momentum 2, SGD 1) — mirror the compiled
+            # model's value or the knob leg of the cache key diverges
+            # and the recovery-time lookup only near-misses
+            cfg.optimizer_state_factor = \
+                self.model.config.optimizer_state_factor
+            graph_clone = self.model.graph.clone()
+            base_hash = sr.graph_hash
+
+            def job(cfg=cfg, graph_clone=graph_clone, n=n, tag=tag,
+                    base_hash=base_hash):
+                t0 = time.perf_counter()
+                machine = make_machine_model(cfg, n)
+                res = unity_optimize(graph_clone, cfg, machine,
+                                     cfg.batch_size, n,
+                                     cache_graph_hash=base_hash)
+                self.events.record(
+                    PLAN_PRECOMPUTE, step=self.detector.current_step,
+                    tag=tag, n_devices=n, cache=res.cache_mode,
+                    cost_us=res.cost_us,
+                    wall_ms=round((time.perf_counter() - t0) * 1e3, 3))
+                return {"tag": tag, "n_devices": n,
+                        "cache": res.cache_mode}
+
+            self.planner.submit(f"anticipate:{tag}", job)
+
+    def preplan_join(self, timeout: Optional[float] = None) -> bool:
+        """Block until queued background plans land (tests, drills).
+        True when the queue drained; trivially True with preplan off."""
+        return self.planner.join(timeout) if self.planner else True
 
     def _write_spec(self, fname: str) -> str:
         path = os.path.join(self.checkpoint_dir, fname)
@@ -544,15 +647,35 @@ class ElasticCoordinator:
                                                lost_positions)
         spec_path = self._write_spec(f"survivors_{self._recoveries}.json")
         # 2. re-plan: a fresh compile on the shrunken machine re-runs the
-        # Unity search (when search_budget > 0) against the survivor spec
+        # Unity search (when search_budget > 0) against the survivor
+        # spec. A pre-computed plan for this survivor set makes the
+        # search a cache HIT; a near-miss warm-starts it, with the LIVE
+        # plan threaded through so the candidate ranking prices the
+        # redistribution it would force (docs/search.md).
+        replan_cfg = self._config_for(survivors, spec_path)
+        if live is not None:
+            from ..resharding import plan_of as _plan_of
+
+            try:
+                replan_cfg.replan_live_plan = _plan_of(self.model)
+            except Exception:  # noqa: BLE001 — the distance term is
+                pass           # optional; the re-plan proceeds without
+        t_replan = time.perf_counter()
         with get_tracer().span("elastic.replan", n_devices=len(survivors)):
-            model = self.model_builder(self._config_for(survivors,
-                                                        spec_path))
+            model = self.model_builder(replan_cfg)
+        replan_ms = (time.perf_counter() - t_replan) * 1e3
         sr = model.search_result
+        # search wall time + cache mode recorded HERE, where the win of
+        # background pre-planning is measurable against the recovery pause
         self.events.record(
             RECOVERY_SEARCH, step=self.detector.current_step,
             n_devices=len(survivors), axes=dict(model.parallel_axes),
-            cost_us=(sr.cost_us if sr is not None else None))
+            cost_us=(sr.cost_us if sr is not None else None),
+            search_ms=(round(sr.search_wall_ms, 3)
+                       if sr is not None and sr.search_wall_ms is not None
+                       else None),
+            cache=(sr.cache_mode if sr is not None else None),
+            replan_ms=round(replan_ms, 3))
         self._record_plan_analysis(model, self.detector.current_step)
         # 3. restore — live when the survivors hold verified state (zero
         # disk I/O, resume from the FAILING step), disk otherwise: the
@@ -605,6 +728,9 @@ class ElasticCoordinator:
         self._rearm_drift(model)
         self.events.record(RECOVERY_DONE, step=resume_step,
                            n_devices=len(survivors))
+        # re-anticipate from the NEW topology: the next loss shrinks
+        # from here, and its plan should be waiting too
+        self._preplan_anticipated()
         return resume_step
 
     # -- drift-triggered re-plan -------------------------------------------
@@ -634,8 +760,31 @@ class ElasticCoordinator:
                                    profile=self._fitted_profile_path)
             spec_path = self._write_spec(
                 f"replan_{self._drift_replans}.json")
-            model = self.model_builder(self._config_for(self.device_ids,
-                                                        spec_path))
+            # the mesh is intact — the refreshed fitted profile changed
+            # the MACHINE hash, so this search warm-starts from the
+            # running plan (a near-miss on the same graph+knobs) and its
+            # plan-distance term keeps the refined choice close to the
+            # live layout unless a real win pays for the move
+            replan_cfg = self._config_for(self.device_ids, spec_path)
+            try:
+                from ..resharding import plan_of as _plan_of
+
+                replan_cfg.replan_live_plan = _plan_of(self.model)
+            except Exception:  # noqa: BLE001 — optional term
+                pass
+            model = self.model_builder(replan_cfg)
+            sr = model.search_result
+            if sr is not None:
+                # a DISTINCT kind from recovery.search: consumers of
+                # the recovery stream must never read a drift re-plan's
+                # record as a recovery (and vice versa)
+                self.events.record(
+                    DRIFT_SEARCH, step=step,
+                    n_devices=len(self.device_ids),
+                    axes=dict(model.parallel_axes), cost_us=sr.cost_us,
+                    search_ms=(round(sr.search_wall_ms, 3)
+                               if sr.search_wall_ms is not None else None),
+                    cache=sr.cache_mode)
             # same plan-sanitizer gate + tree-validated restore pipeline
             # recovery re-plans get
             self._record_plan_analysis(model, step)
@@ -650,6 +799,9 @@ class ElasticCoordinator:
         REGISTRY.counter(
             "ff_replan_total",
             "Calibration-drift-triggered budgeted re-plans").inc()
+        # anticipated-topology plans were priced with the OLD profile;
+        # re-plan them in the background under the fitted one
+        self._preplan_anticipated()
         return ckpt_step
 
     # -- training ----------------------------------------------------------

@@ -49,6 +49,13 @@ RECOVERY_LIVE_FALLBACK = "recovery.live_fallback"
 DRIFT_BREACH = "drift.breach"
 DRIFT_REFIT = "drift.refit"
 DRIFT_REPLAN = "drift.replan"
+# the drift re-plan's search record (search_ms/cache/cost) — a separate
+# kind from recovery.search so consumers of either stream never read
+# the other's events
+DRIFT_SEARCH = "drift.search"
+# background pre-planning (search/plan_cache.py BackgroundPlanner): a
+# plan for an ANTICIPATED topology was computed off the critical path
+PLAN_PRECOMPUTE = "plan.precompute"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,6 +27,7 @@ import heapq
 import itertools
 import json
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.graph import Graph
@@ -251,6 +252,23 @@ class SearchResult:
     # "cut_on_tier_boundary", "sync_us"} (pipeline_plan
     # .stage_placement_options); None for non-pipeline plans
     pipeline_placement: Optional[Dict[str, Any]] = None
+    # search provenance (docs/search.md): content hashes of the
+    # PRE-rewrite graph and the overlaid machine this plan was searched
+    # for — the plan-cache key legs, exported so `analyze` can warn
+    # when a strategy JSON is applied to a different graph/machine than
+    # the one that produced it
+    graph_hash: Optional[str] = None
+    machine_hash: Optional[str] = None
+    # how this result was produced and how long it took:
+    # "cold" = full enumeration, "warm" = cached-seed local refinement,
+    # "hit" = plan-cache adoption (enumeration skipped entirely)
+    cache_mode: str = "cold"
+    search_wall_ms: Optional[float] = None
+    # False: do not store this result in the plan cache — set by the
+    # warm path when the plan-distance term biased the choice beyond
+    # the cost tolerance; such a plan is right for THIS live state but
+    # wrong to hand a future live-less lookup as an exact hit
+    cache_store: bool = True
 
 
 class GraphSearchHelper:
@@ -379,22 +397,27 @@ class GraphSearchHelper:
     # -- top level --------------------------------------------------------
     def graph_optimize(self, batch_size: int, n_devices: int,
                        memory_budget_bytes: Optional[float] = None,
-                       rule_spec=None) -> SearchResult:
+                       rule_spec=None, warm_seed=None,
+                       live_plan=None) -> SearchResult:
         from ..obs.tracing import get_tracer
 
         with get_tracer().span("search", n_devices=n_devices,
                                batch_size=batch_size) as sp:
             result = self._graph_optimize_inner(batch_size, n_devices,
                                                 memory_budget_bytes,
-                                                rule_spec)
+                                                rule_spec,
+                                                warm_seed=warm_seed,
+                                                live_plan=live_plan)
             sp.set(cost_us=result.cost_us, axes=result.mesh_axes,
                    simulated=result.candidates_simulated,
-                   pruned=result.candidates_pruned)
+                   pruned=result.candidates_pruned,
+                   cache=result.cache_mode)
             return result
 
     def _graph_optimize_inner(self, batch_size: int, n_devices: int,
                               memory_budget_bytes: Optional[float] = None,
-                              rule_spec=None) -> SearchResult:
+                              rule_spec=None, warm_seed=None,
+                              live_plan=None) -> SearchResult:
         from .substitution import (
             apply_substitutions,
             load_rule_spec,
@@ -440,6 +463,18 @@ class GraphSearchHelper:
                 _log.info(self.log[-1])
             self._greedy_search_rules_ran = bool(applied2)
 
+        # warm start (docs/search.md): a cached near-miss plan — same
+        # graph + knobs, shrunk/grown machine, refreshed profile, or
+        # changed batch — seeds budgeted local refinement instead of the
+        # full factorization enumeration; _warm_optimize returns None to
+        # fall back to the cold search below
+        if warm_seed is not None and self.config.search_budget > 0:
+            warm = self._warm_optimize(warm_seed, batch_size, n_devices,
+                                       memory_budget=memory_budget_bytes,
+                                       live_plan=live_plan)
+            if warm is not None:
+                return self._finalize(warm)
+
         def select(lam: float, final: bool = True) -> SearchResult:
             if joint:
                 # probes must not mutate the real graph (the lambda search
@@ -458,6 +493,12 @@ class GraphSearchHelper:
                                        probe_is_final=not joint)
         else:
             best = select(0.0)
+        return self._finalize(best)
+
+    def _finalize(self, best: SearchResult) -> SearchResult:
+        """Shared epilogue of the cold and warm paths: logs, pruning
+        counters, the calibration anchor, and the per-tier reduction
+        synthesis for the CHOSEN strategies."""
         self.log.append(f"selected: {best.log[-1] if best.log else ''}")
         if self.sim.measured is not None:
             self.log.append(
@@ -485,29 +526,26 @@ class GraphSearchHelper:
             f"simulated, {self.candidates_pruned} pruned before costing")
         return best
 
-    def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
-                     lam: float = 0.0, quiet: bool = False) -> SearchResult:
-        """Best parallelization of a fixed graph under the runtime +
-        lam * memory objective: enumerate mesh factorizations, segment-DP
-        each (reference: Graph::optimal_cost via the DP in graph.cc:1586;
-        lam is the lambda of the memory-aware search, graph.cc:2075)."""
-        from ..obs.tracing import get_tracer
+    def _feasible_factorizations(self, graph: Graph, batch_size: int,
+                                 n_devices: int) -> List[Tuple[int, ...]]:
+        """Enumerate (dp, tp, ep, ap, sp) divisor tuples of the device
+        count and prune the infeasible ones — shared by the cold
+        enumeration (_parallelize) and the warm sweep (_warm_optimize).
 
-        tracer = get_tracer()
-        candidates: List[SearchResult] = []
-        # plan-sanitizer pruning (analysis/passes.py): the cheap
-        # factorization pass rejects infeasible mesh tuples — non-dividing
-        # degrees, unusable axes — before the cost simulator prices them.
-        # analysis_prune=False simulates every divisor tuple instead (the
-        # unpruned baseline tests compare against): dp/tp/ep/ap degrade to
-        # replicated per op inside valid_strategies, and sp — the one axis
-        # whose graph-level blockers (SP disabled, dropout-carrying
-        # attention, ulysses heads) sp_shardable cannot see — is clamped to
-        # 1 here, so both modes can only realize legal degrees. Pruning is
-        # accounted in the SearchResult counters, not the process-wide
-        # diagnostic counters — those mean "a plan was rejected", and
-        # skipping a candidate the search never chose is not a rejection.
+        Plan-sanitizer pruning (analysis/passes.py): the cheap
+        factorization pass rejects infeasible mesh tuples — non-dividing
+        degrees, unusable axes — before the cost simulator prices them.
+        analysis_prune=False simulates every divisor tuple instead (the
+        unpruned baseline tests compare against): dp/tp/ep/ap degrade to
+        replicated per op inside valid_strategies, and sp — the one axis
+        whose graph-level blockers (SP disabled, dropout-carrying
+        attention, ulysses heads) sp_shardable cannot see — is clamped to
+        1 here, so both modes can only realize legal degrees. Pruning is
+        accounted in the SearchResult counters, not the process-wide
+        diagnostic counters — those mean "a plan was rejected", and
+        skipping a candidate the search never chose is not a rejection."""
         from ..analysis import factorization_diagnostics
+        from ..obs.tracing import get_tracer
 
         sp_feasible = make_sp_feasible(graph, self.config)
         prune = getattr(self.config, "analysis_prune", True)
@@ -525,8 +563,8 @@ class GraphSearchHelper:
         if self.config.only_data_parallel:
             tuples = [(n_devices, 1, 1, 1, 1)]
         feasible = []
-        with tracer.span("search.enumerate", n_devices=n_devices,
-                         candidates=len(tuples)) as _sp_enum:
+        with get_tracer().span("search.enumerate", n_devices=n_devices,
+                               candidates=len(tuples)) as _sp_enum:
             for fact in tuples:
                 if prune:
                     if factorization_diagnostics(
@@ -542,6 +580,20 @@ class GraphSearchHelper:
                 feasible.append(fact)
             _sp_enum.set(feasible=len(feasible),
                          pruned=len(tuples) - len(feasible))
+        return feasible
+
+    def _parallelize(self, graph: Graph, batch_size: int, n_devices: int,
+                     lam: float = 0.0, quiet: bool = False) -> SearchResult:
+        """Best parallelization of a fixed graph under the runtime +
+        lam * memory objective: enumerate mesh factorizations, segment-DP
+        each (reference: Graph::optimal_cost via the DP in graph.cc:1586;
+        lam is the lambda of the memory-aware search, graph.cc:2075)."""
+        from ..obs.tracing import get_tracer
+
+        tracer = get_tracer()
+        candidates: List[SearchResult] = []
+        feasible = self._feasible_factorizations(graph, batch_size,
+                                                 n_devices)
         # Stage 1 (cheap): per-segment DP + one full-graph simulate per mesh
         # factorization. Stage 2 (expensive): the cross-segment best-first
         # refinement — O(budget x boundary-ops x menu x simulate) — runs
@@ -847,6 +899,272 @@ class GraphSearchHelper:
         self._memo[key] = best
         return best
 
+    # -- warm-started refinement (docs/search.md) --------------------------
+    def _warm_optimize(self, seed: Dict[str, Any], batch_size: int,
+                       n_devices: int,
+                       memory_budget: Optional[float] = None,
+                       live_plan=None) -> Optional[SearchResult]:
+        """Budgeted local refinement seeded from a cached near-miss plan
+        (same graph + knobs; the machine shrank/grew, the fitted profile
+        refreshed, or the batch changed) instead of the cold
+        factorization enumeration:
+
+         1. QUICK SWEEP: every feasible factorization priced ONCE by
+            transplanting the cached per-op strategies into its legal
+            menus (a structural clamp — nearest by log-2 axis distance,
+            no per-candidate pricing) and running one full-graph
+            simulate — the cost floor the tolerance fallback compares
+            against, at a fraction of the cold stage-1's per-segment
+            flip DP;
+         2. RANK: the sweep's best factorizations plus the one nearest
+            the seed's axes are ranked by simulated cost (plus the
+            plan-distance term below);
+         3. REFINE the winner with `_best_first_flips` — the same
+            budgeted pass the cold search's global refinement uses —
+            over only the ops worth budget: ones whose clamp BROKE the
+            seed's sharding pattern (an axis the op used no longer
+            divides) and CONTESTED ones whose locally-best strategy on
+            the NEW machine disagrees with the transplanted choice (the
+            machine move changed the op's trade-off — e.g. a dp sync
+            that crossed DCN on the old machine but stays on ICI now);
+         4. PLAN DISTANCE: with a LIVE plan present (elastic/drift
+            re-plans), each candidate's ranking adds the predicted
+            redistribution cost of moving the live weights onto it
+            (plan_cache.plan_distance_us, priced via resharding/cost.py)
+            weighted by --replan-distance-weight, so a marginally-
+            cheaper step never triggers a massive reshard.
+
+        Returns None — fall back to the cold search — when the seed
+        carries graph rewrites (replaying them here and then falling
+        back would leave the graph half-rewritten), is a pipeline plan
+        (local flips have no pipeline moves), does not cover this
+        graph's ops, exceeds --warm-fallback-tolerance x the sweep
+        floor (checked only without a live plan: a distance-weighted
+        winner may legitimately trade step time for reshard bytes, and
+        the cold path prices no distance), or misses the memory budget
+        (the lambda search is a cold-path capability)."""
+        import math as _math
+
+        from ..obs.tracing import get_tracer
+
+        if seed.get("applied_rewrites") or seed.get("greedy_search_rules"):
+            self.log.append(
+                "warm start declined: cached plan carries graph rewrites")
+            return None
+        sa = seed.get("mesh_axes") or {}
+        if "stage" in sa:
+            self.log.append(
+                "warm start declined: pipeline seed (no local moves)")
+            return None
+        ops_entry = seed.get("ops") or {}
+        by_name = {op.name: op for op in self.graph.ops.values()}
+        missing = set(by_name) - set(ops_entry)
+        if missing:
+            self.log.append(
+                f"warm start declined: cached plan missing"
+                f" {len(missing)} op(s)")
+            return None
+        facts = self._feasible_factorizations(self.graph, batch_size,
+                                              n_devices)
+        if not facts:
+            return None
+        tracer = get_tracer()
+        seed_fact = (sa.get("data", 1), sa.get("model", 1),
+                     sa.get("expert", 1), sa.get("attr", 1),
+                     sa.get("seq", 1))
+
+        def axdist(f, g) -> float:
+            return sum(abs(_math.log2(max(1, a)) - _math.log2(max(1, b)))
+                       for a, b in zip(f, g))
+
+        def clamp(fact):
+            """Transplant the seed's per-op strategies into `fact`'s
+            legal menus — purely structural (no per-candidate pricing):
+            nearest by axis distance, preferring a matching tp_row, menu
+            order as the deterministic tie-break. Returns (strategies,
+            broken) where `broken` lists ops whose seed SHARDING PATTERN
+            (which axes the op actually uses) did not survive — the only
+            ops worth spending refinement budget on."""
+            dp, tp, ep, ap, sp = fact
+            strategies: Dict[int, OpStrategy] = {}
+            broken: List[Op] = []
+            for op in self.graph.ops.values():
+                menu = [s for s in valid_strategies(
+                    op, dp, tp, batch_size, self.config, ep=ep, ap=ap,
+                    sp=sp) if self._tp_ok(op, s)]
+                e = ops_entry[op.name]
+                want = (e.get("dp", 1), e.get("tp", 1), e.get("ep", 1),
+                        e.get("ap", 1), e.get("sp", 1))
+                want_row = bool(e.get("tp_row", False))
+                chosen = min(enumerate(menu), key=lambda it: (
+                    axdist((it[1].dp, it[1].tp, it[1].ep, it[1].ap,
+                            it[1].sp), want)
+                    + (0.0 if it[1].tp_row == want_row else 0.5),
+                    it[0]))[1]
+                strategies[op.guid] = chosen
+                if ([d > 1 for d in (chosen.dp, chosen.tp, chosen.ep,
+                                     chosen.ap, chosen.sp)]
+                        != [d > 1 for d in want]
+                        or chosen.tp_row != want_row):
+                    broken.append(op)
+            return strategies, broken
+
+        quick = []
+        with tracer.span("search.warm_sweep", factorizations=len(facts)):
+            for fact in facts:
+                self.candidates_simulated += 1
+                dp, tp, ep, ap, sp = fact
+                self.sim.cost.set_mesh_degrees(tp=tp, sp=sp, ep=ep, ap=ap)
+                st, broken = clamp(fact)
+                quick.append((self.sim.simulate(self.graph, st), fact,
+                              st, broken))
+        quick.sort(key=lambda x: (x[0], x[1]))
+        sweep_floor = quick[0][0]
+        near_fact = min(facts, key=lambda f: (axdist(f, seed_fact), f))
+        cand = quick[:2] + [q for q in quick if q[1] == near_fact]
+        seen_facts = set()
+        candidates = []
+        for q in cand:
+            if q[1] not in seen_facts:
+                seen_facts.add(q[1])
+                candidates.append(q)
+        weight = float(getattr(self.config, "replan_distance_weight", 1.0))
+
+        # the candidate's devices: the re-plan config's actual survivor
+        # ids when they match the searched count — identical layouts
+        # must price as noops, not as cross-mesh transfers, when the
+        # running ids are not 0..n-1 (e.g. the first pod already died)
+        cand_ids = getattr(self.config, "device_ids", None)
+        if not cand_ids or len(cand_ids) != n_devices:
+            cand_ids = list(range(n_devices))
+
+        def distance_of(strategies, axes):
+            if live_plan is None or weight <= 0:
+                return 0.0
+            from .plan_cache import plan_distance_us
+
+            try:
+                return plan_distance_us(self.graph, live_plan,
+                                        strategies, axes, self.machine,
+                                        n_devices, device_ids=cand_ids)
+            except Exception as exc:  # noqa: BLE001 — pricing the
+                # distance term must never kill a re-plan; without it
+                # the candidate ranks on runtime alone
+                self.log.append(
+                    "warm: plan-distance pricing failed"
+                    f" ({type(exc).__name__}: {exc}); term dropped")
+                return 0.0
+
+        best = None
+        best_rank = float("inf")
+        for cost, fact, start, broken in candidates:
+            dp, tp, ep, ap, sp = fact
+            axes = self._axes(dp, tp, start, ep, ap, sp)
+            dist_us = distance_of(start, axes)
+            rank = cost + weight * dist_us
+            self.log.append(
+                f"warm dp={dp} tp={tp} ep={ep} ap={ap} sp={sp}"
+                f" cost={cost:.1f}us"
+                + (f" reshard={dist_us:.1f}us"
+                   if live_plan is not None else ""))
+            if rank < best_rank:
+                best_rank = rank
+                best = (fact, start, broken, dist_us)
+        fact, start, broken, dist_us = best
+        dp, tp, ep, ap, sp = fact
+        self.sim.cost.set_mesh_degrees(tp=tp, sp=sp, ep=ep, ap=ap)
+        # refinement budget goes to the WINNER only: pattern-broken ops
+        # plus contested ones (locally-best != transplanted on the new
+        # machine) — the ops the machine move actually put in play
+        flip_ops: List[Op] = list(broken)
+        seen_guids = {op.guid for op in broken}
+        for op in self.graph.ops.values():
+            menu = [s for s in valid_strategies(
+                op, dp, tp, batch_size, self.config, ep=ep, ap=ap,
+                sp=sp) if self._tp_ok(op, s)]
+            local_best = min(
+                menu, key=lambda s: self.sim.op_step_time_us(op, s))
+            if (local_best != start[op.guid]
+                    and op.guid not in seen_guids):
+                seen_guids.add(op.guid)
+                flip_ops.append(op)
+
+        def cost_of(st):
+            return self.sim.simulate(self.graph, st)
+
+        with tracer.span("search.warm_refine", flips=len(flip_ops),
+                         factorization=f"dp={dp},tp={tp},ep={ep},"
+                                       f"ap={ap},sp={sp}"):
+            refined = (self._best_first_flips(
+                flip_ops, start, cost_of, dp, tp, batch_size, ep, ap,
+                sp) if flip_ops else start)
+        cost = self.sim.simulate(self.graph, refined)
+        if refined != start and live_plan is not None:
+            # the flip pass optimizes pure step time — it must not be
+            # allowed to UNDO the reshard-aware choice (a marginal
+            # simulate win that re-shards a weight). Re-rank the
+            # refined plan with its own distance and keep whichever of
+            # (start, refined) ranks better.
+            r_axes = self._axes(dp, tp, refined, ep, ap, sp)
+            r_dist = distance_of(refined, r_axes)
+            if cost + weight * r_dist > best_rank:
+                self.log.append(
+                    f"warm: refinement reverted — {cost:.1f}us +"
+                    f" {r_dist:.1f}us reshard ranks worse than the"
+                    " transplanted plan")
+                refined = start
+                cost = self.sim.simulate(self.graph, refined)
+            else:
+                dist_us = r_dist
+        mem = self.sim.memory_bytes(self.graph, refined)
+        axes = self._axes(dp, tp, refined, ep, ap, sp)
+        best = SearchResult(
+            refined, axes, cost, mem,
+            [f"warm dp={dp} tp={tp} ep={ep} ap={ap} sp={sp}"
+             f" cost={cost:.1f}us mem={mem/1e9:.2f}GB"
+             + (f" reshard={dist_us:.1f}us"
+                if live_plan is not None else "")])
+        self.log.append(best.log[0])
+        tol = float(getattr(self.config, "warm_fallback_tolerance", 1.05))
+        if best.cost_us > tol * sweep_floor:
+            if live_plan is None:
+                # the refined winner drifted too far from the sweep's
+                # cost floor: the topology changed more than local
+                # refinement can absorb
+                self.log.append(
+                    "warm start fell back to cold: refined"
+                    f" {best.cost_us:.1f}us > {tol:.2f} x sweep floor"
+                    f" {sweep_floor:.1f}us")
+                return None
+            # WITH a live plan the winner may legitimately trade step
+            # time for reshard bytes — falling back to a cold search
+            # (which prices no distance) would re-create the
+            # massive-reshard choice the term exists to prevent. Keep
+            # the plan for THIS re-plan, but do not cache it: a future
+            # live-less lookup must not adopt a reshard-biased plan as
+            # an exact hit.
+            best.cache_store = False
+            self.log.append(
+                f"warm: keeping reshard-biased plan ({best.cost_us:.1f}us"
+                f" > {tol:.2f} x floor {sweep_floor:.1f}us paid to avoid"
+                f" {dist_us:.1f}us of redistribution); not cached")
+        if memory_budget is not None and best.memory_bytes > memory_budget:
+            self.log.append(
+                "warm start fell back to cold: refined plan exceeds the"
+                " memory budget (the lambda search is cold-path)")
+            return None
+        # overlap split of the winner: the simulate that priced `cost`
+        # above already left last_sync_stats describing THESE strategies
+        st = self.sim.last_sync_stats or {}
+        best.overlapped_sync_us = st.get("overlapped_sync_us")
+        best.exposed_sync_us = st.get("exposed_sync_us")
+        best.sync_buckets = len(st.get("buckets") or [])
+        best.cache_mode = "warm"
+        self.log.append(
+            f"warm start: refined {len(candidates)} candidate(s) near"
+            f" seed axes {dict(sa)}; sweep floor {sweep_floor:.1f}us")
+        return best
+
     def _lambda_search(self, select, budget: float,
                        probe_is_final: bool = True) -> SearchResult:
         """Binary-search the lambda of the runtime + lambda*memory objective
@@ -1035,26 +1353,77 @@ def _want_measured(config) -> bool:
 
 def unity_optimize(graph: Graph, config, machine: MachineModel,
                    batch_size: int, n_devices: int,
-                   simulator: Optional[Simulator] = None) -> SearchResult:
+                   simulator: Optional[Simulator] = None,
+                   cache_graph_hash: Optional[str] = None) -> SearchResult:
     """Entry point (reference: FFModel::graph_optimize, substitution.cc:3589).
 
     Dispatches to the native C++ core (src/ffcore, loaded via ctypes) when
     available; the pure-Python path below is the fallback and the behavioral
-    spec. A custom simulator (e.g. measured costs) forces the Python path."""
+    spec. A custom simulator (e.g. measured costs) forces the Python path.
+
+    Plan cache (docs/search.md): unless disabled, the search is keyed by
+    a content hash over (pre-rewrite graph, overlaid machine, batch,
+    devices, search knobs). An exact hit adopts the cached plan —
+    enumeration skipped entirely, the analysis gate still run — and a
+    near-miss (same graph + knobs) seeds warm-started refinement.
+    `cache_graph_hash` overrides the graph leg: the background
+    pre-planner searches a POST-rewrite graph clone and passes the
+    original pre-rewrite hash so its entry lands where the event-time
+    fresh-graph lookup will look. Measured-cost searches bypass the
+    cache — their answers depend on the mutable measured-cost cache,
+    not just the key's content legs."""
+    from . import plan_cache as _pc
     from .substitution import (
         apply_substitutions,
         load_rule_spec,
         rule_set_from_spec,
     )
 
+    t_start = time.perf_counter()
     # measured op costs (reference: the simulator profiles real kernels,
     # simulator.cc:489,537): on by default when a real accelerator is the
     # backend; the process-wide cache persists across compiles
+    measured = simulator is not None
     if simulator is None and _want_measured(config):
         from .simulator import get_op_cost_cache
 
         simulator = Simulator(config=config, machine=machine,
                               measured=get_op_cost_cache(config))
+        measured = True
+
+    cache = None if measured else _pc.get_plan_cache(config)
+    key = None
+    warm_seed = None
+    if not measured:
+        key = _pc.plan_key(graph, config, machine, batch_size, n_devices,
+                           graph_hash=cache_graph_hash)
+    if cache is not None and key is not None:
+        from ..obs.tracing import get_tracer
+
+        entry = cache.get_entry(key)
+        if entry is not None:
+            tier, data = entry
+            with get_tracer().span("search", backend="cache",
+                                   n_devices=n_devices,
+                                   batch_size=batch_size) as sp:
+                result = _adopt_cached_plan(graph, config, machine, data,
+                                            batch_size, n_devices)
+                if result is not None:
+                    # counted only now: an entry that fails to bind or
+                    # validate is a MISS, whatever the lookup found
+                    cache.note_hit(tier)
+                    sp.set(cost_us=result.cost_us, axes=result.mesh_axes,
+                           simulated=0, pruned=0, cache="hit")
+                    return _finish_search(result, key, None, t_start,
+                                          graph)
+                sp.set(cache="stale")
+            # the entry no longer binds/validates on this graph/machine:
+            # drop it and search cold
+            cache.invalidate(key)
+        cache.note_miss()
+        if (getattr(config, "search_warm_start", True)
+                and config.search_budget > 0):
+            warm_seed = cache.get_warm(key)
 
     spec, is_taso = load_rule_spec(config.substitution_json_path)
     # a TASO rule file constrains the TP menu; the lambda memory search,
@@ -1135,41 +1504,116 @@ def unity_optimize(graph: Graph, config, machine: MachineModel,
                 result.overlapped_sync_us = st.get("overlapped_sync_us")
                 result.exposed_sync_us = st.get("exposed_sync_us")
                 result.sync_buckets = len(st.get("buckets") or [])
-            return result
+            return _finish_search(result, key, cache, t_start, graph)
     helper = GraphSearchHelper(graph, config, machine, simulator)
     budget = None
     if config.memory_search:
         budget = config.memory_budget_mb * 1e6
-    return helper.graph_optimize(batch_size, n_devices, budget,
-                                 rule_spec=(spec, is_taso, taso_rules))
+    result = helper.graph_optimize(
+        batch_size, n_devices, budget,
+        rule_spec=(spec, is_taso, taso_rules), warm_seed=warm_seed,
+        live_plan=getattr(config, "replan_live_plan", None))
+    return _finish_search(result, key, cache, t_start, graph)
 
 
-def rewrite_and_import_strategy(graph: Graph, config, path: str,
-                                spec: Optional[dict] = None):
-    """compile()'s --import preamble, shared with the analyze CLI so the
-    two paths cannot drift: the exporting search ran the greedy rewrite
-    pass before choosing strategies, so op names in the file refer to the
-    REWRITTEN graph (e.g. fuse_parallel_ops' merged names) — re-run the
-    same deterministic pass before matching names. Trade-off (search-rule)
-    rewrites the exporting search materialized are recorded in the file
-    and replayed by import_strategy via the rules registry. Returns
-    (strategies, mesh_axes); raises PlanAnalysisError on a malformed
-    file."""
+def _finish_search(result: SearchResult, key, cache, t_start: float,
+                   graph: Graph) -> SearchResult:
+    """Shared unity_optimize epilogue: stamp provenance + wall time,
+    observe the mode-labeled wall histogram, count warm starts, and
+    store cold/warm results in the plan cache (hits are already there)."""
+    from .plan_cache import count_warm_start, observe_search_wall
+
+    result.search_wall_ms = (time.perf_counter() - t_start) * 1e3
+    if key is not None:
+        result.graph_hash = key.graph_hash
+        result.machine_hash = key.machine_hash
+    observe_search_wall(result.search_wall_ms, result.cache_mode)
+    if result.cache_mode == "warm":
+        count_warm_start()
+    if (cache is not None and key is not None
+            and result.cache_mode != "hit" and result.cache_store):
+        cache.put(key, result_to_dict(result, graph))
+    return result
+
+
+def _adopt_cached_plan(graph: Graph, config, machine, data: Dict[str, Any],
+                       batch_size: int,
+                       n_devices: int) -> Optional[SearchResult]:
+    """Adopt a plan-cache entry onto (a rebuild of) its graph: replay
+    the same rewrite pipeline the exporting search ran (greedy
+    substitutions, then the recorded trade-off rewrites via
+    import_strategy), bind strategies by op NAME, and re-validate the
+    plan through the analysis gate (FFTA pipeline) before use. Returns
+    None — the caller treats the entry as a miss — when anything fails
+    to bind or validate; enumeration is skipped entirely on success
+    (candidates_simulated == 0)."""
+    from ..analysis import PlanAnalysisError, check_plan
     from .substitution import (apply_substitutions, load_rule_spec,
                                rule_set_from_spec, search_rules_from_spec)
 
-    rule_spec, is_taso = load_rule_spec(config.substitution_json_path)
-    apply_substitutions(graph, rule_set_from_spec(rule_spec, is_taso))
-    return import_strategy(graph, path, spec=spec,
-                           rules=search_rules_from_spec(rule_spec, is_taso))
+    spec, is_taso = load_rule_spec(config.substitution_json_path)
+    applied = apply_substitutions(graph, rule_set_from_spec(spec, is_taso))
+    try:
+        strategies, axes = import_strategy(
+            graph, "<plan-cache>",
+            rules=search_rules_from_spec(spec, is_taso), spec=data)
+    except PlanAnalysisError:
+        return None
+    if set(strategies) != set(graph.ops):
+        return None  # an op fell back to defaults: not this graph
+    result = SearchResult(
+        strategies=strategies, mesh_axes=dict(axes),
+        cost_us=float(data.get("cost_us", 0.0)),
+        memory_bytes=float(data.get("memory_bytes", 0.0)), log=[])
+    result.predicted_step_us = data.get("predicted_step_us",
+                                        result.cost_us)
+    result.applied_rewrites = [tuple(x)
+                               for x in data.get("applied_rewrites", [])]
+    result.greedy_search_rules = bool(data.get("greedy_search_rules"))
+    result.reduction_strategies = dict(data.get("reductions") or {})
+    ov = data.get("overlap") or {}
+    if ov:
+        result.overlapped_sync_us = ov.get("overlapped_sync_us")
+        result.exposed_sync_us = ov.get("exposed_sync_us")
+        result.sync_buckets = int(ov.get("sync_buckets") or 0)
+        result.pipeline_placement = ov.get("pipeline_placement")
+    result.cache_mode = "hit"
+    if applied:
+        result.log.append(f"substitutions: {applied}")
+    gate_off = getattr(config, "plan_analysis", "error") == "off"
+    result.log.append(
+        "plan cache: hit — enumeration skipped, "
+        + ("analysis gate off: adopted WITHOUT re-validation" if gate_off
+           else "plan re-validated through the analysis gate"))
+    if not gate_off:
+        try:
+            # record=False: this is the ADOPTION gate; compile()'s
+            # pre-flight gate still runs (and records) downstream, so
+            # counting here would double every diagnostic on a hit
+            check_plan(graph, record=False, strategies=strategies,
+                       mesh_axes=dict(axes), machine=machine,
+                       config=config, batch_size=batch_size,
+                       n_devices=n_devices,
+                       reduction_strategies=result.reduction_strategies
+                       or None)
+        except PlanAnalysisError as exc:
+            _log.warning(
+                "plan cache: cached plan failed re-validation (%s);"
+                " falling back to cold search", exc)
+            return None
+    return result
 
 
-def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
-    """Serialize the chosen strategy (reference: --export, model.cc:3609)."""
-    data = {
+def result_to_dict(result: SearchResult, graph: Graph) -> Dict[str, Any]:
+    """The serialized-plan dict shared by export_strategy and the plan
+    cache — strategies keyed by op NAME (guids are process-local), the
+    informational reduction/overlap records, and the search provenance
+    (the cache-key content hashes plus the enumeration counters)."""
+    return {
         "mesh_axes": result.mesh_axes,
         "cost_us": result.cost_us,
         "memory_bytes": result.memory_bytes,
+        "predicted_step_us": result.predicted_step_us,
         # rewrites the search materialized: the import path replays these
         # (by rule + description) so op names in "ops" resolve
         "applied_rewrites": list(result.applied_rewrites),
@@ -1191,6 +1635,17 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
             **({"pipeline_placement": result.pipeline_placement}
                if result.pipeline_placement else {}),
         }} if result.exposed_sync_us is not None else {}),
+        # search provenance (docs/search.md): which graph/machine this
+        # plan was produced for, and what the search actually did —
+        # `analyze` warns when the hashes don't match the target
+        "provenance": {
+            "graph_hash": result.graph_hash,
+            "machine_hash": result.machine_hash,
+            "candidates_simulated": result.candidates_simulated,
+            "candidates_pruned": result.candidates_pruned,
+            "cache_mode": result.cache_mode,
+            "search_wall_ms": result.search_wall_ms,
+        },
         "ops": {
             graph.ops[guid].name: {"dp": s.dp, "tp": s.tp, "ep": s.ep,
                                    "ap": s.ap, "sp": s.sp,
@@ -1199,12 +1654,64 @@ def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
             if guid in graph.ops
         },
     }
+
+
+def rewrite_and_import_strategy(graph: Graph, config, path: str,
+                                spec: Optional[dict] = None,
+                                check_provenance: bool = True):
+    """compile()'s --import preamble, shared with the analyze CLI so the
+    two paths cannot drift: the exporting search ran the greedy rewrite
+    pass before choosing strategies, so op names in the file refer to the
+    REWRITTEN graph (e.g. fuse_parallel_ops' merged names) — re-run the
+    same deterministic pass before matching names. Trade-off (search-rule)
+    rewrites the exporting search materialized are recorded in the file
+    and replayed by import_strategy via the rules registry. Returns
+    (strategies, mesh_axes); raises PlanAnalysisError on a malformed
+    file.
+
+    Provenance: the PRE-rewrite graph hash and this config's machine
+    hash are computed here and checked against the file's recorded
+    provenance — a strategy JSON silently applied to a different graph
+    or machine than the one that produced it now warns (FFTA052).
+    check_provenance=False skips that (the analyze CLI runs its own
+    check so the mismatch lands in ITS printed report, not twice in
+    the process counters)."""
+    from .plan_cache import graph_fingerprint, machine_fingerprint
+    from .substitution import (apply_substitutions, load_rule_spec,
+                               rule_set_from_spec, search_rules_from_spec)
+
+    expect_graph = expect_machine = None
+    if check_provenance:
+        expect_graph = graph_fingerprint(graph)
+        try:
+            from .machine_model import make_machine_model
+
+            expect_machine = machine_fingerprint(
+                make_machine_model(config, config.total_devices))
+        except Exception:  # noqa: BLE001 — a spec-less config must
+            # still import; the machine leg of the check just disarms
+            pass
+    rule_spec, is_taso = load_rule_spec(config.substitution_json_path)
+    apply_substitutions(graph, rule_set_from_spec(rule_spec, is_taso))
+    return import_strategy(graph, path, spec=spec,
+                           rules=search_rules_from_spec(rule_spec, is_taso),
+                           expect_graph_hash=expect_graph,
+                           expect_machine_hash=expect_machine)
+
+
+def export_strategy(result: SearchResult, graph: Graph, path: str) -> None:
+    """Serialize the chosen strategy (reference: --export, model.cc:3609).
+    The file carries search provenance (graph/machine content hashes +
+    enumeration counters) so importing it onto a DIFFERENT graph or
+    machine warns (FFTA052) instead of silently applying."""
     with open(path, "w") as f:
-        json.dump(data, f, indent=2)
+        json.dump(result_to_dict(result, graph), f, indent=2)
 
 
 def import_strategy(graph: Graph, path: str, rules=None,
-                    spec: Optional[dict] = None
+                    spec: Optional[dict] = None,
+                    expect_graph_hash: Optional[str] = None,
+                    expect_machine_hash: Optional[str] = None
                     ) -> Tuple[Dict[int, OpStrategy], Dict[str, int]]:
     """Load a strategy exported by export_strategy (reference: --import).
 
@@ -1213,7 +1720,13 @@ def import_strategy(graph: Graph, path: str, rules=None,
     rule-created op names in the file resolve against this graph.
     spec: the already-parsed file contents, when the caller read the JSON
     itself (the analyze CLI also pulls "reductions" from it) — avoids a
-    second read that could drift from this one."""
+    second read that could drift from this one.
+    expect_graph_hash/expect_machine_hash: the importing side's content
+    hashes (plan_cache.graph_fingerprint on the PRE-rewrite graph /
+    machine_fingerprint) — when the file records provenance and it
+    disagrees, an FFTA052 warning fires instead of the mismatch passing
+    silently. Files without provenance (pre-provenance exports, hand-
+    written strategies) are not warned about."""
     if spec is not None:
         data = spec
     else:
@@ -1251,6 +1764,27 @@ def import_strategy(graph: Graph, path: str, rules=None,
                                         make_diag, record_report)
 
     diags = []
+    # provenance check (docs/search.md): warn when this strategy was
+    # produced for a DIFFERENT graph or machine than the one importing it
+    prov = data.get("provenance") or {}
+    if (expect_graph_hash and prov.get("graph_hash")
+            and prov["graph_hash"] != expect_graph_hash):
+        diags.append(make_diag(
+            "FFTA052",
+            "strategy file was produced for a different graph (recorded"
+            f" hash {prov['graph_hash'][:12]}..., this graph"
+            f" {expect_graph_hash[:12]}...)",
+            hint="op entries that still match by name apply; re-export"
+                 " from the current model to clear this"))
+    if (expect_machine_hash and prov.get("machine_hash")
+            and prov["machine_hash"] != expect_machine_hash):
+        diags.append(make_diag(
+            "FFTA052",
+            "strategy file was produced for a different machine (recorded"
+            f" hash {prov['machine_hash'][:12]}..., this machine"
+            f" {expect_machine_hash[:12]}...)",
+            hint="the plan's degrees may be legal here but its costs were"
+                 " priced elsewhere; re-search on this machine to clear"))
     ops_entry = data.get("ops")
     if not isinstance(ops_entry, dict):
         diags.append(make_diag(

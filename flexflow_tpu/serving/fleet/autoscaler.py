@@ -44,7 +44,8 @@ class Autoscaler:
                  max_replicas: Optional[int] = None, min_replicas: int = 1,
                  idle_ticks_before_shrink: int = 2,
                  idle_ticks_before_drain: int = 3,
-                 ttft_window_ticks: int = 20):
+                 ttft_window_ticks: int = 20,
+                 preplanner=None, preplan_fn: Optional[Callable] = None):
         if not 1 <= int(min_slots) <= int(max_slots):
             raise ValueError(
                 f"need 1 <= min_slots ({min_slots}) <= max_slots"
@@ -72,6 +73,17 @@ class Autoscaler:
         # the SLO on lifetime p99 would turn one historic slow burst
         # into permanent overload (grow forever, shrink never)
         self.ttft_window_ticks = max(1, int(ttft_window_ticks))
+        # background pre-planning (search/plan_cache.py, docs/search.md):
+        # when overload first appears while room to grow remains, the
+        # NEXT resize target's plan is pre-computed off the tick thread
+        # (`preplan_fn` — typically a closure running the replica
+        # model's Unity search for the grown mesh into the plan cache),
+        # so the eventual replica add / resize consumes a cache hit
+        # instead of paying a cold search under load. Re-armed when the
+        # fleet returns to all-idle.
+        self.preplanner = preplanner
+        self.preplan_fn = preplan_fn
+        self._preplanned = False
         self._ttft_snaps: Dict[str, Deque] = {}
         self._replica_idle: Dict[str, int] = {}
         self.log: List[Dict] = []
@@ -143,6 +155,21 @@ class Autoscaler:
                      if r.state is ReplicaState.READY]
             all_idle = bool(ready) and all(self._idle(r) for _, r in ready)
             self._idle_ticks = self._idle_ticks + 1 if all_idle else 0
+            if all_idle:
+                self._preplanned = False  # next overload pre-plans again
+            elif (not self._preplanned and self.preplanner is not None
+                    and self.preplan_fn is not None
+                    and any(self._overloaded(n, r) for n, r in ready)):
+                # overload is building: pre-compute the next resize
+                # target's plan off the tick thread, so the grow /
+                # replica add consumes a cache hit instead of paying a
+                # cold search at event time
+                self._preplanned = True
+                self.preplanner.submit("fleet.resize_target",
+                                       self.preplan_fn)
+                self._c_actions.inc(action="preplan")
+                actions.append({"action": "preplan",
+                                "t": time.monotonic()})
             for name, rep in ready:
                 self._advance_ttft_window(name, rep)
                 if name in self._pending:

@@ -469,6 +469,13 @@ class ContinuousBatcher:
         # the SLO-admission estimate the fleet router sheds by.
         self._ewma_prefill_s_per_tok: Optional[float] = None
         self._ewma_decode_iter_s: Optional[float] = None
+        # speculative serving doubles prefill work: the DRAFT prefills
+        # the whole prompt through its own chunk stream beside the
+        # target's. Its per-token cost is measured separately (sampled
+        # at the draft's final synced chunk) and credited in
+        # `predicted_ttft_s`'s prefill leg — without it a speculative
+        # fleet under-predicts TTFT and over-admits.
+        self._ewma_draft_prefill_s_per_tok: Optional[float] = None
         self._g_prefill_rate = registry.gauge(
             "ff_serving_prefill_tokens_per_s",
             "Measured prefill rate, EWMA over synced prefill dispatches",
@@ -1011,6 +1018,17 @@ class ContinuousBatcher:
         self._g_prefill_rate.set(
             1.0 / self._ewma_prefill_s_per_tok, pool=self.pool.label)
 
+    def _observe_draft_prefill(self, n_tokens: int, dt: float) -> None:
+        """One synced DRAFT prefill dispatch covered `n_tokens` in `dt`
+        seconds (scheduler thread only) — the measured cost of the
+        doubled prefill work speculation adds per prompt token."""
+        if n_tokens <= 0 or dt <= 0:
+            return
+        sample = dt / n_tokens
+        old = self._ewma_draft_prefill_s_per_tok
+        self._ewma_draft_prefill_s_per_tok = sample if old is None else \
+            (1 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * sample
+
     def _observe_decode_iter(self, dt: float) -> None:
         """One decode iteration took `dt` seconds of wall (scheduler
         thread only). The EWMA stays the RAW per-iteration wall — a
@@ -1073,14 +1091,29 @@ class ContinuousBatcher:
         install); the second is the chunk-interleave model — chunked
         prefill runs one decode iteration between chunks whenever
         anything is decoding, so every pending chunk costs one decode
-        wall on top of its own compute. A cold batcher (no samples yet)
-        predicts 0 and admits — the estimate only starts shedding once
-        it is backed by measurements."""
+        wall on top of its own compute. With a draft model the prefill
+        leg additionally credits the draft's doubled prefill dispatches
+        at the draft's own measured per-token cost. A cold batcher (no
+        samples yet) predicts 0 and admits — the estimate only starts
+        shedding once it is backed by measurements."""
         own = max(1, int(prompt_len) - max(0, int(shared_tokens)))
         backlog = self.queued_prefill_tokens()
         total = own + backlog
         per_tok = self._ewma_prefill_s_per_tok
         t = total * per_tok if per_tok is not None else 0.0
+        if self.draft_model is not None:
+            # draft-aware admission (docs/serving.md): speculation
+            # prefills every prompt token TWICE — the draft's chunk
+            # stream runs beside the target's — so the prefill leg
+            # credits the second dispatch at the draft's measured
+            # per-token cost (falling back to the target's until the
+            # first draft sample lands; prefix-cache credit does not
+            # apply — the draft re-prefills even on a band hit)
+            draft_per_tok = self._ewma_draft_prefill_s_per_tok
+            if draft_per_tok is None:
+                draft_per_tok = per_tok
+            if draft_per_tok is not None:
+                t += (int(prompt_len) + backlog) * draft_per_tok
         chunk = self.prefill_chunk_tokens
         iter_s = self._ewma_decode_iter_s
         if chunk and iter_s is not None:
@@ -1147,6 +1180,7 @@ class ContinuousBatcher:
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "num_slots": self.num_slots,
             "prefill_s_per_token": self._ewma_prefill_s_per_tok,
+            "draft_prefill_s_per_token": self._ewma_draft_prefill_s_per_tok,
             "decode_iter_s": self._ewma_decode_iter_s,
             "queued_prefill_tokens": self.queued_prefill_tokens(),
             "resizes": list(self._resizes),
@@ -1598,10 +1632,19 @@ class ContinuousBatcher:
                     draft.params, draft.state, s.draft_small,
                     jnp.asarray(dtokens), jnp.asarray(doff, jnp.int32))
             else:
+                import jax
+
+                t0 = time.monotonic()
                 self._draft_caches = self._draft_last_fn(
                     draft.params, draft.state, self._draft_caches,
                     s.draft_small, jnp.asarray(dtokens),
                     jnp.asarray(doff, jnp.int32), s.slot)
+                # sync the final draft chunk (one per request, mirroring
+                # the target's per-request sync) so the measured wall is
+                # a real dispatch, feeding the admission model's
+                # draft-prefill credit
+                jax.block_until_ready(self._draft_caches)
+                self._observe_draft_prefill(dn, time.monotonic() - t0)
                 s.draft_small = None
         s.draft_filled = doff + dn
 

@@ -72,6 +72,19 @@ def _serving_xla_cache_cleanup():
 
 
 @pytest.fixture(autouse=True)
+def _reset_plan_cache():
+    """The process-wide plan cache (search/plan_cache.py) must not leak
+    between tests: a test searching the same (graph, machine, knobs) an
+    earlier test searched would HIT and skip enumeration, breaking
+    asserts on the search's internals (candidates_simulated, logs)."""
+    from flexflow_tpu.search.plan_cache import reset_plan_cache
+
+    reset_plan_cache()
+    yield
+    reset_plan_cache()
+
+
+@pytest.fixture(autouse=True)
 def _reset_obs_state():
     """Process-wide observability state must not leak between tests: one
     obs.reset_all() zeroes every registry counter family (plan

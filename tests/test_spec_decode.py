@@ -179,6 +179,10 @@ def test_spec_metrics_and_predicted_ttft_drain_horizon(target,
     assert "ff_spec_decode_accepted_total" in text
     assert "ff_spec_decode_acceptance" in text
     assert st["spec"]["accepted"] > 0
+    # the draft's prefill dispatches were MEASURED (draft-aware
+    # admission samples the final synced draft chunk per request)
+    assert st["draft_prefill_s_per_token"] is not None
+    assert st["draft_prefill_s_per_token"] > 0
 
     # unit: a not-started speculative batcher with a fabricated queued
     # request and measured EWMAs. Full acceptance -> k_eff = k = 3, so
@@ -201,13 +205,22 @@ def test_spec_metrics_and_predicted_ttft_drain_horizon(target,
         return b
 
     b = mk(tied_draft, 3.0)
-    total = 60 + 4  # queued backlog 4-token prompt + own 60... own only
     own = 60
     total = own + 4
     chunk = b.prefill_chunk_tokens
-    want = own * 0.01 + 4 * 0.01 + min(
-        math.ceil(total / chunk), 10) * 0.3
+    # draft-aware admission (PR 15 satellite): the prefill leg credits
+    # the draft's doubled prefill dispatches — every prompt token (own
+    # AND backlog) prefills through the draft's chunk stream too, at
+    # the draft's measured per-token cost (falls back to the target's
+    # until the first draft sample lands)
+    want = (own * 0.01 + 4 * 0.01 + total * 0.01
+            + min(math.ceil(total / chunk), 10) * 0.3)
     assert b.predicted_ttft_s(own) == pytest.approx(want)
+    # a measured draft EWMA replaces the fallback in the credit term
+    b._observe_draft_prefill(10, 0.05)  # 0.005 s/token
+    want_measured = (own * 0.01 + 4 * 0.01 + total * 0.005
+                     + min(math.ceil(total / chunk), 10) * 0.3)
+    assert b.predicted_ttft_s(own) == pytest.approx(want_measured)
 
     # plain batcher: every chunk pays a wall (historical semantics)
     p = ContinuousBatcher(target, max_len=MAX_LEN, num_slots=SLOTS,
