@@ -74,10 +74,31 @@ def _synthetic(model_name, config):
         xs = [rng.randn(b * 4, 4096).astype(np.float32) for _ in range(2)]
         y = rng.randint(0, 10, size=(b * 4, 1)).astype(np.int32)
         return m, xs, y
+    if model_name == "moe":
+        import os
+
+        # FF_MOE_* env knobs mirror the FF_BERT_* pattern: the defaults
+        # are the multipod dryrun's switch-transformer shape, shrinkable
+        # for CPU CI profiling runs
+        cfg = zoo.MoeTransformerConfig(
+            hidden_size=int(os.environ.get("FF_MOE_HIDDEN", 512)),
+            num_heads=int(os.environ.get("FF_MOE_HEADS", 8)),
+            num_layers=int(os.environ.get("FF_MOE_LAYERS", 2)),
+            num_experts=int(os.environ.get("FF_MOE_EXPERTS", 8)),
+            top_k=int(os.environ.get("FF_MOE_TOPK", 2)),
+            vocab_size=int(os.environ.get("FF_MOE_VOCAB", 1024)),
+        )
+        seq = int(os.environ.get("FF_MOE_SEQ", 64))
+        tokens = m.create_tensor([b, seq], ff.DataType.DT_INT32)
+        zoo.build_moe_transformer(m, tokens, cfg)
+        x = rng.randint(0, cfg.vocab_size,
+                        size=(b * 2, seq)).astype(np.int32)
+        y = rng.randint(0, 2, size=(b * 2, seq, 1)).astype(np.int32)
+        return m, [x], y
     raise SystemExit(
         f"unknown --model {model_name!r}; choices: alexnet resnet50 inception "
-        f"resnext50 cifar10_cnn mnist_cnn mnist_mlp bert mlp_unify, or pass a "
-        f"script path")
+        f"resnext50 cifar10_cnn mnist_cnn mnist_mlp bert mlp_unify moe, or "
+        f"pass a script path")
 
 
 def main(argv=None):

@@ -87,6 +87,24 @@ CODE_CATALOG: Dict[str, tuple] = {
     "FFTA072": (Severity.ERROR,
                 "explicit collective lowering diverges from the priced"
                 " reduction plan (dropped or renamed sync)"),
+    # -- mixture-of-experts legality (FFTA08x, docs/moe.md) --
+    "FFTA080": (Severity.WARNING,
+                "degenerate expert capacity: the unclamped rounding falls"
+                " below top-k (moe_capacity raises it silently)"),
+    "FFTA081": (Severity.ERROR,
+                "expert-parallel degree does not divide the expert count"),
+    "FFTA082": (Severity.ERROR,
+                "load-balance loss requested without the full gate"
+                " distribution wired (lambda_bal needs full_gate)"),
+    "FFTA083": (Severity.WARNING,
+                "router computed in a reduced-precision dtype; gate"
+                " probabilities should stay float32"),
+    "FFTA084": (Severity.WARNING,
+                "capacity factor below 1.0 drops tokens even under a"
+                " perfectly balanced router"),
+    "FFTA085": (Severity.ERROR,
+                "expert-parallel group spans the slow inter-pod tier:"
+                " the routing all_to_all must stay pod-resident"),
 }
 
 

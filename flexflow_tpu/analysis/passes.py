@@ -207,14 +207,19 @@ _UNSET = object()
 def factorization_diagnostics(graph: Graph, config, batch_size: int,
                               factorization, sp_pred=_UNSET,
                               expert_counts=None,
-                              has_spatial=None) -> List[Diagnostic]:
+                              has_spatial=None,
+                              pod_degree=None) -> List[Diagnostic]:
     """Cheap legality of one (dp, tp, ep, ap, sp) mesh factorization —
     exactly the feasibility conditions GraphSearchHelper._parallelize
     enforces, expressed as diagnostics so the search can prune (and count)
     infeasible candidates before the cost simulator sees them. sp_pred /
     expert_counts / has_spatial: precomputed make_sp_feasible result and
     graph-scan facts, so a caller sweeping many tuples does not rebuild
-    them per tuple."""
+    them per tuple. pod_degree (multi-tier machines only): degree of the
+    innermost tier — the expert-parallel group, whose device span is
+    ep x its inner stride (sp x ap, the axes nested inside it), must fit
+    within it so the per-step routing all_to_all never touches DCN
+    (FFTA085, docs/moe.md "Search")."""
     from ..search.simulator import AP_CAPABLE
     from ..search.unity import make_sp_feasible
 
@@ -235,6 +240,12 @@ def factorization_diagnostics(graph: Graph, config, batch_size: int,
                 "FFTA001",
                 f"ep={ep} does not divide every expert count"
                 f" ({sorted(expert_counts)})"))
+        if pod_degree and ep > 1 and ep * ap * sp > pod_degree:
+            diags.append(make_diag(
+                "FFTA085",
+                f"ep={ep} spans {ep * ap * sp} devices (inner stride"
+                f" ap*sp={ap * sp}) but the pod holds {pod_degree}: the"
+                " routing all_to_all would cross DCN"))
     if ap > 1:
         if has_spatial is None:
             has_spatial = any(op.op_type in AP_CAPABLE
@@ -759,4 +770,92 @@ def survivor_diagnostics(old_plan, leaves: Dict[str, int],
             f"{path}: {n_lost} shard(s) held only by lost devices"
             f" {sorted(int(p) for p in lost_positions)}",
             hint="recover from the newest verified checkpoint instead"))
+    return diags
+
+
+# ---------------------------------------------------------------------
+# pass 8: mixture-of-experts legality (FFTA08x, docs/moe.md)
+# ---------------------------------------------------------------------
+def pass_moe(ctx: AnalysisContext) -> List[Diagnostic]:
+    """MoE-specific plan legality: degenerate capacity roundings (the
+    moe_capacity clamp silently raising the effective capacity factor),
+    expert-count/ep divisibility, aux-loss wiring, router dtype. Runs on
+    EXPERTS (fused) and GROUP_BY (unfused dispatch) ops; graphs without
+    them produce no findings, so the pass is safe in every pipeline."""
+    from ..ops.moe import moe_capacity, moe_capacity_degenerate, moe_tokens
+    from .diagnostics import Severity
+
+    diags: List[Diagnostic] = []
+    mesh_ep = (ctx.mesh_axes or {}).get("expert", 1)
+    for op in ctx.graph.ops.values():
+        if op.op_type not in (OpType.EXPERTS, OpType.GROUP_BY):
+            continue
+        n = op.params["n"]
+        alpha = op.params.get("alpha", 1.0)
+        x = op.inputs[0]
+        if op.op_type == OpType.EXPERTS:
+            assign = op.inputs[2]
+        else:
+            assign = op.inputs[1]
+        tokens = moe_tokens(x.dims)
+        k = assign.dims[-1]
+        if moe_capacity_degenerate(tokens, k, n, alpha):
+            cap = moe_capacity(tokens, k, n, alpha)
+            diags.append(make_diag(
+                "FFTA080",
+                f"capacity factor {alpha} x {tokens} tokens / {n} experts"
+                f" rounds below top-k={k}; moe_capacity clamps to {cap},"
+                f" an effective factor of {cap * n / (k * tokens):.2f}",
+                op,
+                hint="raise alpha (or shrink n) so the requested capacity"
+                     " is the one that runs"))
+        elif alpha < 1.0:
+            diags.append(make_diag(
+                "FFTA084",
+                f"capacity factor {alpha} < 1.0: even a perfectly"
+                f" balanced router overflows the per-expert buffers and"
+                " drops tokens every step", op,
+                hint="alpha >= 1.0 keeps a balanced router drop-free"))
+        # ep divisibility: a pinned strategy with a non-dividing ep is an
+        # illegal plan; a mesh expert axis the op cannot use (default
+        # assignment degrades it to ep=1) is legal but buys nothing — the
+        # axis's devices idle through the expert FFN, so warn
+        s = ctx.strategy_of(op)
+        sep = getattr(s, "ep", 1) if s is not None else 1
+        if op.op_type == OpType.EXPERTS:
+            if sep > 1 and n % sep:
+                diags.append(make_diag(
+                    "FFTA081",
+                    f"ep={sep} does not divide the expert count {n}; the"
+                    " stacked expert weights cannot shard over the"
+                    " 'expert' axis", op,
+                    hint=f"choose ep from the divisors of {n}"))
+            elif sep == 1 and mesh_ep > 1 and n % mesh_ep:
+                diags.append(make_diag(
+                    "FFTA081",
+                    f"mesh 'expert' axis of {mesh_ep} does not divide the"
+                    f" expert count {n}: the op degrades to replicated"
+                    " and the axis's devices idle through the expert FFN",
+                    op, severity=Severity.WARNING,
+                    hint=f"size the expert axis to a divisor of {n}"))
+        if op.op_type == OpType.EXPERTS:
+            lambda_bal = op.params.get("lambda_bal", 0.0)
+            if lambda_bal and len(op.inputs) <= 3:
+                diags.append(make_diag(
+                    "FFTA082",
+                    f"lambda_bal={lambda_bal} but no full_gate input:"
+                    " the load-balance loss needs the full gate"
+                    " distribution and lowering will fail", op,
+                    hint="pass full_gate= (FFModel.moe wires it for"
+                         " fused=True)"))
+            if (ctx.config is not None
+                    and getattr(ctx.config, "allow_mixed_precision",
+                                False)):
+                diags.append(make_diag(
+                    "FFTA083",
+                    "mixed precision stores the router's softmax in"
+                    " bf16 between ops: near-tied expert selections can"
+                    " flip vs the f32 reference", op,
+                    hint="keep router-sensitive runs at f32, or accept"
+                         " assignment jitter under bf16"))
     return diags

@@ -21,7 +21,8 @@ from ..core.graph import Graph
 from .diagnostics import DiagnosticReport, PlanAnalysisError, record_report
 from .passes import (AnalysisContext, default_strategies_for,
                      pass_collectives, pass_divisibility, pass_donation,
-                     pass_hygiene, pass_memory_fit, pass_tier_collectives)
+                     pass_hygiene, pass_memory_fit, pass_moe,
+                     pass_tier_collectives)
 
 _log = logging.getLogger("flexflow_tpu.analysis")
 
@@ -32,11 +33,12 @@ PASS_REGISTRY = {
     "tiers": pass_tier_collectives,
     "donation": pass_donation,
     "hygiene": pass_hygiene,
+    "moe": pass_moe,
 }
 
 # the machine-model-free subset: a preset for analyze_plan(passes=...)
 # callers that want a quick structural check without a MachineModel
-CHEAP_PASSES = ("divisibility", "collectives", "hygiene")
+CHEAP_PASSES = ("divisibility", "collectives", "hygiene", "moe")
 ALL_PASSES = tuple(PASS_REGISTRY)
 
 

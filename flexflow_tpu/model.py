@@ -538,10 +538,15 @@ class FFModel:
     ) -> Tensor:
         """MoE block (reference: FFModel::moe, model.h:509-514 / moe.cc):
         gating softmax → topk → group_by → per-expert dense → aggregate.
-        Inputs of rank > 2 are flattened to [tokens, features] for dispatch
-        and restored afterwards (the capacity-factor dispatch is per-token)."""
+        For the unfused path, inputs of rank > 2 are flattened to
+        [tokens, features] for dispatch and restored afterwards (the
+        capacity-factor dispatch is per-token). The fused path keeps
+        rank-3 inputs NATIVE: ExpertsOp flattens tokens inside its own
+        lowering, so the graph stays shape-polymorphic over the leading
+        dims and the serving decode path (seq=1) re-runs it unchanged —
+        a fixed reshape op here would pin the build-time token count."""
         orig_dims = None
-        if len(input.dims) > 2:
+        if len(input.dims) > 2 and not fused:
             orig_dims = input.dims
             tokens = 1
             for d in input.dims[:-1]:
@@ -1441,6 +1446,7 @@ class FFModel:
                 summ["epoch"] = epoch
                 summ["throughput"] = (n // bs) * bs / dt
                 history.append(summ)
+                self._publish_moe_metrics()
                 if verbose:
                     print(
                         f"epoch {epoch}: loss={mvals.get('loss', 0):.4f} "
@@ -1496,6 +1502,7 @@ class FFModel:
             summ["epoch"] = epoch
             summ["throughput"] = (n // (bs * accum_steps)) * bs * accum_steps / dt
             history.append(summ)
+            self._publish_moe_metrics()
             if verbose:
                 print(
                     f"epoch {epoch}: loss={mvals.get('loss', 0):.4f} "
@@ -1508,6 +1515,17 @@ class FFModel:
             if self.config.profiling:
                 print(stats.format_summary())
         return history
+
+    def _publish_moe_metrics(self) -> None:
+        """End-of-epoch MoE router health: mirror every EXPERTS op's
+        dropped/load state into the ff_moe_* metric families
+        (obs/moe.py). No-op (no registry touch) for expert-free graphs."""
+        if not any(op.op_type == OpType.EXPERTS
+                   for op in self.graph.ops.values()):
+            return
+        from .obs.moe import publish_moe_metrics
+
+        publish_moe_metrics(self)
 
     def eval(self, x, y, batch_size: Optional[int] = None) -> Dict[str, float]:
         assert self._compiled

@@ -17,7 +17,8 @@ from .xdl import XDLConfig, build_xdl
 from .candle_uno import CandleUnoConfig, build_candle_uno
 from .mlp import build_mlp_unify
 from .transformer import TransformerConfig, build_bert_encoder, build_transformer
-from .moe import MoeConfig, build_moe_encoder
+from .moe import (MoeConfig, MoeTransformerConfig, build_moe_encoder,
+                  build_moe_lm, build_moe_transformer, moe_expert_ops)
 from .rnn import build_lstm_nmt
 
 __all__ = [
@@ -40,6 +41,10 @@ __all__ = [
     "build_transformer",
     "build_bert_encoder",
     "MoeConfig",
+    "MoeTransformerConfig",
     "build_moe_encoder",
+    "build_moe_transformer",
+    "build_moe_lm",
+    "moe_expert_ops",
     "build_lstm_nmt",
 ]

@@ -1,10 +1,28 @@
-"""Mixture-of-experts encoder (reference: examples/cpp/mixture_of_experts/
-moe.cc:100-135) — attention + MoE blocks with layer norm, the
-expert-parallelism benchmark and the user of the recompile/cache machinery
-(moe.cc:40-98: moe_score/moe_trigger/moe_alter)."""
+"""Mixture-of-experts model builders.
+
+Two generations live here:
+
+ - `build_moe_encoder` (reference: examples/cpp/mixture_of_experts/
+   moe.cc:100-135) — the original attention + unfused-MoE encoder, kept for
+   the recompile/cache machinery its tests exercise (moe.cc:40-98:
+   moe_score/moe_trigger/moe_alter).
+
+ - `build_moe_transformer` / `build_moe_lm` — the switch/top-k MoE
+   transformer the expert-parallel (ep) search axis trains and serves: a
+   learned softmax router per MoE block, capacity-factor token dropping
+   (ops/moe.py moe_capacity — clamped to >= k, FFTA080 flags degenerate
+   roundings), and the Switch-Transformer load-balance auxiliary loss
+   (lambda_bal) folded into fit()'s loss through ctx.aux_losses. Every MoE
+   block uses the FUSED ExpertsOp path: the stacked (n, F, H) expert
+   weights shard over the 'expert' mesh axis, which is what the Unity
+   search prices (simulator.py ep_collective_time_us) and what GSPMD
+   lowers to all_to_all token routing. docs/moe.md walks the math.
+"""
 from __future__ import annotations
 
 from dataclasses import dataclass
+
+from ..ffconst import ActiMode, AggrMode
 
 
 @dataclass
@@ -35,3 +53,95 @@ def build_moe_encoder(model, input, cfg: MoeConfig = None):
                             name=f"l{i}_moe")
         x = ff.layer_norm(ff.add(x, expert_out), [-1], name=f"l{i}_ln2")
     return x
+
+
+@dataclass
+class MoeTransformerConfig:
+    """Switch/top-k MoE transformer (the shape arXiv:2101.03961 trains:
+    dense attention, MoE FFN every `moe_every` layers). Defaults are
+    test-sized; the bench/dryrun legs scale hidden/experts up."""
+    hidden_size: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    num_experts: int = 8
+    top_k: int = 2           # num_select: 1 = switch routing
+    capacity_factor: float = 2.0   # alpha in moe_capacity
+    lambda_bal: float = 0.01       # load-balance aux loss weight
+    moe_every: int = 1       # every k-th layer gets an MoE FFN
+    vocab_size: int = 64
+
+
+def _moe_layer(ff, t, cfg: MoeTransformerConfig, name: str,
+               causal: bool = False):
+    """One transformer layer: pre-built MHA + (dense | MoE) FFN with
+    residuals and layer norm. The MoE FFN is the fused experts path on the
+    NATIVE rank-3 hidden states — ExpertsOp flattens (batch, seq) to
+    tokens inside its own lowering, so the layer decodes at seq=1
+    unchanged (serving) and the router/capacity math is per-token."""
+    attn = ff.multihead_attention(t, t, t, cfg.hidden_size, cfg.num_heads,
+                                  causal=causal, name=f"{name}_attn")
+    t = ff.layer_norm(ff.add(t, attn), [-1], name=f"{name}_ln1")
+    h = ff.moe(t, cfg.num_experts, cfg.top_k, cfg.hidden_size,
+               alpha=cfg.capacity_factor, lambda_bal=cfg.lambda_bal,
+               fused=True, name=f"{name}_moe")
+    return ff.layer_norm(ff.add(t, h), [-1], name=f"{name}_ln2")
+
+
+def _dense_layer(ff, t, cfg: MoeTransformerConfig, name: str,
+                 causal: bool = False):
+    attn = ff.multihead_attention(t, t, t, cfg.hidden_size, cfg.num_heads,
+                                  causal=causal, name=f"{name}_attn")
+    t = ff.layer_norm(ff.add(t, attn), [-1], name=f"{name}_ln1")
+    h = ff.dense(t, cfg.hidden_size * 2, ActiMode.AC_MODE_GELU,
+                 name=f"{name}_ff1")
+    h = ff.dense(h, cfg.hidden_size, name=f"{name}_ff2")
+    return ff.layer_norm(ff.add(t, h), [-1], name=f"{name}_ln2")
+
+
+def _stack(ff, t, cfg: MoeTransformerConfig, causal: bool):
+    for i in range(cfg.num_layers):
+        if cfg.moe_every > 0 and i % cfg.moe_every == cfg.moe_every - 1:
+            t = _moe_layer(ff, t, cfg, f"l{i}", causal=causal)
+        else:
+            t = _dense_layer(ff, t, cfg, f"l{i}", causal=causal)
+    return t
+
+
+def build_moe_transformer(model, token_input,
+                          cfg: MoeTransformerConfig = None,
+                          num_classes: int = 2):
+    """Token ids -> embedding -> MoE encoder stack -> classifier softmax.
+    The training-side builder: compile with LOSS_SPARSE_CATEGORICAL_
+    CROSSENTROPY and the per-block load-balance losses ride into fit()'s
+    loss as the executor's aux-loss sum (runtime/executor.py)."""
+    cfg = cfg or MoeTransformerConfig()
+    ff = model
+    t = ff.embedding(token_input, cfg.vocab_size, cfg.hidden_size,
+                     AggrMode.AGGR_MODE_NONE, name="tok_emb")
+    t = _stack(ff, t, cfg, causal=False)
+    t = ff.dense(t, num_classes, name="cls")
+    return ff.softmax(t)
+
+
+def build_moe_lm(model, token_input, cfg: MoeTransformerConfig = None):
+    """Causal MoE LM: the serving-side builder (GenerativeSession /
+    ContinuousBatcher). Same MoE blocks as build_moe_transformer but
+    causal attention and an LM head over the vocabulary; the final tensor
+    is the next-token distribution the decode loop samples from."""
+    cfg = cfg or MoeTransformerConfig()
+    ff = model
+    t = ff.embedding(token_input, cfg.vocab_size, cfg.hidden_size,
+                     AggrMode.AGGR_MODE_NONE, name="tok_emb")
+    t = _stack(ff, t, cfg, causal=True)
+    return ff.softmax(ff.dense(t, cfg.vocab_size, name="lm_head"))
+
+
+def moe_expert_ops(model):
+    """The graph's EXPERTS ops in topological order — the hook obs/moe.py
+    and the expert-affine batcher use to find router state and gate
+    weights without assuming layer names."""
+    from ..ffconst import OpType
+
+    ops = (model.graph.ops.values() if getattr(model, "graph", None)
+           is not None else model.ops)  # pre-compile: build-time op list
+    return [op for op in ops if op.op_type == OpType.EXPERTS]

@@ -20,6 +20,7 @@ calibration data into a persisted `FittedProfile` overlay, and
 budgeted re-plan through the ElasticCoordinator.
 """
 from .calibration import CalibrationReport, OpCalibration, calibrate
+from .moe import moe_router_families, publish_moe_metrics
 from .refit import (DriftDetector, FittedCoefficients, FittedProfile,
                     FittedProfileError, FittedProfileMismatch, refit)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
@@ -35,7 +36,10 @@ def reset_all() -> None:
     """Zero every metric family in the default registry AND drop buffered
     trace events — the one call the test autouse fixture needs so no
     counter/span state leaks between tests."""
+    from .moe import reset_moe_publisher
+
     REGISTRY.reset_all()
+    reset_moe_publisher()
     tr = get_tracer()
     tr.disable()
     tr.clear()
@@ -45,6 +49,7 @@ __all__ = [
     "CalibrationReport", "OpCalibration", "calibrate",
     "DriftDetector", "FittedCoefficients", "FittedProfile",
     "FittedProfileError", "FittedProfileMismatch", "refit",
+    "moe_router_families", "publish_moe_metrics",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "get_registry", "iter_samples", "parse_exposition", "render_labeled",
     "render_merged", "validate_exposition",

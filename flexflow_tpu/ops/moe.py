@@ -22,7 +22,32 @@ from ..ffconst import DataType, OpType
 
 
 def moe_capacity(batch: int, k: int, n: int, alpha: float) -> int:
-    return max(1, int(math.ceil(alpha * k * batch / n)))
+    """Per-expert token capacity: ceil(alpha * k * batch / n), clamped to
+    >= k. The clamp floor is k (not 1): a tiny batch x small alpha can
+    round the raw value below k, and a capacity under k cannot even hold
+    one token's k assignments when the router concentrates — every token
+    routed to a popular expert would be dropped SILENTLY. The degenerate
+    configuration is surfaced by the FFTA080 analysis warning
+    (analysis/passes.py pass_moe) instead of by zeroed outputs."""
+    return max(int(k), int(math.ceil(alpha * k * batch / n)))
+
+
+def moe_capacity_degenerate(batch: int, k: int, n: int,
+                            alpha: float) -> bool:
+    """True when the UNCLAMPED capacity rounds below k — the configuration
+    the FFTA080 warning names (the clamp in moe_capacity is silently
+    raising the effective capacity factor above the requested alpha)."""
+    return int(math.ceil(alpha * k * batch / n)) < int(k)
+
+
+def moe_tokens(dims) -> int:
+    """Token count of an ExpertsOp input: rank-2 inputs are (tokens, F);
+    rank-3 (batch, seq, F) inputs dispatch per token over the flattened
+    leading dims (the serving decode path runs the same graph at seq=1)."""
+    t = 1
+    for d in dims[:-1]:
+        t *= int(d)
+    return t
 
 
 def _dispatch_plan(assign, n: int, capacity: int):
@@ -156,25 +181,39 @@ class ExpertsOp(Op):
         x, gate_preds, assign = self.inputs[:3]
         n = self.params["n"]
         alpha = self.params.get("alpha", 1.0)
-        cap = moe_capacity(x.dims[0], assign.dims[1], n, alpha)
+        cap = moe_capacity(moe_tokens(x.dims), assign.dims[-1], n, alpha)
         return x, n, cap, self.params["out_dim"]
 
     def output_shapes(self):
         x, n, cap, out_dim = self._shape()
-        return [(x.dims[0], out_dim)], [x.dtype]
+        return [tuple(x.dims[:-1]) + (out_dim,)], [x.dtype]
 
     def weight_specs(self):
         from ..core.op import WeightSpec
         from ..runtime.initializers import DefaultInitializer, ZeroInitializer
 
         x, n, cap, out_dim = self._shape()
-        f = x.dims[1]
+        f = x.dims[-1]
         init = self.params.get("kernel_initializer") or DefaultInitializer(
             fan_in=f, fan_out=out_dim
         )
         return [
             WeightSpec("kernel", (n, f, out_dim), x.dtype, init),
             WeightSpec("bias", (n, out_dim), x.dtype, ZeroInitializer()),
+        ]
+
+    def state_specs(self):
+        from ..core.op import WeightSpec
+        from ..runtime.initializers import ZeroInitializer
+
+        n = self.params["n"]
+        # router health state, read by obs.moe.publish_moe_metrics:
+        # `dropped` accumulates capacity-overflow token-assignments (the
+        # ff_moe_router_dropped_tokens_total source), `load` holds the last
+        # step's per-expert assignment fractions (the load-balance gauge)
+        return [
+            WeightSpec("dropped", (), DataType.DT_FLOAT, ZeroInitializer()),
+            WeightSpec("load", (n,), DataType.DT_FLOAT, ZeroInitializer()),
         ]
 
     def _constrain_expert(self, ctx, val):
@@ -198,6 +237,15 @@ class ExpertsOp(Op):
         n = self.params["n"]
         alpha = self.params.get("alpha", 1.0)
         lambda_bal = self.params.get("lambda_bal", 0.0)
+        lead = x.shape[:-1]  # (tokens,) or (batch, seq) — restored at exit
+        if x.ndim > 2:
+            # token-flattened dispatch: the capacity formulation is
+            # per-token, and flattening HERE (not in the builder) keeps the
+            # graph shape-polymorphic over the leading dims — the serving
+            # decode path re-runs this op at seq=1 against the same lowering
+            x = x.reshape((-1, x.shape[-1]))
+            gate_preds = gate_preds.reshape((-1, gate_preds.shape[-1]))
+            assign = assign.reshape((-1, assign.shape[-1]))
         b, f = x.shape
         k = assign.shape[1]
         cap = moe_capacity(b, k, n, alpha)
@@ -209,11 +257,29 @@ class ExpertsOp(Op):
                     f"experts op {self.name}: lambda_bal={lambda_bal} needs "
                     "the full gate distribution (pass full_gate=)"
                 )
+            full_gate = inputs[3]
+            if full_gate.ndim > 2:
+                full_gate = full_gate.reshape((-1, full_gate.shape[-1]))
             ctx.aux_losses.append(
-                _load_balance_loss(inputs[3], assign, n, lambda_bal)
+                _load_balance_loss(full_gate, assign, n, lambda_bal)
             )
 
         sel, slot_oh = _dispatch_masks(assign.astype(jnp.int32), n, cap, cdt)
+        # router health state (obs/moe.py publishes these as the
+        # ff_moe_router_dropped_tokens_total / ff_moe_expert_load families);
+        # stop_gradient: bookkeeping must not leak into the backward pass
+        assign_i = assign.astype(jnp.int32)
+        _, _, valid = _dispatch_plan(assign_i, n, cap)
+        prev = ctx.state.get((self.name, "dropped"))
+        if prev is not None:
+            dropped = jnp.sum(1.0 - valid.astype(jnp.float32))
+            ctx.state_updates[(self.name, "dropped")] = (
+                prev + jax.lax.stop_gradient(dropped))
+            load = jnp.mean(
+                jax.nn.one_hot(assign_i.reshape(-1), n, dtype=jnp.float32),
+                axis=0)
+            ctx.state_updates[(self.name, "load")] = (
+                jax.lax.stop_gradient(load))
         # (b, k, ...) mask views contract directly against x — no k-fold
         # jnp.repeat copy of the token features
         disp = jnp.einsum("bkn,bkc,bf->ncf", sel.reshape(b, k, n),
@@ -232,12 +298,14 @@ class ExpertsOp(Op):
         sel_g = (sel * gate_flat[:, None]).reshape(b, k, n)
         slot_bk = slot_oh.reshape(b, k, cap)
         out = jnp.einsum("bkn,bkc,nch->bh", sel_g, slot_bk, h)
+        if len(lead) > 1:
+            out = out.reshape(lead + (out.shape[-1],))
         return [out.astype(self.outputs[0].dtype.jnp_dtype)]
 
     def flops(self) -> float:
         x, n, cap, out_dim = self._shape()
-        t = x.dims[0] * self.inputs[2].dims[1]
-        f = x.dims[1]
+        t = moe_tokens(x.dims) * self.inputs[2].dims[-1]
+        f = x.dims[-1]
         dispatch = 2.0 * t * n * cap * f
         ffn = 2.0 * n * cap * f * out_dim
         combine = 2.0 * t * n * cap * out_dim

@@ -474,6 +474,12 @@ def _candidate_weight_plan(graph, strategies, mesh_axes,
     mesh = MeshSpec(device_ids=tuple(int(i) for i in device_ids),
                     axes=tuple((str(k), int(v))
                                for k, v in (mesh_axes or {}).items()))
+    # the runtime clamps a searched ep to the mesh's expert axis
+    # (model.py _assign_strategy: min(s.ep, axes['expert'])) — the priced
+    # candidate must claim the same degree, or a cached ep plan
+    # transplanted onto a pod-loss survivor mesh prices a reshard the
+    # runtime will never perform
+    ep_cap = int((mesh_axes or {}).get("expert", 1))
     arrays: Dict[str, Any] = {}
     for op in graph.topo_order():
         s = strategies.get(op.guid)
@@ -485,9 +491,10 @@ def _candidate_weight_plan(graph, strategies, mesh_axes,
                 continue
             degrees = [1] * len(w.dims)
             axes: List[Optional[str]] = [None] * len(w.dims)
-            if (op.op_type == OpType.EXPERTS and s.ep > 1
-                    and w.dims[0] % s.ep == 0):
-                degrees[0], axes[0] = s.ep, "expert"
+            op_ep = min(int(getattr(s, "ep", 1)), ep_cap)
+            if (op.op_type == OpType.EXPERTS and op_ep > 1
+                    and w.dims[0] % op_ep == 0):
+                degrees[0], axes[0] = op_ep, "expert"
             elif s.tp > 1:
                 shard_dim = ({"kernel": 0} if s.tp_row
                              else TP_WEIGHT_SHARD_DIMS.get(op.op_type))

@@ -557,17 +557,17 @@ class CostModel:
         if s.ep <= 1 or op.op_type != OpType.EXPERTS:
             return 0.0
         x = op.inputs[0]
-        from ..ops.moe import moe_capacity
+        from ..ops.moe import moe_capacity, moe_tokens
 
         n = op.params["n"]
-        cap = moe_capacity(x.dims[0], op.inputs[2].dims[1], n,
+        cap = moe_capacity(moe_tokens(x.dims), op.inputs[2].dims[-1], n,
                            op.params.get("alpha", 1.0))
         # per-chip share of the capacity buffers (each chip holds n/ep
         # experts' buffers for its dp slice of the batch): dispatch moves
         # (n, cap, F) features in, combine moves (n, cap, out_dim) out
         shard = max(1, s.dp * s.ep)
         db = self.op_dtype_bytes(op)
-        disp_bytes = n * cap * x.dims[1] * db / shard
+        disp_bytes = n * cap * x.dims[-1] * db / shard
         comb_bytes = n * cap * op.params["out_dim"] * db / shard
         ep_inner = self._axis_inner(s, "ep")
         # each direction fwd + mirrored bwd

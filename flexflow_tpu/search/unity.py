@@ -553,6 +553,13 @@ class GraphSearchHelper:
                          if op.op_type == OpType.EXPERTS}
         has_spatial = any(op.op_type in AP_CAPABLE
                           for op in graph.ops.values())
+        # multi-tier machines: experts must stay pod-resident — the ep
+        # group's span (ep x the axes nested inside it) may not cross the
+        # innermost tier, or every step's routing all_to_all rides DCN
+        # (FFTA085). Flat machines have no slow tier to protect.
+        tiers = getattr(self.machine, "tiers", None)
+        pod_degree = int(tiers[0].degree) if tiers and len(tiers) > 1 \
+            else None
         tuples = [
             (dp, tp, ep, ap, sp)
             for dp, rest in _divisor_pairs(n_devices)
@@ -571,7 +578,8 @@ class GraphSearchHelper:
                             graph, self.config, batch_size, fact,
                             sp_pred=sp_feasible,
                             expert_counts=expert_counts,
-                            has_spatial=has_spatial):
+                            has_spatial=has_spatial,
+                            pod_degree=pod_degree):
                         self.candidates_pruned += 1
                         continue
                 elif fact[4] > 1 and (sp_feasible is None

@@ -1,0 +1,142 @@
+"""Expert-affine admission for MoE serving (docs/moe.md "Serving").
+
+Expert-parallel MoE serving pays an all_to_all per decode iteration whose
+cost scales with how many DISTINCT experts the in-flight batch touches:
+co-scheduling requests that route to overlapping expert sets keeps the
+dispatch fan-out narrow. The exact routing is only known inside the
+jitted step, so admission works from a cheap host-side approximation:
+
+ - `ExpertAffinityProbe` pulls the embedding table and the first MoE
+   layer's gate weights out of ``model.params`` at batcher construction
+   and, per request, scores ``mean(embed(prompt)) @ gate_kernel + bias``
+   — the router's view of the prompt's average token — taking the top-k
+   expert ids as the request's SIGNATURE. A heuristic, not the true
+   per-token routing (the gate consumes post-attention activations); it
+   only has to correlate, and it costs one small matmul on the host.
+ - `pick_affine` chooses which queued request to admit: among the first
+   ``window`` queued entries, the one whose signature overlaps the active
+   slots' signatures most (FIFO order breaks ties). A request passed over
+   ``window`` times is FORCED next — affinity never starves the head of
+   the queue.
+
+The scheduler publishes pick outcomes (`ff_serving_affinity_picks_total`
+{outcome=affine|fifo|forced}) and an overlap EWMA
+(`ff_serving_affinity_overlap`); serve-bench's ``--workload moe`` leg
+hard-asserts token parity + zero drops with affinity ON, so the knob can
+only ever re-order admissions, never change tokens.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, List, Optional, Sequence
+
+import numpy as np
+
+from ...ffconst import OpType
+
+
+class ExpertAffinityProbe:
+    """Host-side router approximation for one compiled MoE model."""
+
+    def __init__(self, model):
+        experts = [op for op in model.graph.ops.values()
+                   if op.op_type == OpType.EXPERTS]
+        if not experts:
+            raise ValueError(
+                "expert_affinity=True needs a model with a fused EXPERTS"
+                " op (model.moe(..., fused=True))")
+        first = min(experts, key=lambda op: op.guid)
+        self.num_experts = int(first.params["n"])
+        # top-k from the assignment input's trailing dim (the top_k op's
+        # index output feeding the fused dispatch)
+        self.top_k = int(first.inputs[2].dims[-1])
+
+        emb = next((op for op in model.graph.ops.values()
+                    if op.op_type == OpType.EMBEDDING), None)
+        if emb is None:
+            raise ValueError(
+                "expert_affinity=True needs a token-embedding model: the"
+                " probe scores mean(embed(prompt)) through the gate")
+        self._table = np.asarray(model.params[emb.name]["weight"],
+                                 np.float32)
+
+        gate = self._find_gate(model, first)
+        self._gate_kernel = np.asarray(model.params[gate.name]["kernel"],
+                                       np.float32)
+        bias = model.params[gate.name].get("bias")
+        self._gate_bias = (np.asarray(bias, np.float32)
+                           if bias is not None
+                           else np.zeros(self.num_experts, np.float32))
+        if self._gate_kernel.shape[0] != self._table.shape[1]:
+            raise ValueError(
+                f"gate in-features ({self._gate_kernel.shape[0]}) do not"
+                f" match the embedding width ({self._table.shape[1]}):"
+                " the affinity probe needs the gate to consume the"
+                " embedded hidden size")
+
+    @staticmethod
+    def _find_gate(model, experts_op):
+        """The gate dense: walk producers upward from the fused op's
+        top-k scores input until the op that OWNS the (H, n) kernel."""
+        graph = model.graph
+        t = experts_op.inputs[1]  # top-k gate scores
+        for _ in range(4):  # top_k -> softmax -> dense, plus one spare
+            op = getattr(t, "owner_op", None)
+            if op is None or op.guid not in graph.ops:
+                break
+            if op.weights and op.weights[0].dims[-1] == \
+                    experts_op.params["n"]:
+                return op
+            if not op.inputs:
+                break
+            t = op.inputs[0]
+        raise ValueError(
+            f"could not locate the gate dense feeding {experts_op.name!r}"
+            " (expected top_k <- softmax <- dense with an (H, n) kernel)")
+
+    def signature(self, prompt_ids) -> FrozenSet[int]:
+        """Top-k expert ids for the prompt's mean embedding."""
+        ids = np.clip(np.asarray(prompt_ids, np.int64).ravel(),
+                      0, self._table.shape[0] - 1)
+        if ids.size == 0:
+            return frozenset()
+        mean = self._table[ids].mean(axis=0)
+        logits = mean @ self._gate_kernel + self._gate_bias
+        k = min(self.top_k, logits.size)
+        top = np.argpartition(logits, -k)[-k:]
+        return frozenset(int(e) for e in top)
+
+
+def overlap_fraction(sig: FrozenSet[int],
+                     active: Sequence[FrozenSet[int]]) -> float:
+    """|sig ∩ union(active)| / |sig| — 1.0 when every expert the request
+    routes to is already resident in the running batch."""
+    if not sig:
+        return 0.0
+    union = frozenset().union(*active) if active else frozenset()
+    return len(sig & union) / len(sig)
+
+
+def pick_affine(queue: List, active: Sequence[FrozenSet[int]],
+                window: int) -> tuple:
+    """Index into `queue` to admit next, plus the pick outcome
+    ('affine' | 'fifo' | 'forced') and the winner's overlap fraction.
+
+    Considers only the first `window` entries (bounded reordering); any
+    entry already passed over `window` times wins outright — the oldest
+    such first — so affinity delays admission by at most `window` picks.
+    Callers bump `affinity_skips` on the entries the pick jumped over.
+    """
+    window = max(1, int(window))
+    horizon = queue[:window]
+    for i, req in enumerate(horizon):
+        if getattr(req, "affinity_skips", 0) >= window:
+            return i, "forced", overlap_fraction(
+                getattr(req, "expert_sig", frozenset()), active)
+    best_i, best_frac = 0, -1.0
+    for i, req in enumerate(horizon):
+        frac = overlap_fraction(
+            getattr(req, "expert_sig", frozenset()), active)
+        if frac > best_frac:
+            best_i, best_frac = i, frac
+    return best_i, ("fifo" if best_i == 0 else "affine"), max(best_frac,
+                                                              0.0)

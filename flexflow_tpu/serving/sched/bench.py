@@ -103,6 +103,34 @@ def build_tiny_lm(batch: int, window: int, vocab: int = 64,
     return model
 
 
+def build_tiny_moe_lm(batch: int, window: int, vocab: int = 64,
+                      hidden: int = 32, heads: int = 4, layers: int = 2,
+                      experts: int = 4, moe_top_k: int = 2):
+    """The MoE bench model: the zoo's switch/top-k causal LM
+    (models/moe.py build_moe_lm) at bench scale. capacity_factor is
+    pinned to the expert count so capacity == top_k * tokens — the
+    router can NEVER drop a token-assignment, which is what lets the
+    moe leg hard-assert zero drops and exact parity with the lockstep
+    reference regardless of how the random gate routes."""
+    import flexflow_tpu as ff
+    from ...models import MoeTransformerConfig, build_moe_lm
+
+    config = ff.FFConfig()
+    config.batch_size = batch
+    config.allow_mixed_precision = False
+    config.num_devices = 1
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([batch, window], ff.DataType.DT_INT32)
+    cfg = MoeTransformerConfig(
+        hidden_size=hidden, num_heads=heads, num_layers=layers,
+        num_experts=experts, top_k=moe_top_k,
+        capacity_factor=float(experts), lambda_bal=0.0, vocab_size=vocab)
+    build_moe_lm(model, tokens, cfg)
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return model
+
+
 def make_workload(n: int, prompt_min: int, prompt_max: int, out_min: int,
                   out_max: int, vocab: int, seed: int) -> List[Dict]:
     rng = np.random.RandomState(seed)
@@ -707,13 +735,150 @@ def _run_speculative_cli(args) -> int:
     return _finish(args, report, failures)
 
 
+def run_moe(model, workload, max_len: int, slots: int, page_size: int,
+            deadline_s: float, affinity_window: int) -> Dict:
+    """Drive the MoE workload through the continuous batcher with
+    expert-affine admission ON, checking every request's greedy tokens
+    against a lockstep GenerativeSession reference — affinity may only
+    reorder admissions, never change tokens."""
+    from ..generate import GenerativeSession
+    from .continuous import ContinuousBatcher
+
+    session = GenerativeSession(model, max_len=max_len)
+    refs = [session.generate(w["prompt"][None, :], w["max_new"])[0]
+            for w in workload]
+
+    batcher = ContinuousBatcher(
+        model, max_len=max_len, num_slots=slots, page_size=page_size,
+        prefix_cache_pages=0, max_queue=max(len(workload), 1),
+        expert_affinity=True, affinity_window=affinity_window)
+    with batcher:
+        warm = np.zeros(
+            max(1, min(page_size * 2 + 1, max_len - 2)), np.int32)
+        batcher.submit(warm, 2).result(timeout=600.0)
+        batcher.submit(warm, 2).result(timeout=600.0)
+        t0 = time.monotonic()
+        handles, backpressured = _submit_with_backpressure(
+            batcher, workload, deadline_s, t0)
+        results = [h.result(timeout=600.0) for h in handles]
+        wall = time.monotonic() - t0
+        stats = batcher.stats()
+    tokens = sum(len(r) for r in results)
+    parity_bad = sum(
+        1 for h, ref in zip(handles, refs)
+        if not np.array_equal(np.asarray(h.tokens, np.int32),
+                              np.asarray(ref)))
+    waits = [h.queue_wait_s or 0.0 for h in handles]
+    affinity = stats.get("affinity") or {}
+    return {
+        "wall_s": round(wall, 3),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "dropped": sum(
+            1 for h, w in zip(handles, workload)
+            if h.error is not None or len(h.tokens) != w["max_new"]),
+        "parity_mismatches": parity_bad,
+        "requests": len(workload),
+        "max_queue_wait_s": round(max(waits), 3) if waits else 0.0,
+        "starved": sum(1 for w in waits if w > deadline_s),
+        "backpressure_retries": backpressured,
+        "affinity": affinity,
+        "stats": stats,
+    }
+
+
+def _moe_router_check(model, workload, window: int) -> Dict:
+    """One state-threaded inference forward over the workload's prompts:
+    the fused ExpertsOp counts capacity-overflow drops and per-expert
+    load in its op state, which this publishes into the obs registry
+    (ff_moe_* families). Returns {op: {dropped, load}}."""
+    from ...ffconst import CompMode
+    from ...obs.moe import publish_moe_metrics
+
+    b = model.config.batch_size
+    batch = np.zeros((b, window), np.int32)
+    for i, w in enumerate(workload[:b]):
+        p = w["prompt"][:window]
+        batch[i, :p.size] = p
+    feeds = {model.input_ops[0].name: batch}
+    _, new_state, _ = model.executor.forward_values(
+        model.params, model.state, feeds, None,
+        CompMode.COMP_MODE_INFERENCE)
+    model.state = new_state
+    return publish_moe_metrics(model)
+
+
+def _run_moe_cli(args) -> int:
+    """MoE serving leg (docs/moe.md acceptance: token parity with the
+    lockstep reference and ZERO router drops under expert-affine
+    continuous batching)."""
+    window = args.prompt_max
+    max_len = args.prompt_max + args.out_max
+    print(f"[serve-bench] moe: {args.requests} requests through a"
+          f" {args.experts}-expert top-{args.moe_top_k} MoE LM"
+          f" (hidden={args.hidden} layers={args.layers}), expert-affine"
+          f" admission window {args.affinity_window}")
+    model = build_tiny_moe_lm(args.slots, window, vocab=args.vocab,
+                              hidden=args.hidden, heads=args.heads,
+                              layers=args.layers, experts=args.experts,
+                              moe_top_k=args.moe_top_k)
+    workload = make_workload(args.requests, args.prompt_min,
+                             args.prompt_max, args.out_min, args.out_max,
+                             args.vocab, args.seed)
+    res = run_moe(model, workload, max_len, args.slots, args.page_size,
+                  args.deadline, args.affinity_window)
+    router = _moe_router_check(model, workload, window)
+    router_dropped = sum(v["dropped"] for v in router.values())
+    aff = res["affinity"]
+    picks = aff.get("picks", {})
+    print(f"[serve-bench] {res['tokens']} tokens in {res['wall_s']}s ="
+          f" {res['tokens_per_s']} tok/s | dropped {res['dropped']} |"
+          f" parity mismatches {res['parity_mismatches']}")
+    print(f"[serve-bench] affinity picks: {picks} | overlap ewma"
+          f" {round(aff.get('overlap_ewma') or 0.0, 3)} | router drops"
+          f" {router_dropped} across {len(router)} experts ops")
+
+    failures = []
+    if res["dropped"]:
+        failures.append(f"{res['dropped']} requests dropped/short")
+    if res["starved"]:
+        failures.append(f"{res['starved']} requests starved past"
+                        f" {args.deadline}s")
+    if res["parity_mismatches"]:
+        failures.append(
+            f"{res['parity_mismatches']} requests' greedy tokens differ"
+            " from the lockstep reference under expert-affine admission")
+    if router_dropped > 0:
+        failures.append(
+            f"router dropped {router_dropped} token-assignments despite"
+            f" capacity_factor == num_experts")
+    if not router:
+        failures.append("no EXPERTS op state found — the router check"
+                        " never ran")
+    if not picks or sum(picks.values()) == 0:
+        failures.append(
+            "expert-affine admission never made a pick (queue never"
+            " held 2+ requests — raise --requests)")
+    _check_exposition(failures, extra_required=(
+        "ff_moe_router_dropped_tokens_total", "ff_moe_expert_load",
+        "ff_moe_expert_load_imbalance", "ff_serving_affinity_picks_total",
+        "ff_serving_affinity_overlap"))
+    report = {"config": vars(args), "moe": {
+        **{k: v for k, v in res.items() if k != "stats"},
+        "router_dropped_tokens": router_dropped,
+        "router": router,
+    }}
+    return _finish(args, report, failures)
+
+
 def run_bench(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="flexflow_tpu serve-bench",
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
                     choices=("mixed", "shared-prefix", "long-prefill",
-                             "mesh-resize", "fleet", "speculative"))
+                             "mesh-resize", "fleet", "speculative",
+                             "moe"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -804,6 +969,16 @@ def run_bench(argv=None) -> int:
                     help="keep the draft's own random weights instead of"
                          " tying them to the target (speculative;"
                          " acceptance is then whatever the draft earns)")
+    # moe workload (expert-affine serving, docs/moe.md)
+    ap.add_argument("--experts", type=int, default=4,
+                    help="expert count of the MoE bench model (moe)")
+    ap.add_argument("--moe-top-k", type=int, default=2,
+                    help="router top-k of the MoE bench model (moe)")
+    ap.add_argument("--affinity-window", type=int, default=4,
+                    help="expert-affine admission fairness window:"
+                         " queued requests considered per pick, and the"
+                         " max times any request may be passed over"
+                         " (moe)")
     args = ap.parse_args(argv)
 
     if args.workload == "shared-prefix":
@@ -814,6 +989,8 @@ def run_bench(argv=None) -> int:
         return _run_mesh_resize_cli(args)
     if args.workload == "speculative":
         return _run_speculative_cli(args)
+    if args.workload == "moe":
+        return _run_moe_cli(args)
     if args.workload == "fleet":
         from ..fleet.bench import run_fleet_cli
 

@@ -145,6 +145,11 @@ class GenRequest:
         # cache_hit = >=1 page of the prompt was installed from the cache
         self.cache_hit = False
         self.prefix_tokens = 0
+        # expert-affine admission (sched/affinity.py): the probe's expert
+        # signature, and how many picks jumped over this request (the
+        # anti-starvation bound)
+        self.expert_sig = frozenset()
+        self.affinity_skips = 0
 
     # -- consumer API ------------------------------------------------------
     def stream(self, timeout: Optional[float] = None):
@@ -273,7 +278,9 @@ class ContinuousBatcher:
                  registry=None,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_cache_pages: Optional[int] = None,
-                 draft_model=None, spec_tokens: int = 3):
+                 draft_model=None, spec_tokens: int = 3,
+                 expert_affinity: bool = False,
+                 affinity_window: int = 4):
         if getattr(model.executor, "mesh", None) is not None:
             # a mesh is fine as long as nothing is actually partitioned
             # (the common replicated case — e.g. a dp axis the batch does
@@ -378,6 +385,17 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"draft vocab ({dvocab}) != target vocab ({tvocab}):"
                     " proposals must be scoreable by the target")
+        # expert-affine admission (docs/moe.md "Serving"): a host-side
+        # router probe signs every request at submit; _admit_new then
+        # prefers queued requests whose expert set overlaps the running
+        # batch's, within a bounded fairness window. Purely an admission
+        # ORDER policy — tokens are unchanged.
+        self._affinity_probe = None
+        self.affinity_window = max(1, int(affinity_window))
+        if expert_affinity:
+            from .affinity import ExpertAffinityProbe
+
+            self._affinity_probe = ExpertAffinityProbe(model)
         # prefix cache sizing: default two slots' worth of band pages when
         # chunked prefill is on (the hit path needs the chunk-offset entry
         # to prefill just the suffix); 0 disables reuse
@@ -442,6 +460,16 @@ class ContinuousBatcher:
             "Continuous-batching requests by outcome", labels=("outcome",))
         self._c_tokens = registry.counter(
             "ff_serving_tokens_total", "Tokens generated")
+        self._ewma_affinity_overlap: Optional[float] = None
+        if self._affinity_probe is not None:
+            self._c_affinity = registry.counter(
+                "ff_serving_affinity_picks_total",
+                "Expert-affine admission picks by outcome",
+                labels=("outcome",))
+            self._g_affinity_overlap = registry.gauge(
+                "ff_serving_affinity_overlap",
+                "EWMA expert-signature overlap of admitted requests with"
+                " the running batch", labels=("pool",))
 
         self._build_fns()
         self._caches = self._zero_caches()
@@ -941,6 +969,9 @@ class ContinuousBatcher:
         if self.pool.prefix is not None:
             matched, _ = self.pool.prefix.match(prompt)
             shared_pages = min(matched, prompt.size - 1) // self.pool.page_size
+        # expert signature outside the lock: one small host matmul
+        sig = (self._affinity_probe.signature(prompt)
+               if self._affinity_probe is not None else frozenset())
         with self._cv:
             if not self._running:
                 raise BatcherStopped("batcher is not running")
@@ -948,6 +979,7 @@ class ContinuousBatcher:
                 self.admission.admit(rid, prompt.size, max_new_tokens,
                                      shared_pages=shared_pages)
             req = GenRequest(rid, prompt, max_new_tokens, eos_id, seed)
+            req.expert_sig = sig
             self._queue.append(req)
             self._cv.notify_all()
         return req
@@ -1195,6 +1227,13 @@ class ContinuousBatcher:
                 "acceptance": (self._spec_accepted / self._spec_proposed
                                if self._spec_proposed else 0.0),
                 "acceptance_ewma": self._ewma_spec_accept,
+            }
+        if self._affinity_probe is not None:
+            out["affinity"] = {
+                "window": self.affinity_window,
+                "overlap_ewma": self._ewma_affinity_overlap,
+                "picks": {outcome: int(v) for (outcome,), v
+                          in self._c_affinity.items()},
             }
         return out
 
@@ -1484,6 +1523,31 @@ class ContinuousBatcher:
         with self._cv:
             self._cv.notify_all()
 
+    def _pop_next_locked(self) -> GenRequest:
+        """Take the next request off the queue (caller holds self._cv).
+        FIFO, unless expert-affine admission is on: then the best
+        signature-overlap pick within the fairness window (affinity.py),
+        with picks counted and the winner's overlap folded into the
+        EWMA gauge."""
+        if self._affinity_probe is None or len(self._queue) < 2:
+            return self._queue.pop(0)
+        from .affinity import pick_affine
+
+        active = [s.req.expert_sig for s in self._slots
+                  if s is not None and s.req.expert_sig]
+        idx, outcome, frac = pick_affine(self._queue, active,
+                                         self.affinity_window)
+        for passed in self._queue[:idx]:
+            passed.affinity_skips += 1
+        req = self._queue.pop(idx)
+        self._c_affinity.inc(outcome=outcome)
+        old = self._ewma_affinity_overlap
+        self._ewma_affinity_overlap = frac if old is None else \
+            (1 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * frac
+        self._g_affinity_overlap.set(self._ewma_affinity_overlap,
+                                     pool=self.pool.label)
+        return req
+
     def _admit_new(self, params, state, tracer) -> None:
         """Move queued requests into free slots. One-shot mode runs the
         whole prefill here (the pre-chunking behavior); chunked mode pins +
@@ -1501,7 +1565,7 @@ class ContinuousBatcher:
                     return
                 if not self._queue or self.pool.free_slot_count() == 0:
                     return
-                req = self._queue.pop(0)
+                req = self._pop_next_locked()
             req.state = RequestState.PREFILL
             req.queue_wait_s = self.admission.on_scheduled(req.id)
             plen = req.prompt.size

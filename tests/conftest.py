@@ -15,6 +15,18 @@ force_platform("cpu", n_host_devices=8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    """Register the `slow` marker (no pytest.ini/pyproject marker table in
+    this repo): heavy multi-step training tests — MoE transformers
+    training to parity, large searched-plan fits — opt out of the tier-1
+    sweep, which runs `-m 'not slow'`. A full `pytest tests/` still runs
+    them."""
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy training/search tests excluded from the tier-1 "
+        "`-m 'not slow'` sweep")
+
+
 def module_xla_cache():
     """Generator behind the serving modules' module-scoped XLA
     compilation-cache fixture (each module wires it up as

@@ -530,3 +530,100 @@ def test_searched_plans_pass_analyzer(name):
         batch_size=batch, n_devices=n_dev, mesh_axes=result.mesh_axes,
         final_guid=g.topo_order()[-1].guid)
     assert report.ok, report.format()
+
+
+# ---------------------------------------------------------------------
+# pass family 8: mixture-of-experts legality (FFTA08x)
+# ---------------------------------------------------------------------
+def _moe_graph(batch=32, n=4, k=2, alpha=None, lambda_bal=0.0,
+               mixed=False):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    if mixed:
+        config.allow_mixed_precision = True
+    m = ff.FFModel(config)
+    inp = m.create_tensor([batch, 8])
+    out = m.moe(inp, n, k, 12,
+                alpha=float(n) if alpha is None else alpha,
+                lambda_bal=lambda_bal, fused=True, name="moe")
+    m.dense(out, 3)
+    return m, Graph(m.ops), config
+
+
+def test_degenerate_capacity_warns_ffta080():
+    # ceil(0.1 * 2 * 32 / 64) = 1 < k=2: the clamp silently raises it
+    _, g, config = _moe_graph(batch=32, n=64, alpha=0.1)
+    report = analyze_plan(g, batch_size=32, n_devices=1, config=config,
+                          passes=("moe",))
+    assert report.ok  # warning, not error
+    diag = report.by_code("FFTA080")[0]
+    assert "clamps" in diag.message
+
+
+def test_non_dividing_ep_strategy_ffta081():
+    m, g, config = _moe_graph(n=4)
+    experts = op_named(g, "moe_experts")
+    report = analyze_plan(
+        g, strategies={experts.guid: OpStrategy(dp=2, ep=3)},
+        mesh_axes={"data": 2, "expert": 3}, batch_size=32, n_devices=6,
+        config=config, passes=("moe",))
+    assert [d.code for d in report.errors()] == ["FFTA081"]
+
+
+def test_unusable_expert_axis_warns_ffta081():
+    """A mesh expert axis the op cannot divide degrades to replicated:
+    legal (warning), but the axis's devices idle through the expert FFN."""
+    _, g, config = _moe_graph(n=4)
+    report = analyze_plan(
+        g, mesh_axes={"data": 2, "expert": 3}, batch_size=32,
+        n_devices=6, config=config, passes=("moe",))
+    assert report.ok
+    assert report.by_code("FFTA081")
+    assert report.by_code("FFTA081")[0].severity == Severity.WARNING
+
+
+def test_balance_loss_without_full_gate_ffta082():
+    """A hand-built EXPERTS op carrying lambda_bal without the full gate
+    distribution cannot lower its aux loss."""
+    m, g, config = _moe_graph(lambda_bal=0.05)
+    experts = op_named(g, "moe_experts")
+    experts.params["lambda_bal"] = 0.05
+    experts.inputs = experts.inputs[:3]  # drop the wired full_gate
+    report = analyze_plan(g, batch_size=32, n_devices=1, config=config,
+                          passes=("moe",))
+    assert "FFTA082" in report.counts()
+    assert not report.ok
+
+
+def test_mixed_precision_router_warns_ffta083():
+    _, g, config = _moe_graph(mixed=True)
+    report = analyze_plan(g, batch_size=32, n_devices=1, config=config,
+                          passes=("moe",))
+    assert report.ok
+    assert report.by_code("FFTA083")
+
+
+def test_sub_unit_capacity_factor_warns_ffta084():
+    _, g, config = _moe_graph(batch=64, n=4, alpha=0.5)
+    report = analyze_plan(g, batch_size=64, n_devices=1, config=config,
+                          passes=("moe",))
+    assert report.ok
+    assert report.by_code("FFTA084")
+
+
+def test_pod_spanning_ep_factorization_ffta085():
+    """factorization_diagnostics with a pod degree rejects ep tuples whose
+    span (ep x nested sp/ap) crosses the pod; pod-resident tuples and
+    flat machines (pod_degree=None) pass."""
+    _, g, config = _moe_graph(n=16)
+    assert factorization_diagnostics(
+        g, config, 32, (2, 1, 8, 1, 1), pod_degree=8) == []
+    diags = factorization_diagnostics(
+        g, config, 32, (1, 1, 16, 1, 1), pod_degree=8)
+    assert [d.code for d in diags] == ["FFTA085"]
+    # nested axes count against the span: ep=8 with sp=2 inside crosses
+    diags = factorization_diagnostics(
+        g, config, 32, (1, 1, 8, 1, 2), pod_degree=8)
+    assert any(d.code == "FFTA085" for d in diags)
+    assert factorization_diagnostics(
+        g, config, 32, (1, 1, 16, 1, 1), pod_degree=None) == []

@@ -488,3 +488,76 @@ def test_generate_padded_rows_never_delay_eos(lm):
     chunked = GenerativeSession(lm, max_len=12).generate(
         p, 6, eos_id=eos, tokens_per_dispatch=3, **kw)
     np.testing.assert_array_equal(chunked, got)
+
+
+# ---------------------------------------------------------------------
+# expert-affine admission (ISSUE 16)
+# ---------------------------------------------------------------------
+class _FakeReq:
+    def __init__(self, sig, skips=0):
+        self.expert_sig = frozenset(sig)
+        self.affinity_skips = skips
+
+
+def test_pick_affine_prefers_overlap_within_window():
+    from flexflow_tpu.serving.sched.affinity import (overlap_fraction,
+                                                     pick_affine)
+
+    active = [frozenset({0, 1})]
+    queue = [_FakeReq({2, 3}), _FakeReq({0, 1}), _FakeReq({1, 4}),
+             _FakeReq({0, 1})]
+    idx, outcome, frac = pick_affine(queue, active, window=4)
+    assert (idx, outcome, frac) == (1, "affine", 1.0)  # ties -> lowest idx
+    # outside the window the perfect match is invisible
+    idx, outcome, _ = pick_affine(queue[:1] + queue[2:], active, window=1)
+    assert (idx, outcome) == (0, "fifo")
+    assert overlap_fraction(frozenset(), active) == 0.0
+
+
+def test_pick_affine_forces_starved_head():
+    from flexflow_tpu.serving.sched.affinity import pick_affine
+
+    queue = [_FakeReq({2, 3}, skips=4), _FakeReq({0, 1})]
+    idx, outcome, _ = pick_affine(queue, [frozenset({0, 1})], window=4)
+    assert (idx, outcome) == (0, "forced")  # no starvation past `window`
+
+
+def test_expert_affinity_batcher_parity_and_stats():
+    """Affinity ON re-orders admissions only: every request's tokens
+    match the lockstep GenerativeSession reference, and the scheduler
+    reports its pick outcomes + overlap EWMA."""
+    from flexflow_tpu.serving.sched.affinity import ExpertAffinityProbe
+    from flexflow_tpu.serving.sched.bench import build_tiny_moe_lm
+
+    lm = build_tiny_moe_lm(2, 16, vocab=32, hidden=16, heads=2, layers=1,
+                           experts=4, moe_top_k=2)
+    probe = ExpertAffinityProbe(lm)
+    assert probe.num_experts == 4 and probe.top_k == 2
+    prompts = _prompts([4, 6, 5, 3, 7, 4], seed=9, vocab=32)
+    sigs = [probe.signature(p) for p in prompts]
+    assert all(len(s) == 2 for s in sigs)
+    assert sigs[0] == probe.signature(prompts[0])  # deterministic
+
+    session = GenerativeSession(lm, max_len=16)
+    refs = [session.generate(p[None, :], 4)[0] for p in prompts]
+    with ContinuousBatcher(lm, max_len=16, num_slots=2, page_size=4,
+                           expert_affinity=True,
+                           affinity_window=3) as cb:
+        reqs = [cb.submit(p, 4) for p in prompts]
+        outs = [r.result(timeout=300) for r in reqs]
+        stats = cb.stats()
+    for got, ref in zip(outs, refs):
+        np.testing.assert_array_equal(got, ref)
+    aff = stats["affinity"]
+    assert aff["window"] == 3
+    assert sum(aff["picks"].values()) > 0
+    if aff["overlap_ewma"] is not None:
+        assert 0.0 <= aff["overlap_ewma"] <= 1.0
+
+
+def test_expert_affinity_rejects_dense_models(lm):
+    """expert_affinity=True on a model with no EXPERTS op fails fast at
+    construction, not mid-serve."""
+    with pytest.raises(ValueError, match="EXPERTS"):
+        ContinuousBatcher(lm, max_len=12, num_slots=2, page_size=4,
+                          expert_affinity=True)

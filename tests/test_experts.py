@@ -134,3 +134,98 @@ def test_expert_parallel_trains():
     y = np.zeros((B, 1), dtype=np.int32)
     hist = model.fit([x], y, batch_size=B, epochs=1)
     assert np.isfinite(hist[0]["loss"])
+
+
+def test_moe_capacity_clamps_to_top_k():
+    """ceil(alpha*k*B/n) can round below k for tiny batches; the clamp
+    floors at k (a capacity under k cannot hold one token's k assignments
+    when the router concentrates) and the degenerate predicate flags
+    exactly the clamped configurations for the FFTA080 warning."""
+    from flexflow_tpu.ops.moe import moe_capacity, moe_capacity_degenerate
+
+    # raw = ceil(1.0 * 2 * 4 / 64) = 1 < k=2 -> clamped to 2
+    assert moe_capacity(4, 2, 64, 1.0) == 2
+    assert moe_capacity_degenerate(4, 2, 64, 1.0)
+    # ample batch: the requested capacity is the one that runs
+    assert moe_capacity(64, 2, 4, 1.0) == 32
+    assert not moe_capacity_degenerate(64, 2, 4, 1.0)
+    # the clamp never lowers a legal capacity
+    assert moe_capacity(64, 2, 4, 2.0) == 64
+
+
+def test_rank3_experts_match_flattened_rank2():
+    """(batch, seq, F) inputs dispatch per token over the flattened
+    leading dims — numerics match the same tokens fed as a rank-2 batch,
+    and the output restores the (batch, seq, out) shape. This is the
+    contract the serving decode path (seq=1) relies on."""
+    B, S, F, n, k, H = 4, 3, 6, 4, 2, 5
+    rng = np.random.RandomState(11)
+    x3 = rng.randn(B, S, F).astype(np.float32)
+
+    cfg = ff.FFConfig()
+    cfg.batch_size = B
+    cfg.allow_mixed_precision = False
+    m3 = ff.FFModel(cfg)
+    inp3 = m3.create_tensor([B, S, F])
+    out3 = m3.moe(inp3, n, k, H, alpha=float(n), fused=True, name="moe")
+    m3.final_tensor = out3
+    m3.compile(optimizer=ff.SGDOptimizer(m3, lr=0.0),
+               loss_type=ff.LossType.LOSS_IDENTITY)
+    got3 = _forward(m3, out3, x3)
+    assert got3.shape == (B, S, H)
+
+    flat, out_f = _build_moe(True, B * S, F, n, k, H)
+    flat.params = {kk: dict(vv) for kk, vv in flat.params.items()}
+    flat.params["moe_gate"] = dict(m3.params["moe_gate"])
+    flat.params["moe_experts"] = dict(m3.params["moe_experts"])
+    ref = _forward(flat, out_f, x3.reshape(B * S, F))
+    np.testing.assert_allclose(got3, ref.reshape(B, S, H),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_router_state_tracks_drops_and_load():
+    """The fused op threads router health through functional op state:
+    `load` holds the last step's per-expert assignment fractions (sums
+    to 1), `dropped` grows monotonically when a sub-1.0 capacity factor
+    forces overflow, and publish_moe_metrics mirrors both into the
+    ff_moe_* families."""
+    from flexflow_tpu.ffconst import CompMode
+    from flexflow_tpu.obs import publish_moe_metrics
+    from flexflow_tpu.obs.registry import MetricsRegistry
+
+    B, F, n, k, H = 32, 6, 4, 2, 5
+    cfg = ff.FFConfig()
+    cfg.batch_size = B
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor([B, F])
+    # alpha=0.25: capacity = max(k, ceil(0.25*2*32/4)) = 4 slots per
+    # expert for 64 assignments -> overflow is guaranteed
+    out = model.moe(inp, n, k, H, alpha=0.25, fused=True, name="moe")
+    model.final_tensor = out
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_IDENTITY)
+    x = np.random.RandomState(3).randn(B, F).astype(np.float32)
+
+    feeds = {model.input_ops[0].name: x}
+    _, state1, _ = model.executor.forward_values(
+        model.params, model.state, feeds, None,
+        CompMode.COMP_MODE_INFERENCE)
+    model.state = state1
+    load = np.asarray(state1["moe_experts"]["load"])
+    assert load.shape == (n,)
+    assert np.isclose(load.sum(), 1.0, atol=1e-5)
+    d1 = float(state1["moe_experts"]["dropped"])
+    assert d1 > 0
+
+    _, state2, _ = model.executor.forward_values(
+        model.params, model.state, feeds, None,
+        CompMode.COMP_MODE_INFERENCE)
+    assert float(state2["moe_experts"]["dropped"]) == 2 * d1  # monotone across steps
+
+    reg = MetricsRegistry()
+    model.state = state2
+    raw = publish_moe_metrics(model, registry=reg)
+    assert raw["moe_experts"]["dropped"] == 2 * d1
+    text = reg.render()
+    assert "ff_moe_router_dropped_tokens_total" in text
+    assert "ff_moe_expert_load_imbalance" in text

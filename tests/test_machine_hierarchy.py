@@ -744,3 +744,71 @@ def test_pipeline_placement_stage_count_differs_from_pod_count():
     assert outer4["cut_on_tier_boundary"] and outer4["hop_tier"] == "dcn"
     outer8 = stage_placement_options(machine, dp=2, pp=8)[0]
     assert not outer8["cut_on_tier_boundary"]
+
+
+# -- expert-parallel all_to_all tiering (ISSUE 16) --------------------------
+
+def _moe_experts_op(n=8, batch=64, F=16, k=2, H=24):
+    cfg = ff.FFConfig()
+    cfg.batch_size = batch
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor([batch, F])
+    m.moe(inp, n, k, H, alpha=float(n), fused=True, name="moe")
+    graph = Graph(m.ops)
+    op = next(o for o in graph.ops.values()
+              if o.op_type.value == "experts")
+    return graph, op, cfg
+
+
+def test_expert_a2a_pod_resident_never_prices_dcn():
+    """An ep group that fits the innermost tier (ep=8 on an 8-chip pod,
+    inner stride 1) routes its all_to_all entirely over ICI: its price is
+    a single-tier tier_path and does NOT move when the DCN tier is made
+    100x slower — while the cross-pod dp grad sync of the same plan
+    does."""
+    _, op, cfg = _moe_experts_op(n=8)
+    s = OpStrategy(dp=2, ep=8)
+
+    def price(dcn_scale):
+        machine = multipod()  # fresh: tier scales and memos reset
+        machine.tier_scales["dcn"] = dcn_scale
+        sim = Simulator(machine, cfg)
+        sim.cost.set_mesh_degrees(tp=1, sp=1, ep=8, ap=1)
+        return (sim.cost.ep_collective_time_us(op, s),
+                sim.cost.grad_sync_time_us(op, s))
+
+    a2a_fast, sync_fast = price(1.0)
+    a2a_slow, sync_slow = price(0.01)
+    assert a2a_fast > 0
+    assert a2a_slow == pytest.approx(a2a_fast)  # ICI-only: DCN-invariant
+    assert sync_slow > sync_fast  # dp=2 strided across the pods pays DCN
+
+    machine = multipod()
+    path = machine.tier_path(8, 1)
+    assert [t.name for t, _ in path] == ["ici"]
+
+
+def test_expert_a2a_crossing_pods_prices_the_dcn_tier():
+    """The SAME ep degree with a stride that pushes the group across the
+    pod boundary (an sp axis nested inside ep) spans both tiers: the
+    all_to_all price jumps and now scales with the DCN link speed —
+    the cost signal behind the FFTA085 pod-residency prune."""
+    _, op, cfg = _moe_experts_op(n=8, batch=64)
+
+    def price(sp_inner, dcn_scale=1.0):
+        machine = multipod()
+        machine.tier_scales["dcn"] = dcn_scale
+        sim = Simulator(machine, cfg)
+        sim.cost.set_mesh_degrees(tp=1, sp=sp_inner, ep=8, ap=1)
+        s = OpStrategy(dp=16 // (8 * sp_inner) if sp_inner == 1 else 1,
+                       ep=8, sp=sp_inner)
+        return sim.cost.ep_collective_time_us(op, s)
+
+    resident = price(1)
+    crossing = price(2)
+    assert crossing > resident
+    assert price(2, dcn_scale=0.5) > crossing  # rides the DCN link
+
+    machine = multipod()
+    path = machine.tier_path(8, 2)
+    assert [t.name for t, _ in path] == ["ici", "dcn"]

@@ -845,3 +845,78 @@ def test_legacy_overlap_knob_pins_blocking_pricing():
     assert 0.0 <= st["exposed_sync_us"] <= st["total_sync_us"]
     assert st["overlapped_sync_us"] == pytest.approx(
         st["total_sync_us"] - st["exposed_sync_us"])
+
+
+# -- expert-parallel enumeration (ISSUE 16) ---------------------------------
+
+def _moe_search_graph(n_experts=8, batch=64, F=32, k=2, H=48):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    model = ff.FFModel(config)
+    inp = model.create_tensor([batch, F])
+    out = model.moe(inp, n_experts, k, H, alpha=float(n_experts),
+                    fused=True, name="moe")
+    model.dense(out, 4)
+    return Graph(model.ops), config
+
+
+def test_feasible_ep_values_respect_divisibility():
+    """ep candidates divide BOTH the device count and every expert count;
+    graphs without EXPERTS ops get no ep candidates at all."""
+    from flexflow_tpu.search.unity import feasible_ep_values
+
+    graph, config = _moe_search_graph(n_experts=6)
+    # divisors of 8 devices: 2, 4, 8 — only 2 divides 6 experts
+    assert feasible_ep_values(graph, config, 8) == [1, 2]
+    graph12, config12 = _moe_search_graph(n_experts=12)
+    assert feasible_ep_values(graph12, config12, 8) == [1, 2, 4]
+    dense_graph, dense_config = (lambda m: (Graph(m.ops), m.config))(
+        (lambda: (m := ff.FFModel(ff.FFConfig()),
+                  m.dense(m.create_tensor([8, 4]), 4), m)[-1])())
+    assert feasible_ep_values(dense_graph, dense_config, 8) == [1]
+
+
+def test_factorization_enumeration_includes_ep_and_prunes_non_dividing():
+    """The cold sweep's factorization table carries ep>1 tuples for MoE
+    graphs, and the sanitizer prunes ep values that do not divide the
+    expert count before the simulator prices them."""
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    graph, config = _moe_search_graph(n_experts=6, batch=64)
+    machine = make_machine_model(config, 8)
+    helper = GraphSearchHelper(graph, config, machine)
+    facts = helper._feasible_factorizations(graph, 64, 8)
+    eps = {f[2] for f in facts}
+    assert 2 in eps  # divides 6 experts and 8 devices
+    assert 4 not in eps and 8 not in eps  # do not divide 6 experts
+    assert helper.candidates_pruned > 0
+
+
+def test_pod_residency_prunes_dcn_crossing_ep():
+    """On a multi-tier machine the ep group's device span (ep x the axes
+    nested inside it) must fit in the innermost tier: ep tuples that
+    would stride the routing all_to_all across DCN are pruned (FFTA085),
+    while the same tuples survive on a flat machine."""
+    from flexflow_tpu.search.machine_model import (CHIP_SPECS,
+                                                   HierarchicalMachineModel,
+                                                   TierSpec,
+                                                   make_machine_model)
+    from flexflow_tpu.search.unity import GraphSearchHelper
+
+    graph, config = _moe_search_graph(n_experts=16, batch=64)
+    chip = CHIP_SPECS["tpu-v5e"]
+    tiered = HierarchicalMachineModel(
+        [TierSpec("ici", 8, chip.ici_link_gbps, 2),
+         TierSpec("dcn", 2, 3.125, 1, 10.0)], chip)
+    helper = GraphSearchHelper(graph, config, tiered)
+    facts = helper._feasible_factorizations(graph, 64, 16)
+    spanning = [f for f in facts if f[2] > 1 and f[2] * f[3] * f[4] > 8]
+    assert not spanning, spanning
+    assert any(f[2] == 8 for f in facts)  # pod-filling ep survives
+
+    flat = make_machine_model(config, 16)
+    assert not getattr(flat, "tiers", None)
+    helper_flat = GraphSearchHelper(graph, config, flat)
+    facts_flat = helper_flat._feasible_factorizations(graph, 64, 16)
+    assert any(f[2] == 16 for f in facts_flat)  # no pod to protect

@@ -331,6 +331,76 @@ def test_warm_replan_prices_distance_term_in_log():
     assert any("reshard=" in line for line in warm.log), warm.log
 
 
+# -- expert-parallel plans across a pod-loss shrink (satellite: ep
+# transplant; docs/moe.md "Warm re-planning") ------------------------------
+
+def _moe_graph_model(cfg, F=1024, n=8, k=2, H=4096, head=True):
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor([cfg.batch_size, F])
+    out = m.moe(inp, n, k, H, alpha=float(n), fused=True, name="moe")
+    t = m.dense(out, 3)
+    if head:
+        m.softmax(t)
+    return m
+
+
+def test_warm_transplant_keeps_ep_legal_across_pod_loss():
+    """A cached ep>1 plan warm-starts the survivor search after a pod
+    loss; every transplanted EXPERTS strategy must stay legal on the
+    smaller mesh (ep divides the expert count AND fits the survivor
+    expert axis)."""
+    from flexflow_tpu.ffconst import OpType
+
+    cfg = _config(n_devices=8)
+    cfg.batch_size = 512
+    g = Graph(_moe_graph_model(cfg).ops)
+    cold = unity_optimize(g, cfg, TpuPodModel(8), 512, 8)
+    assert cold.cache_mode == "cold"
+    assert any(s.ep > 1 for s in cold.strategies.values()), cold.log
+
+    cfg_w = _config(n_devices=4)
+    cfg_w.batch_size = 512
+    cfg_w.device_ids = [0, 1, 2, 3]  # pod-loss survivors
+    g_w = Graph(_moe_graph_model(cfg_w).ops)
+    warm = unity_optimize(g_w, cfg_w, TpuPodModel(4), 512, 4)
+    assert warm.cache_mode == "warm"
+    ep_axis = warm.mesh_axes.get("expert", 1)
+    for guid, s in warm.strategies.items():
+        op = g_w.ops[guid]
+        if op.op_type == OpType.EXPERTS:
+            assert 8 % max(s.ep, 1) == 0
+            assert s.ep <= ep_axis
+
+
+def test_plan_distance_clamps_cached_ep_to_survivor_axis():
+    """Regression: a cached strategy carrying ep=4 priced against a
+    survivor mesh whose 'expert' axis shrank to 2 must claim the SAME
+    degree the runtime will apply (min(s.ep, axis) — model.py
+    _assign_strategy), so an effectively-unchanged layout prices as a
+    noop instead of a phantom reshard."""
+    from flexflow_tpu.resharding import plan_of
+    from flexflow_tpu.search.simulator import OpStrategy
+
+    cfg = _config(n_devices=4, budget=0)
+    cfg.device_ids = list(range(4))
+    m = _moe_graph_model(cfg, F=16, n=8, k=2, H=32)
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.05),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              parallel_axes={"data": 2, "expert": 2})
+    live = plan_of(m)
+    graph = m.graph
+    machine = TpuPodModel(4)
+    axes = {"data": 2, "expert": 2}
+    # the cached (pre-loss) plan says ep=4; the survivor axis is 2
+    cand = {g: (OpStrategy(dp=2, ep=4)
+                if graph.ops[g].op_type.value == "experts"
+                else OpStrategy(dp=2))
+            for g in graph.ops}
+    d = plan_distance_us(graph, live, cand, axes, machine, 4,
+                         device_ids=cfg.device_ids)
+    assert d == 0.0  # runtime clamps ep 4 -> 2: nothing actually moves
+
+
 # -- background pre-planning ------------------------------------------------
 
 def test_background_planner_runs_jobs_and_survives_errors():
