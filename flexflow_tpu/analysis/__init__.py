@@ -16,11 +16,23 @@ Public surface:
  - check_redistribution / redistribution_diagnostics /
    survivor_diagnostics — the FFTA06x gate over live-resharding
    schedules (resharding/) and the shard-coverage check the elastic
-   coordinator consults before a zero-disk recovery.
+   coordinator consults before a zero-disk recovery;
+ - AbstractLayout / ShardingFlowInterpreter / CollectiveEvent /
+   verify_grad_sync_program / verify_reshard_program — the FFTA09x
+   sharding-flow verifier (interp.py): abstract interpretation of a
+   plan over the PCG plus deadlock/uniformity model checking of the
+   executed collective program (docs/analysis.md "Verifier").
 """
 from .diagnostics import (CODE_CATALOG, Diagnostic, DiagnosticReport,
                           PlanAnalysisError, Severity, diagnostic_counters,
                           make_diag, record_report, reset_counters)
+from .interp import (AbstractLayout, CollectiveEvent,
+                     ShardingFlowInterpreter, build_grad_sync_program,
+                     build_reshard_program, check_event_partitions,
+                     check_program_uniformity, gradient_state,
+                     participant_programs, pass_sharding_flow,
+                     semantic_reduction_diagnostics,
+                     verify_grad_sync_program, verify_reshard_program)
 from .passes import (AnalysisContext, default_strategies_for,
                      factorization_diagnostics, plan_memory_bytes,
                      redistribution_diagnostics, survivor_diagnostics)
@@ -29,24 +41,37 @@ from .pipeline import (ALL_PASSES, CHEAP_PASSES, PASS_REGISTRY,
 
 __all__ = [
     "ALL_PASSES",
+    "AbstractLayout",
     "AnalysisContext",
     "CHEAP_PASSES",
     "CODE_CATALOG",
+    "CollectiveEvent",
     "Diagnostic",
     "DiagnosticReport",
     "PASS_REGISTRY",
     "PlanAnalysisError",
     "Severity",
+    "ShardingFlowInterpreter",
     "analyze_plan",
+    "build_grad_sync_program",
+    "build_reshard_program",
+    "check_event_partitions",
     "check_plan",
+    "check_program_uniformity",
     "check_redistribution",
     "default_strategies_for",
     "diagnostic_counters",
     "factorization_diagnostics",
+    "gradient_state",
     "make_diag",
+    "participant_programs",
+    "pass_sharding_flow",
     "plan_memory_bytes",
     "record_report",
     "redistribution_diagnostics",
     "reset_counters",
+    "semantic_reduction_diagnostics",
     "survivor_diagnostics",
+    "verify_grad_sync_program",
+    "verify_reshard_program",
 ]

@@ -136,9 +136,13 @@ def run_analyze(argv: Optional[List[str]] = None) -> int:
         final_guid=final.guid if final is not None else None)
     report.extend(provenance_diags)
     record_report(report)
+    # --json keeps stdout PURE machine-readable (the stable schema in
+    # DiagnosticReport.to_json, consumed by the CI verify-plans job);
+    # the human verdict line moves to stderr
     print(report.to_json() if as_json else report.format())
     if report.ok:
         print(f"plan OK: {model_name} on {n_dev} device(s)"
-              + (f" under {strategy_path}" if strategy_path else ""))
+              + (f" under {strategy_path}" if strategy_path else ""),
+              file=sys.stderr if as_json else sys.stdout)
         return 0
     return 1

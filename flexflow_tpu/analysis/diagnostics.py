@@ -105,6 +105,22 @@ CODE_CATALOG: Dict[str, tuple] = {
     "FFTA085": (Severity.ERROR,
                 "expert-parallel group spans the slow inter-pod tier:"
                 " the routing all_to_all must stay pod-resident"),
+    # -- sharding-flow verifier (FFTA09x, analysis/interp.py) --
+    "FFTA090": (Severity.ERROR,
+                "unreduced gradient use: a pending partial sum is never"
+                " discharged by the executed collective schedule"),
+    "FFTA091": (Severity.ERROR,
+                "mismatched or non-covering axis_index_groups: participants"
+                " of one group issue different collective sequences"),
+    "FFTA092": (Severity.ERROR,
+                "cross-group ordering cycle in the interleaved collective"
+                " schedule (deadlock)"),
+    "FFTA093": (Severity.ERROR,
+                "layout-incompatible edge: the consumer's layout does not"
+                " compose with the producer tensor it consumes"),
+    "FFTA094": (Severity.ERROR,
+                "donation/alias overwrite of a tensor still live in the"
+                " abstract state"),
 }
 
 
@@ -187,7 +203,18 @@ class DiagnosticReport:
         return "\n".join(lines)
 
     def to_json(self) -> str:
+        """Machine-readable report with a STABLE schema (consumed by the
+        CI verify-plans job instead of grepping stdout). Schema contract,
+        append-only like the code catalog: bump "schema" only when an
+        existing key changes meaning — new keys may appear at any time.
+        v1 keys: schema, ok, errors, warnings, counts, passes_run,
+        diagnostics[{code, severity, message, op_guid, op_name, hint}]."""
         return json.dumps({
+            "schema": 1,
+            "ok": self.ok,
+            "errors": len(self.errors()),
+            "warnings": len(self.warnings()),
+            "counts": self.counts(),
             "passes_run": self.passes_run,
             "diagnostics": [d.to_dict() for d in self.diagnostics],
         }, indent=2)

@@ -19,6 +19,7 @@ from typing import Dict, Optional, Sequence
 
 from ..core.graph import Graph
 from .diagnostics import DiagnosticReport, PlanAnalysisError, record_report
+from .interp import pass_sharding_flow
 from .passes import (AnalysisContext, default_strategies_for,
                      pass_collectives, pass_divisibility, pass_donation,
                      pass_hygiene, pass_memory_fit, pass_moe,
@@ -34,11 +35,16 @@ PASS_REGISTRY = {
     "donation": pass_donation,
     "hygiene": pass_hygiene,
     "moe": pass_moe,
+    "flow": pass_sharding_flow,
 }
 
 # the machine-model-free subset: a preset for analyze_plan(passes=...)
-# callers that want a quick structural check without a MachineModel
-CHEAP_PASSES = ("divisibility", "collectives", "hygiene", "moe")
+# callers that want a quick structural check without a MachineModel.
+# "flow" is the sharding-flow verifier's layout-only subset (FFTA093/094
+# edge composition + FFTA090 discharge when an executed schedule is in
+# the context) — the full collective-program model checker runs where a
+# schedule exists (plan_grad_sync_lowering / check_redistribution)
+CHEAP_PASSES = ("divisibility", "collectives", "hygiene", "moe", "flow")
 ALL_PASSES = tuple(PASS_REGISTRY)
 
 
@@ -95,11 +101,16 @@ def check_redistribution(schedule, machine=None,
     gate semantics — warnings logged and counted, errors raise
     PlanAnalysisError carrying the report. Every schedule the elastic
     coordinator or the serving resize path is about to execute goes
-    through here first."""
+    through here first. The sharding-flow verifier's program checker
+    rides along (FFTA091/092, docs/analysis.md "Verifier"): the
+    schedule's collective rounds must be SPMD-uniform and deadlock-free
+    as a per-participant program, not just legal move-by-move."""
+    from .interp import verify_reshard_program
     from .passes import redistribution_diagnostics
 
-    report = DiagnosticReport(passes_run=["redistribution"])
+    report = DiagnosticReport(passes_run=["redistribution", "flow"])
     report.extend(redistribution_diagnostics(schedule, machine=machine))
+    report.extend(verify_reshard_program(schedule))
     if record:
         record_report(report)
     for d in report.warnings():

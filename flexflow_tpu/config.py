@@ -184,6 +184,12 @@ class FFConfig:
     # simulator prices them; False simulates every divisor tuple (the
     # unpruned comparison baseline — same chosen strategy, more work)
     analysis_prune: bool = True
+    # Opt-in search prune (--verify-candidates): run the sharding-flow
+    # verifier's cheap layout subset over the top-K simulated candidates
+    # and drop any that fail before the winner is chosen — a plan the
+    # verifier rejects would only bounce off the compile gate later
+    # (docs/analysis.md "Verifier")
+    verify_candidates: bool = False
     # Pre-flight plan analysis at compile()/re-plan time: "error" rejects
     # plans with error-severity diagnostics (PlanAnalysisError), "warn"
     # only logs, "off" skips the pipeline
@@ -358,6 +364,8 @@ class FFConfig:
                 self.search_overlap_backward_update = True
             elif a == "--no-analysis-prune":
                 self.analysis_prune = False
+            elif a == "--verify-candidates":
+                self.verify_candidates = True
             elif a == "--plan-analysis":
                 v = take()
                 if v not in ("error", "warn", "off"):

@@ -923,27 +923,35 @@ class FFModel:
         )
 
     def _verify_executed_reductions(self) -> None:
-        """The FFTA072 compile-time gate: with the explicit collective
-        lowering active, fail loudly (under plan_analysis="error") if
-        the lowering dropped or renamed any tensor the priced
-        reduction_plan names — the analysis and the cost model must
-        describe the schedule that actually runs (docs/analysis.md)."""
+        """The compile-time executed-schedule gate: with the explicit
+        collective lowering active, fail loudly (under
+        plan_analysis="error") if the lowering dropped or renamed any
+        tensor the priced reduction_plan names (FFTA072) — and, beyond
+        name matching, if the priced plan and the executed schedule do
+        not *interpret* to the same discharged gradient state: the
+        sharding-flow verifier re-derives each weight's pending
+        partial-sum axes from the graph + strategies and requires the
+        executed schedule to discharge them all (FFTA090,
+        docs/analysis.md "Verifier")."""
         lowering = getattr(self.executor, "grad_sync_lowering", None)
         mode = getattr(self.config, "plan_analysis", "error")
         if lowering is None or mode == "off" or not self._reduction_plan:
             return
         from .analysis import PlanAnalysisError, record_report
         from .analysis.diagnostics import DiagnosticReport
+        from .analysis.interp import semantic_reduction_diagnostics
         from .analysis.passes import (AnalysisContext,
                                       check_executed_reductions)
 
         ctx = AnalysisContext(
             graph=self.graph,
+            strategies=getattr(self, "_op_strategies", None),
             reduction_strategies=self._reduction_plan,
             executed_reductions=lowering.executed_plan(),
             executed_buckets=lowering.executed_buckets())
-        report = DiagnosticReport(passes_run=["tiers"])
+        report = DiagnosticReport(passes_run=["tiers", "flow"])
         report.extend(check_executed_reductions(ctx))
+        report.extend(semantic_reduction_diagnostics(ctx))
         if not report.diagnostics:
             return
         record_report(report)

@@ -456,5 +456,38 @@ def plan_grad_sync_lowering(config, graph, mesh, reduction_plan,
                       " GSPMD schedule is already tier-optimal",)
     if not entries:
         return None, ("no synced weight tensors",)
-    return GradSyncLowering(axis_name=axis, degree=dp,
-                            entries=entries, mode=mode), ()
+    lowering = GradSyncLowering(axis_name=axis, degree=dp,
+                                entries=entries, mode=mode)
+    _verify_lowered_program(config, graph, lowering)
+    return lowering, ()
+
+
+def _verify_lowered_program(config, graph, lowering) -> None:
+    """Mandatory sharding-flow gate before the explicit lowering's
+    collectives are ever jitted (docs/analysis.md "Verifier"): the
+    executed program — tier groups, bucket fusion, per-participant
+    sequences — must discharge every pending gradient (FFTA090), carry
+    partition-legal axis_index_groups (FFTA091), and be deadlock-free
+    under the blocking-collective semantics (FFTA092). Honors the
+    plan_analysis knob: "error" raises PlanAnalysisError, "warn" logs,
+    "off" skips. Cheap (pure Python over entries x tier levels), so it
+    runs on every lowering, not just under the analysis CLI."""
+    gate = getattr(config, "plan_analysis", "error") or "error"
+    if gate == "off":
+        return
+    from ..analysis.diagnostics import (DiagnosticReport,
+                                        PlanAnalysisError, record_report)
+    from ..analysis.interp import verify_grad_sync_program
+
+    report = DiagnosticReport(passes_run=["collective_program"])
+    report.extend(verify_grad_sync_program(lowering, graph=graph))
+    if not report.diagnostics:
+        return
+    record_report(report)
+    import logging
+
+    log = logging.getLogger("flexflow_tpu.collectives")
+    for d in report.diagnostics:
+        log.warning("collective program: %s", d.format())
+    if gate == "error" and report.errors():
+        raise PlanAnalysisError(report)

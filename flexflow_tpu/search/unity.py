@@ -654,6 +654,8 @@ class GraphSearchHelper:
             self._pipeline_candidates(graph, batch_size, n_devices))
         if not candidates:
             raise ValueError("no feasible mesh factorization")
+        candidates = self._verify_candidate_plans(graph, batch_size,
+                                                  candidates)
         best = min(candidates, key=lambda r: r.cost_us + lam * r.memory_bytes)
         # grad-sync overlap split of the winner (docs/machine.md
         # "Overlap"): pipeline candidates computed theirs inline;
@@ -669,6 +671,38 @@ class GraphSearchHelper:
         if not quiet:
             self.log.extend(c.log[0] for c in candidates)
         return best
+
+    def _verify_candidate_plans(self, graph: Graph, batch_size: int,
+                                candidates: List[SearchResult]
+                                ) -> List[SearchResult]:
+        """Opt-in FFTA09x search prune (--verify-candidates,
+        docs/analysis.md "Verifier"): symbolically execute each
+        candidate plan through the sharding-flow interpreter's cheap
+        layout subset and drop the ones it rejects BEFORE the winner is
+        chosen — a failing plan would only bounce off the compile gate
+        later, after the search already spent its budget on it. A slate
+        the verifier rejects wholesale is returned unfiltered (the
+        compile gate gives the real, attributed error)."""
+        if not getattr(self.config, "verify_candidates", False):
+            return candidates
+        from ..analysis.diagnostics import Severity
+        from ..analysis.interp import ShardingFlowInterpreter
+
+        kept: List[SearchResult] = []
+        rejected = 0
+        for r in candidates:
+            diags = ShardingFlowInterpreter(
+                graph, r.strategies, batch_size=batch_size).run()
+            if any(d.severity is Severity.ERROR for d in diags):
+                rejected += 1
+                continue
+            kept.append(r)
+        self.candidates_verify_rejected = rejected
+        if rejected:
+            self.log.append(
+                f"verify-candidates: sharding-flow verifier rejected"
+                f" {rejected}/{len(candidates)} candidate plan(s)")
+        return kept or candidates
 
     def _pipeline_candidates(self, graph: Graph, batch_size: int,
                              n_devices: int) -> List[SearchResult]:

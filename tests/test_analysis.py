@@ -459,6 +459,24 @@ def test_analyze_cli_clean(capsys):
     assert "plan OK" in capsys.readouterr().out
 
 
+def test_analyze_cli_json_schema_v1(capsys):
+    """--json keeps stdout PURE machine-readable under the stable v1
+    schema (the human verdict moves to stderr) — the contract the CI
+    verify-plans job parses."""
+    from flexflow_tpu.analysis.cli import run_analyze
+
+    assert run_analyze(["--model", "mnist_mlp", "--chips", "4",
+                        "--json"]) == 0
+    out, err = capsys.readouterr()
+    doc = json.loads(out)  # would raise if a verdict line leaked in
+    assert doc["schema"] == 1
+    assert doc["ok"] is True and doc["errors"] == 0
+    assert set(doc) >= {"schema", "ok", "errors", "warnings", "counts",
+                        "passes_run", "diagnostics"}
+    assert "flow" in doc["passes_run"]
+    assert "plan OK" in err
+
+
 def test_analyze_cli_missing_flag_value_is_usage_error(capsys):
     from flexflow_tpu.analysis.cli import run_analyze
 

@@ -1,0 +1,421 @@
+"""Sharding-flow verifier (ISSUE 17): abstract interpretation of
+parallel plans plus deadlock/uniformity model checking of the executed
+collective program (FFTA09x, docs/analysis.md "Verifier").
+
+The decisive properties:
+ - every checked-in strategy artifact, every zoo model's searched plan
+   (test_analysis.py covers those through the shared pipeline), a moe
+   plan searched on the multipod_2x8 hierarchy, and a live-resharding
+   schedule all verify CLEAN through the new pass;
+ - five seeded mutations — dropped sync, overlapping group member,
+   reordered participant program, layout-incompatible edge, in-place
+   overwrite of a live tensor — each produce their exact FFTA09x code;
+ - the diagnostic catalogue, the analysis sources, and
+   docs/analysis.md never drift apart (both directions).
+"""
+import copy
+import glob
+import json
+import os
+import re
+
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.analysis import (
+    ALL_PASSES,
+    CHEAP_PASSES,
+    PlanAnalysisError,
+    ShardingFlowInterpreter,
+    analyze_plan,
+    build_grad_sync_program,
+    build_reshard_program,
+    check_event_partitions,
+    check_program_uniformity,
+    gradient_state,
+    participant_programs,
+    verify_grad_sync_program,
+    verify_reshard_program,
+)
+from flexflow_tpu.analysis.diagnostics import CODE_CATALOG, Severity
+from flexflow_tpu.analysis.interp import (
+    ALL_GATHER,
+    PSUM,
+    PSUM_SCATTER,
+    AbstractLayout,
+    CollectiveEvent,
+)
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.search.simulator import OpStrategy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class StubMesh:
+    """The mesh surface plan_grad_sync_lowering reads — no jax needed."""
+
+    def __init__(self, n=8):
+        self.axis_names = ("data",)
+        self.shape = {"data": n}
+
+
+def build_mlp(batch=64, din=32, hidden=128, classes=10):
+    config = ff.FFConfig()
+    config.batch_size = batch
+    m = ff.FFModel(config)
+    t = m.create_tensor([batch, din])
+    t = m.dense(t, hidden, ff.ActiMode.AC_MODE_RELU)
+    t = m.dense(t, classes)
+    m.softmax(t)
+    return m, Graph(m.ops), config
+
+
+def tiered_lowering(graph, n=8, strategy="rs_ar_ag", inner=4, outer=2):
+    """An explicit lowering whose every weighted op syncs over an
+    inner x outer tier decomposition (built exactly the way compile()
+    does, through plan_grad_sync_lowering — gate included)."""
+    from flexflow_tpu.runtime.collectives import plan_grad_sync_lowering
+
+    cfg = ff.FFConfig()
+    cfg.collective_lowering = "explicit"
+    plan = {op.name: {"strategy": strategy, "degree": n, "bytes": 1e6,
+                      "tiers": [{"tier": "ici", "group": inner},
+                                {"tier": "dcn", "group": outer}]}
+            for op in graph.topo_order() if op.weights}
+    lowering, reasons = plan_grad_sync_lowering(cfg, graph, StubMesh(n),
+                                                plan)
+    assert lowering is not None, reasons
+    return lowering
+
+
+# ---------------------------------------------------------------------
+# the abstract domain
+# ---------------------------------------------------------------------
+def test_abstract_layout_of_strategy():
+    _, g, _ = build_mlp()
+    dense = next(op for op in g.ops.values() if "linear" in op.name)
+    out = dense.outputs[0]
+    lay = AbstractLayout.of_strategy(dense, OpStrategy(dp=4, tp=2), out)
+    assert lay.dims[0] == ("data", 4)
+    assert lay.dims[-1] == ("model", 2)
+    assert lay.pending == frozenset()
+    # a row-parallel matmul's raw output is a pending partial sum
+    row = AbstractLayout.of_strategy(
+        dense, OpStrategy(tp=2, tp_row=True), out)
+    assert row.pending == frozenset({"model"})
+    assert AbstractLayout.replicated(2).dims == (None, None)
+
+
+def test_gradient_state_tracks_sync_degree():
+    _, g, _ = build_mlp()
+    weighted = [op for op in g.topo_order() if op.weights]
+    synced = gradient_state(
+        g, {op.guid: OpStrategy(dp=4) for op in weighted})
+    assert all(synced[op.name] == frozenset({"data"}) for op in weighted)
+    unsynced = gradient_state(
+        g, {op.guid: OpStrategy(dp=1, tp=2) for op in weighted})
+    assert all(unsynced[op.name] == frozenset() for op in weighted)
+    # no strategy pinned: conservatively pending
+    assert all(v == frozenset({"data"})
+               for v in gradient_state(g, None).values())
+
+
+def test_flow_pass_registered_in_presets():
+    assert "flow" in CHEAP_PASSES and "flow" in ALL_PASSES
+
+
+# ---------------------------------------------------------------------
+# program construction mirrors lower_allreduce
+# ---------------------------------------------------------------------
+def test_program_expansion_flat_hier_rs():
+    _, g, _ = build_mlp()
+    flat = build_grad_sync_program(tiered_lowering(g, strategy="flat"))
+    per_op = {e.tag for e in flat}
+    assert len(per_op) == 2 and all(e.kind == PSUM for e in flat)
+    assert all(e.groups == (tuple(range(8)),) for e in flat)
+
+    hier = build_grad_sync_program(
+        tiered_lowering(g, strategy="hier_ring"))
+    kinds = [e.kind for e in hier if e.tag == sorted(per_op)[0]]
+    assert kinds == [PSUM, PSUM]  # one psum per tier level
+
+    rs = build_grad_sync_program(tiered_lowering(g, strategy="rs_ar_ag"))
+    seq = [(e.kind, len(e.groups)) for e in rs
+           if e.tag == sorted(per_op)[0]]
+    # scatter over the 2 inner rings, psum over the 4 cross groups,
+    # gather back over the inner rings — lower_allreduce's issue order
+    assert seq == [(PSUM_SCATTER, 2), (PSUM, 4), (ALL_GATHER, 2)]
+
+
+def test_bucketed_entries_collapse_to_one_program():
+    from flexflow_tpu.runtime.collectives import GradSyncLowering
+
+    entries = {
+        "a": {"strategy": "flat", "sizes": [8], "tiers": [],
+              "bucket": 0, "bytes": 1.0},
+        "b": {"strategy": "flat", "sizes": [8], "tiers": [],
+              "bucket": 0, "bytes": 1.0},
+        "c": {"strategy": "flat", "sizes": [8], "tiers": [],
+              "bucket": None, "bytes": 1.0},
+    }
+    low = GradSyncLowering(axis_name="data", degree=8, entries=entries,
+                           mode="explicit")
+    ev = build_grad_sync_program(low)
+    # bucket mates fuse to ONE collective; the unbucketed op keeps its own
+    assert [e.tag for e in ev] == ["bucket:0", "c"]
+
+
+# ---------------------------------------------------------------------
+# clean plans verify clean
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("strategy", ["flat", "hier_ring", "rs_ar_ag"])
+def test_grad_sync_program_verifies_clean(strategy):
+    _, g, _ = build_mlp()
+    low = tiered_lowering(g, strategy=strategy)
+    weighted = [op for op in g.topo_order() if op.weights]
+    diags = verify_grad_sync_program(
+        low, graph=g, strategies={op.guid: OpStrategy(dp=8)
+                                  for op in weighted})
+    assert diags == []
+
+
+@pytest.mark.parametrize("path", sorted(
+    glob.glob(os.path.join(REPO, "examples", "strategies", "*.json"))))
+def test_strategy_artifacts_verify_clean(path, capsys):
+    """Every checked-in strategy file passes the full pipeline (flow
+    pass included) through the CLI, and the --json stdout carries no
+    FFTA09x finding — the same contract the CI verify-plans job pins."""
+    from flexflow_tpu.analysis.cli import run_analyze
+
+    model = os.path.basename(path).replace("_8dev.json", "")
+    assert run_analyze(["--model", model, "--chips", "8",
+                        "--strategy", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == 1 and doc["ok"]
+    assert not [d for d in doc["diagnostics"]
+                if d["code"].startswith("FFTA09")]
+    assert "flow" in doc["passes_run"]
+
+
+def test_moe_searched_plan_on_multipod_verifies():
+    """A moe plan searched on the multipod_2x8 hierarchy analyzes clean
+    AND its explicit grad-sync lowering model-checks clean."""
+    from flexflow_tpu.runtime.collectives import plan_grad_sync_lowering
+    from flexflow_tpu.search.machine_model import HierarchicalMachineModel
+    from flexflow_tpu.search.unity import unity_optimize
+
+    machine = HierarchicalMachineModel.from_json(
+        os.path.join(REPO, "examples", "machines", "multipod_2x8.json"))
+    config = ff.FFConfig()
+    config.batch_size = 32
+    config.search_budget = 2
+    config.use_native_search = False
+    m = ff.FFModel(config)
+    inp = m.create_tensor([32, 8])
+    out = m.moe(inp, 4, 2, 12, alpha=4.0, fused=True, name="moe")
+    m.dense(out, 3)
+    g = Graph(m.ops)
+    result = unity_optimize(g, config, machine, 32, 16)
+    report = analyze_plan(
+        g, strategies=result.strategies, machine=machine, config=config,
+        batch_size=32, n_devices=16, mesh_axes=result.mesh_axes,
+        reduction_strategies=result.reduction_strategies,
+        final_guid=g.topo_order()[-1].guid)
+    assert report.ok, report.format()
+    assert not [d for d in report.diagnostics
+                if d.code.startswith("FFTA09")]
+    if result.reduction_strategies:
+        dp = max(e["degree"]
+                 for e in result.reduction_strategies.values())
+        cfg = ff.FFConfig()
+        cfg.collective_lowering = "explicit"
+        low, reasons = plan_grad_sync_lowering(
+            cfg, g, StubMesh(dp), result.reduction_strategies)
+        if low is None:
+            # the documented GSPMD fallback: experts carry running
+            # state, so the explicit lowering declines the whole model
+            assert any("running state" in r for r in reasons), reasons
+        else:
+            assert verify_grad_sync_program(
+                low, graph=g, strategies=result.strategies) == []
+
+
+def test_live_reshard_schedule_verifies_clean():
+    import numpy as np
+
+    from flexflow_tpu.analysis import check_redistribution
+    from flexflow_tpu.resharding import (ArraySpec, MeshSpec, ShardingPlan,
+                                         plan_redistribution)
+    from flexflow_tpu.search.machine_model import (ChipSpec,
+                                                   SimpleMachineModel)
+
+    mesh = MeshSpec(device_ids=tuple(range(8)),
+                    axes=(("data", 4), ("model", 2)))
+    old = ShardingPlan(mesh=mesh,
+                       arrays={"w": ArraySpec((4, 1), ("data", None))})
+    new = ShardingPlan(mesh=mesh, arrays={})
+    sched = plan_redistribution({"w": np.zeros((16, 8), np.float32)},
+                                old, new, peak_bytes=1 << 30)
+    assert verify_reshard_program(sched) == []
+    events, devices = build_reshard_program(sched)
+    # the allgather round groups the old mesh along 'data': 2 groups of 4
+    ag = [e for e in events if e.kind == ALL_GATHER]
+    assert ag and all(len(e.groups) == 2 and
+                      all(len(grp) == 4 for grp in e.groups)
+                      for e in ag)
+    report = check_redistribution(
+        sched, machine=SimpleMachineModel(8, ChipSpec(hbm_gb=16.0)),
+        record=False)
+    assert report.ok and "flow" in report.passes_run
+
+
+# ---------------------------------------------------------------------
+# seeded mutations: each corruption produces its exact code
+# ---------------------------------------------------------------------
+def test_mutation_dropped_sync_ffta090():
+    _, g, _ = build_mlp()
+    low = copy.deepcopy(tiered_lowering(g))
+    dropped = next(iter(low.entries))
+    del low.entries[dropped]
+    codes = [d.code for d in verify_grad_sync_program(low, graph=g)]
+    assert codes == ["FFTA090"]
+    d = verify_grad_sync_program(low, graph=g)[0]
+    assert dropped in d.message and d.severity is Severity.ERROR
+
+
+def test_mutation_swapped_group_member_ffta091():
+    _, g, _ = build_mlp()
+    events = list(build_grad_sync_program(tiered_lowering(g)))
+    e0 = events[0]
+    groups = [list(grp) for grp in e0.groups]
+    groups[0][0] = groups[1][0]  # one member duplicated, one uncovered
+    events[0] = CollectiveEvent(
+        e0.kind, e0.tag, e0.phase,
+        tuple(tuple(grp) for grp in groups))
+    codes = {d.code for d in check_event_partitions(events, 8)}
+    assert codes == {"FFTA091"}
+    # the full verifier stops at the static layer for this corruption
+    msgs = " ".join(d.message
+                    for d in check_event_partitions(events, 8))
+    assert "axis_index_group" in msgs or "cover" in msgs
+
+
+def test_mutation_reordered_round_ffta092():
+    _, g, _ = build_mlp()
+    events = build_grad_sync_program(tiered_lowering(g, strategy="flat"))
+    progs = participant_programs(events, range(8))
+    # one participant issues the two fused syncs in the opposite order
+    progs[3][0], progs[3][1] = progs[3][1], progs[3][0]
+    codes = [d.code for d in check_program_uniformity(progs)]
+    assert codes == ["FFTA092"]
+    assert "cycle" in check_program_uniformity(progs)[0].message
+
+
+def test_mutation_incompatible_edge_ffta093():
+    _, g, _ = build_mlp()
+    ops = g.topo_order()
+    strategies = {op.guid: OpStrategy(dp=4) for op in ops}
+    consumer = ops[1]
+    t = consumer.inputs[0]
+    orig = t.dims
+    try:
+        # a "rewrite" drifts the producer tensor's batch dim: 64 -> 66,
+        # indivisible by dp=4 while the consumer's own output stays legal
+        t.dims = (orig[0] + 2,) + tuple(orig[1:])
+        diags = ShardingFlowInterpreter(g, strategies, batch_size=64).run()
+        assert [d.code for d in diags] == ["FFTA093"]
+        assert diags[0].op_name == consumer.name
+    finally:
+        t.dims = orig
+
+
+def test_mutation_inplace_overwrite_ffta094():
+    config = ff.FFConfig()
+    config.batch_size = 64
+    m = ff.FFModel(config)
+    x = m.create_tensor([64, 32])
+    h = m.dense(x, 32)
+    h2 = m.dense(h, 32)
+    m.add(h2, h)  # h is read again AFTER the second dense
+    g = Graph(m.ops)
+    clobber = next(op for op in g.topo_order()
+                   if op.inputs and op.inputs[0].guid == h.guid)
+    clobber.params["inplace"] = True
+    diags = ShardingFlowInterpreter(g, {}).run()
+    assert [d.code for d in diags] == ["FFTA094"]
+    assert "add" in diags[0].message
+
+
+def test_uniformity_head_disagreement_ffta091():
+    # two participants reach the same sync tag with different groups
+    progs = {0: [("psum", "t", 0, (0, 1))],
+             1: [("psum", "t", 0, (1, 0))]}  # group order differs
+    codes = [d.code for d in check_program_uniformity(progs)]
+    assert codes == ["FFTA091"]
+    # a participant issuing a collective excluding itself is also 091
+    bad = {0: [("psum", "t", 0, (1, 2))]}
+    assert [d.code for d in check_program_uniformity(bad)] == ["FFTA091"]
+
+
+# ---------------------------------------------------------------------
+# the runtime gate and the search prune
+# ---------------------------------------------------------------------
+def test_lowering_gate_raises_on_corrupt_schedule():
+    from flexflow_tpu.runtime.collectives import _verify_lowered_program
+
+    _, g, _ = build_mlp()
+    low = copy.deepcopy(tiered_lowering(g))
+    del low.entries[next(iter(low.entries))]
+    cfg = ff.FFConfig()
+    with pytest.raises(PlanAnalysisError, match="FFTA090"):
+        _verify_lowered_program(cfg, g, low)
+    cfg.plan_analysis = "warn"
+    _verify_lowered_program(cfg, g, low)  # logs, no raise
+    cfg.plan_analysis = "off"
+    _verify_lowered_program(cfg, g, low)
+
+
+def test_verify_candidates_flag_and_clean_search():
+    from flexflow_tpu.search.machine_model import make_machine_model
+    from flexflow_tpu.search.unity import unity_optimize
+
+    config = ff.FFConfig()
+    assert config.verify_candidates is False
+    assert config.parse_args(["--verify-candidates"]) == []
+    assert config.verify_candidates is True
+
+    config.batch_size = 64
+    config.search_budget = 2
+    config.use_native_search = False
+    _, g, _ = build_mlp()
+    machine = make_machine_model(config, 4)
+    result = unity_optimize(g, config, machine, 64, 4)
+    # a clean graph loses no candidate to the verifier
+    assert result.strategies
+    report = analyze_plan(g, strategies=result.strategies,
+                          machine=machine, config=config, batch_size=64,
+                          n_devices=4, mesh_axes=result.mesh_axes)
+    assert report.ok, report.format()
+
+
+# ---------------------------------------------------------------------
+# catalogue / docs drift guard
+# ---------------------------------------------------------------------
+def test_catalogue_docs_never_drift():
+    """Both directions: every FFTA code referenced by the analysis
+    sources or the docs exists in CODE_CATALOG, and every catalogued
+    code is documented in docs/analysis.md and emitted/referenced
+    somewhere in the analysis sources."""
+    sources = ""
+    for name in ("diagnostics.py", "passes.py", "interp.py"):
+        with open(os.path.join(REPO, "flexflow_tpu", "analysis", name)) as f:
+            sources += f.read()
+    with open(os.path.join(REPO, "docs", "analysis.md")) as f:
+        docs = f.read()
+    catalog = set(CODE_CATALOG)
+    in_sources = set(re.findall(r"FFTA\d{3}", sources))
+    in_docs = set(re.findall(r"FFTA\d{3}", docs))
+    assert in_sources <= catalog, sorted(in_sources - catalog)
+    assert in_docs <= catalog, sorted(in_docs - catalog)
+    assert catalog <= in_docs, sorted(catalog - in_docs)
+    assert catalog <= in_sources, sorted(catalog - in_sources)

@@ -153,7 +153,7 @@ def test_gate_passes_clean_schedule():
     sched = plan_redistribution({"w": np.zeros((16, 8), np.float32)},
                                 old, new, peak_bytes=1 << 30)
     report = check_redistribution(sched, machine=machine(), record=False)
-    assert report.ok and report.passes_run == ["redistribution"]
+    assert report.ok and report.passes_run == ["redistribution", "flow"]
     assert schedule_cost_us(sched, machine()) > 0
 
 

@@ -60,3 +60,45 @@ def test_substitutions_to_dot_renders_rule():
     assert out.startswith("digraph substitution")
     assert "cluster_src" in out and "cluster_dst" in out
     assert "OP_LINEAR" in out
+
+
+# -- tools/lint_invariants.py ----------------------------------------------
+
+def test_lint_invariants_repo_is_clean():
+    """The invariant lint (host-sync, metric-help, span-discipline) runs
+    clean over the tree — the same invocation lint CI makes."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_invariants.py"),
+         "flexflow_tpu"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_lint_invariants_rules_fire(tmp_path):
+    """Each of the three rules flags its seeded violation; the scoped
+    host-sync rule stays silent outside kernels/runtime."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_invariants", os.path.join(REPO, "tools", "lint_invariants.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    bad = tmp_path / "probe.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def f(x, REGISTRY, tracer):\n"
+        "    v = x.item()\n"
+        "    a = np.asarray(x)\n"
+        "    REGISTRY.counter('ff_x_total').inc()\n"
+        "    s = tracer.span('oops')\n"
+        "    with tracer.span('fine'):\n"
+        "        pass\n"
+        "    return v, a, s\n")
+    in_scope = {r for r, *_ in lint.lint_file(
+        bad, "flexflow_tpu/runtime/probe.py")}
+    assert in_scope == {"host-sync", "metric-help", "span-discipline"}
+    out_of_scope = {r for r, *_ in lint.lint_file(
+        bad, "flexflow_tpu/search/probe.py")}
+    assert out_of_scope == {"metric-help", "span-discipline"}
