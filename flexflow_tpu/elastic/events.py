@@ -56,6 +56,13 @@ DRIFT_SEARCH = "drift.search"
 # background pre-planning (search/plan_cache.py BackgroundPlanner): a
 # plan for an ANTICIPATED topology was computed off the critical path
 PLAN_PRECOMPUTE = "plan.precompute"
+# serving-fleet failure domain (serving/fleet/{chaos,health,router}.py):
+# injected faults, health-state transitions, and in-flight failover
+FLEET_FAULT = "fleet.fault"
+FLEET_SUSPECT = "fleet.suspect"
+FLEET_DEAD = "fleet.dead"
+FLEET_FAILOVER = "fleet.failover"
+FLEET_RESPAWN = "fleet.respawn"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -6,18 +6,29 @@ AdmissionController, per-request metrics — and this package composes
 them into the millions-of-users serving tier (docs/serving.md "Fleet"):
 
  - `Replica` (replica.py): one model behind its own batcher + private
-   MetricsRegistry + lifecycle state (READY/DRAINING/STOPPED).
+   MetricsRegistry + lifecycle state (READY/DRAINING/STOPPED/DEAD).
  - `Router` (router.py): prefix-cache-AFFINE routing — the PrefixCache's
    rolling page-block hashes (`prefix_route_key`) are the routing key,
    so a request lands on the replica that already owns its shared
    prefix, falling back to sticky-key then least-loaded when cold — with
    fleet-wide SLO admission that sheds by PREDICTED TTFT
-   (`SLOExceeded`, same typed-429 contract as queue/pool rejections) and
-   drain-with-handoff replica removal.
+   (`SLOExceeded`, same typed-429 contract as queue/pool rejections),
+   drain-with-handoff replica removal, and token-EXACT in-flight
+   failover off DEAD replicas (`fail_over`: fence + replay
+   prompt ‖ emitted-tokens on a survivor).
  - `Autoscaler` (autoscaler.py): watches queue depth, page utilization,
    and registry-read p99 TTFT, grows/shrinks individual replica meshes
-   via `request_resize` (zero drops, token-identical) and adds/drains
-   whole replicas under sustained load swings.
+   via `request_resize` (zero drops, token-identical), adds/drains
+   whole replicas under sustained load swings, and RESPAWNS replicas
+   the monitor declared dead.
+ - `HealthMonitor` (health.py, ISSUE 18): heartbeat + EWMA straggler
+   probes scoring each replica READY → SUSPECT → DEAD
+   (`ff_fleet_health_state`), with the DEAD verdict driving
+   `Router.fail_over`.
+ - `ChaosEngine` / `FleetFaultPlan` (chaos.py, ISSUE 18): seeded,
+   deterministic replica fault injection (crash-at-token-N / hang /
+   straggle / flaky-submit) behind `serve-bench --workload chaos`, so
+   the failover path is exercised by CI instead of trusted.
 
 The fleet's merged observability — one /metrics with a `replica` label,
 one aggregated /healthz — is `obs.render_merged` over
@@ -25,8 +36,13 @@ one aggregated /healthz — is `obs.render_merged` over
 both when a fleet is registered.
 """
 from .autoscaler import Autoscaler
+from .chaos import (FAULT_KINDS, ChaosEngine, FleetFault, FleetFaultPlan,
+                    InjectedCrash)
+from .health import HealthMonitor, HealthState, ReplicaLost
 from .replica import Replica, ReplicaState
 from .router import FleetRequest, FleetUnavailable, Router
 
-__all__ = ["Autoscaler", "FleetRequest", "FleetUnavailable", "Replica",
-           "ReplicaState", "Router"]
+__all__ = ["Autoscaler", "ChaosEngine", "FAULT_KINDS", "FleetFault",
+           "FleetFaultPlan", "FleetRequest", "FleetUnavailable",
+           "HealthMonitor", "HealthState", "InjectedCrash", "Replica",
+           "ReplicaLost", "ReplicaState", "Router"]

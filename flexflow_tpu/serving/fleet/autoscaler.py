@@ -14,7 +14,14 @@ Beyond per-replica mesh resizes it can change fleet MEMBERSHIP: with a
 `replica_factory`, sustained overload at max_slots adds a replica
 (`Router.add_replica`); sustained fleet-wide idleness drains the
 emptiest surplus replica through the router's handoff protocol and
-removes it once empty.
+removes it once empty. The same factory RESPAWNS replicas the
+HealthMonitor declared DEAD (`Router.lost_replicas`): each tick builds
+a fresh replacement under the dead replica's name, clears the lost
+marker (health() returns to "ok", the degraded SLO tightening lifts),
+and resets the replacement's health verdict and straggler baseline —
+FailureDetector.reset_latency semantics, applied equally after a mesh
+resize resolves, so recompile-slow first iterations never re-flag a
+recovered replica.
 
 `tick()` is the whole control loop, deliberately synchronous and
 re-entrant-free so tests and serve-bench drive it deterministically;
@@ -29,6 +36,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
+from ...elastic import events as ev
 from ...obs.tracing import get_tracer
 from .replica import ReplicaState
 from .router import Router
@@ -45,7 +53,8 @@ class Autoscaler:
                  idle_ticks_before_shrink: int = 2,
                  idle_ticks_before_drain: int = 3,
                  ttft_window_ticks: int = 20,
-                 preplanner=None, preplan_fn: Optional[Callable] = None):
+                 preplanner=None, preplan_fn: Optional[Callable] = None,
+                 monitor=None):
         if not 1 <= int(min_slots) <= int(max_slots):
             raise ValueError(
                 f"need 1 <= min_slots ({min_slots}) <= max_slots"
@@ -84,6 +93,10 @@ class Autoscaler:
         self.preplanner = preplanner
         self.preplan_fn = preplan_fn
         self._preplanned = False
+        # HealthMonitor (fleet/health.py), when the fleet runs one:
+        # respawns and applied resizes reset the replica's health
+        # verdict + straggler baseline through it
+        self.monitor = monitor
         self._ttft_snaps: Dict[str, Deque] = {}
         self._replica_idle: Dict[str, int] = {}
         self.log: List[Dict] = []
@@ -149,6 +162,19 @@ class Autoscaler:
                         applied["replica"] = name
                         applied["action"] = "resize_applied"
                         self.log.append(applied)
+                        # reset the straggler baseline: the resized mesh
+                        # recompiles its dispatches, and those slow first
+                        # iterations must not flag a healthy replica
+                        # (FailureDetector.reset_latency semantics)
+                        self._reset_health(name)
+            # respawn replicas the HealthMonitor declared DEAD: a fresh
+            # replacement under the SAME name, so affinity re-learns it
+            # and health() walks back from degraded to ok
+            if self.replica_factory is not None:
+                for name, reason in self.router.lost_replicas().items():
+                    act = self._respawn(name, reason, tracer)
+                    if act:
+                        actions.append(act)
             ready = [(n, r) for n, r in
                      ((n, self.router.replica(n))
                       for n in self.router.replica_names())
@@ -227,6 +253,34 @@ class Autoscaler:
         self._c_actions.inc(action=direction)
         return {"action": direction, "replica": name,
                 "from": rep.num_slots(), "to": target,
+                "t": time.monotonic()}
+
+    def _reset_health(self, name: str) -> None:
+        """Forget a replica's health verdict + step-latency EWMA after a
+        respawn or an applied resize — through the monitor when one is
+        wired, straight at the replica otherwise."""
+        if self.monitor is not None:
+            self.monitor.reset(name)
+            return
+        try:
+            rep = self.router.replica(name)
+        except KeyError:
+            return
+        rep.reset_latency()
+
+    def _respawn(self, name: str, reason: str, tracer) -> Optional[Dict]:
+        with tracer.span("fleet.autoscale", action="respawn",
+                         replica=name):
+            rep = self.router.add_replica(name, self.replica_factory)
+        if rep is None:
+            return None  # factory failed; router recorded it, retry next
+        self.router.clear_lost(name)
+        self._reset_health(name)
+        if self.router.events is not None:
+            self.router.events.record(ev.FLEET_RESPAWN, replica=name,
+                                      reason=reason)
+        self._c_actions.inc(action="respawn")
+        return {"action": "respawn", "replica": name, "reason": reason,
                 "t": time.monotonic()}
 
     def _add_replica(self, tracer) -> Optional[Dict]:

@@ -282,6 +282,304 @@ def _render_fleet(router) -> Dict:
             "replica_labeled_families": labeled}
 
 
+def run_fleet_chaos(model, workload, *, n_replicas: int, slots: int,
+                    page_size: int, max_len: int, prefix_cache_pages: int,
+                    deadline_s: float, crash_after_tokens: int,
+                    suspect_after_s: float, dead_after_s: float,
+                    probe_interval_s: float) -> Dict:
+    """The failure-domain drill (ISSUE 18): crash a loaded replica
+    mid-decode under a live HealthMonitor + Autoscaler and prove the
+    blast radius is a TTFT blip, not an outage.
+
+    Timeline: warm + leaders complete FIRST (compile stalls look exactly
+    like hangs — monitors must never be armed across a cold dispatch),
+    then the monitor, autoscaler (respawn factory wired), and a scripted
+    ChaosEngine go live, then every follower bursts at once and the
+    victim's scheduler raises InjectedCrash `crash_after_tokens`
+    generated tokens later. The main thread watches the milestones —
+    fault fired, DEAD verdict, eviction, same-name respawn — while the
+    failover replays the victim's in-flight requests on survivors.
+    Token parity vs the fault-free run is asserted by the caller."""
+    from .autoscaler import Autoscaler
+    from .chaos import ChaosEngine, FleetFaultPlan
+    from .health import HealthMonitor
+    from .replica import Replica
+    from ...elastic.events import EventLog
+
+    router = _build_fleet(model, n_replicas, "affine", slots, page_size,
+                          max_len, prefix_cache_pages, None,
+                          max_queue=max(len(workload), 16))
+    elog = EventLog()
+    router.events = elog
+    mon = HealthMonitor(router, suspect_after_s=suspect_after_s,
+                        dead_after_s=dead_after_s, event_log=elog)
+
+    def factory():
+        return Replica("respawn", model, max_len=max_len, num_slots=slots,
+                       page_size=page_size,
+                       prefix_cache_pages=prefix_cache_pages,
+                       max_queue=max(len(workload), 16))
+
+    asc = Autoscaler(router, min_slots=slots, max_slots=slots,
+                     replica_factory=factory, max_replicas=n_replicas,
+                     min_replicas=n_replicas,
+                     idle_ticks_before_drain=10**9, monitor=mon)
+    leaders = [(i, w) for i, w in enumerate(workload) if w["leader"]]
+    followers = [(i, w) for i, w in enumerate(workload) if not w["leader"]]
+    handles: List = [None] * len(workload)
+    shed: Dict[str, int] = {}
+    milestones: Dict[str, Optional[float]] = {
+        "fault": None, "dead": None, "evicted": None, "respawned": None}
+    engine = None
+    victim = router.replica_names()[0]
+    try:
+        _warm(router, max_len, page_size)
+        t0 = time.monotonic()
+        for i, w in leaders:
+            handles[i] = _submit_retry(router, w, deadline_s, t0, shed)
+        for i, _ in leaders:
+            handles[i].result(timeout=600.0)
+        # victim: the replica homing the most leaders — guaranteed loaded
+        # when the crash fires (affinity sends its tenants' followers back)
+        homes = [h.replica for i, _ in leaders for h in [handles[i]]]
+        victim = max(router.replica_names(),
+                     key=lambda n: homes.count(n))
+        survivor = next(n for n in router.replica_names() if n != victim)
+        at = router.replica(victim).batcher.tokens_emitted \
+            + crash_after_tokens
+        plan = FleetFaultPlan().crash(victim, at_token=at) \
+            .flaky_submit(survivor, submits=2)
+        engine = ChaosEngine(plan, registry=router.registry,
+                             event_log=elog)
+        engine.arm(router)
+        mon.start(interval_s=probe_interval_s)
+        asc.start(interval_s=probe_interval_s)
+        for i, w in followers:
+            handles[i] = _submit_retry(router, w, deadline_s, t0, shed)
+        # watch the drill from the main thread: fault -> DEAD verdict ->
+        # eviction -> same-name respawn, while results stream in
+        watch_deadline = time.monotonic() + deadline_s
+        while time.monotonic() < watch_deadline:
+            if milestones["fault"] is None:
+                crash = [f for f in engine.fired if f["kind"] == "crash"]
+                if crash:
+                    milestones["fault"] = crash[0]["t"]
+            if milestones["dead"] is None \
+                    and mon.states().get(victim) == "dead":
+                milestones["dead"] = time.monotonic()
+            names = router.replica_names()
+            if milestones["evicted"] is None \
+                    and milestones["dead"] is not None \
+                    and (victim not in names
+                         or victim in router.lost_replicas()):
+                milestones["evicted"] = time.monotonic()
+            if milestones["respawned"] is None \
+                    and milestones["evicted"] is not None \
+                    and victim in names \
+                    and victim not in router.lost_replicas():
+                milestones["respawned"] = time.monotonic()
+            if milestones["respawned"] is not None \
+                    and all(h.done() for h in handles):
+                break
+            time.sleep(0.02)
+        for h in handles:
+            try:
+                h.result(timeout=600.0)
+            except Exception:
+                pass  # surfaces in _collect as dropped
+        wall = time.monotonic() - t0
+        mon.stop()
+        asc.stop()
+        out = _collect(handles, workload, deadline_s, wall, n_replicas,
+                       shed)
+        detect_s = (milestones["dead"] - milestones["fault"]
+                    if milestones["dead"] and milestones["fault"]
+                    else None)
+        recover_s = (milestones["respawned"] - milestones["fault"]
+                     if milestones["respawned"] and milestones["fault"]
+                     else None)
+        out.update({
+            "policy": "affine+chaos",
+            "victim": victim,
+            "fault_plan": plan.describe(),
+            "faults_fired": list(engine.fired),
+            "failovers": sum(h.failovers for h in handles),
+            "failed_over_requests": sum(
+                1 for h in handles if h.failovers > 0),
+            "detect_s": round(detect_s, 3) if detect_s is not None
+            else None,
+            "recover_s": round(recover_s, 3) if recover_s is not None
+            else None,
+            "health_after": router.health()["status"],
+            "monitor_states": mon.states(),
+            "fleet_events": [e.kind for e in elog.tail(50)]
+            if hasattr(elog, "tail") else [],
+            "token_lists": [[int(t) for t in h.tokens] for h in handles],
+            "exposition": _render_fleet(router),
+        })
+        return out
+    finally:
+        if engine is not None:
+            engine.disarm()
+        mon.stop()
+        asc.stop()
+        router.shutdown()
+
+
+def run_chaos_cli(args) -> int:
+    """The `serve-bench --workload chaos` entry (dispatched from
+    serving/sched/bench.py): fault-free affine reference first (token
+    parity + baseline p99 TTFT), then the chaos drill against the same
+    request list."""
+    import json
+
+    from .chaos import FleetFaultPlan
+    from ..sched.bench import build_tiny_lm, make_shared_prefix_workload
+
+    n_rep = args.replicas
+    if n_rep < 2:
+        print("[serve-bench] FAIL: chaos needs --replicas >= 2 — the"
+              " failover replays in-flight work on survivors")
+        return 1
+    window = args.prefix_len + args.suffix_max
+    max_len = window + args.out_max
+    print(f"[serve-bench] chaos: {args.requests} sessions over"
+          f" {args.prefix_groups} tenants x {n_rep} replicas of"
+          f" {args.slots} slots | crash victim after"
+          f" +{args.chaos_crash_after} tokens, heartbeat windows"
+          f" {args.chaos_suspect}s/{args.chaos_dead}s")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    workload = make_shared_prefix_workload(
+        args.requests, args.prefix_groups, args.prefix_len,
+        args.suffix_min, args.suffix_max, args.out_min, args.out_max,
+        args.vocab, args.seed)
+    import math
+
+    pages = 2 + args.prefix_groups * math.ceil(
+        (args.prefix_len + args.suffix_max) / args.page_size)
+    common = dict(n_replicas=n_rep, slots=args.slots,
+                  page_size=args.page_size, max_len=max_len,
+                  prefix_cache_pages=pages, deadline_s=args.deadline)
+
+    # the determinism contract the seeded plans pin: same seed, same
+    # schedule — byte-identical describe()
+    names = [f"r{i}" for i in range(n_rep)]
+    determinism_ok = (
+        FleetFaultPlan.randomized(args.chaos_seed, names).describe()
+        == FleetFaultPlan.randomized(args.chaos_seed, names).describe())
+
+    ref = run_fleet_static(model, workload, policy="affine",
+                           slo_ttft_s=None, **common)
+    chaos = run_fleet_chaos(
+        model, workload, crash_after_tokens=args.chaos_crash_after,
+        suspect_after_s=args.chaos_suspect, dead_after_s=args.chaos_dead,
+        probe_interval_s=args.chaos_interval, **common)
+
+    def line(tag: str, r: Dict) -> None:
+        print(f"[serve-bench] {tag:12s} {r['tokens']} tokens in"
+              f" {r['wall_s']}s = {r['tokens_per_s']} tok/s |"
+              f" ttft p99 {r['ttft_ms_p99']} ms |"
+              f" dropped={r['dropped']} starved={r['starved']}")
+
+    line("fault-free:", ref)
+    line("chaos:", chaos)
+    print(f"[serve-bench] drill: victim {chaos['victim']!r} |"
+          f" faults {[f['kind'] for f in chaos['faults_fired']]} |"
+          f" dead detected in {chaos['detect_s']}s, respawned in"
+          f" {chaos['recover_s']}s | {chaos['failed_over_requests']}"
+          f" requests failed over ({chaos['failovers']} replays) |"
+          f" health after: {chaos['health_after']}")
+
+    failures: List[str] = []
+    if ref["dropped"] or ref["starved"]:
+        failures.append(
+            f"fault-free reference unhealthy: {ref['dropped']} dropped,"
+            f" {ref['starved']} starved")
+    if chaos["dropped"]:
+        failures.append(
+            f"{chaos['dropped']} requests dropped/short across the"
+            " replica crash — failover must lose nothing")
+    if chaos["starved"]:
+        failures.append(f"{chaos['starved']} requests starved past"
+                        f" {args.deadline}s")
+    parity_bad = sum(1 for a, b in zip(chaos["token_lists"],
+                                       ref["token_lists"]) if a != b)
+    if parity_bad:
+        failures.append(
+            f"{parity_bad} requests' greedy tokens changed across the"
+            " mid-decode failover (vs the fault-free run)")
+    crash_fired = any(f["kind"] == "crash" for f in chaos["faults_fired"])
+    if not crash_fired:
+        failures.append("the scripted crash never fired — the drill"
+                        " tested nothing")
+    if chaos["detect_s"] is None:
+        failures.append(
+            f"victim {chaos['victim']!r} was never declared DEAD")
+    elif chaos["detect_s"] > args.chaos_dead:
+        failures.append(
+            f"DEAD verdict took {chaos['detect_s']}s — outside the"
+            f" {args.chaos_dead}s heartbeat window")
+    if chaos["failed_over_requests"] < 1:
+        failures.append(
+            "no in-flight request was failed over — the crash missed"
+            " the loaded window (raise --requests or lower"
+            " --chaos-crash-after)")
+    if chaos["recover_s"] is None:
+        failures.append(
+            f"victim {chaos['victim']!r} was never respawned")
+    if chaos["health_after"] != "ok":
+        failures.append(
+            f"fleet health is {chaos['health_after']!r} after the"
+            " respawn — expected 'ok'")
+    if not determinism_ok:
+        failures.append(
+            "FleetFaultPlan.randomized is not deterministic by seed")
+    fams = chaos["exposition"]["replica_labeled_families"]
+    for required in ("ff_serving_ttft_ms", "ff_kvpool_pages_used"):
+        if required not in fams:
+            failures.append(
+                f"chaos: {required} missing a replica-labeled series in"
+                " the merged exposition")
+
+    blip = (chaos["ttft_ms_p99"] / ref["ttft_ms_p99"]
+            if ref["ttft_ms_p99"] > 0 else 0.0)
+    print(f"[serve-bench] ttft blip: chaos p99 / fault-free p99 ="
+          f" {blip:.2f}x ({chaos['ttft_ms_p99']} /"
+          f" {ref['ttft_ms_p99']} ms)")
+
+    report = {
+        "bench": "serving_fleet_chaos",
+        "config": vars(args),
+        "chips": n_rep,
+        "fault_free": {k: v for k, v in ref.items()
+                       if k != "token_lists"},
+        "chaos": {k: v for k, v in chaos.items() if k != "token_lists"},
+        "parity_mismatches_vs_fault_free": parity_bad,
+        "plan_determinism_ok": determinism_ok,
+        # THE pinned numbers: how big the blast radius of one replica
+        # death is, and how fast the fleet closes it
+        "pinned": {
+            "ttft_blip_x": round(blip, 3),
+            "ttft_ms_p99_under_failover": chaos["ttft_ms_p99"],
+            "dead_detect_s": chaos["detect_s"],
+            "respawn_recover_s": chaos["recover_s"],
+            "failed_over_requests": chaos["failed_over_requests"],
+        },
+    }
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"[serve-bench] report -> {args.report}")
+    if failures:
+        for f in failures:
+            print(f"[serve-bench] FAIL: {f}")
+        return 1
+    print("[serve-bench] OK")
+    return 0
+
+
 def run_fleet_cli(args) -> int:
     """The `serve-bench --workload fleet` entry (dispatched from
     serving/sched/bench.py)."""

@@ -34,6 +34,10 @@ class ReplicaState(enum.Enum):
     READY = "ready"
     DRAINING = "draining"
     STOPPED = "stopped"
+    # declared dead by the HealthMonitor (crashed scheduler, hung
+    # heartbeat): evicted from routing, in-flight work replayed on
+    # survivors (router.fail_over), batcher aborted without a join
+    DEAD = "dead"
 
 
 class Replica:
@@ -77,6 +81,21 @@ class Replica:
             self._state = ReplicaState.STOPPED
         self.batcher.stop()
 
+    def mark_dead(self) -> None:
+        """Record the monitor's DEAD verdict (terminal: a dead replica
+        never takes traffic again — the autoscaler respawns a FRESH one
+        from the factory)."""
+        with self._lock:
+            self._state = ReplicaState.DEAD
+
+    def kill(self, err: BaseException) -> None:
+        """DEAD + non-blocking batcher abort: every in-flight request is
+        fenced with `err` (its emitted-token snapshot frozen for the
+        router's token-exact replay) and the scheduler thread — possibly
+        hung — is left to exit on its own (ContinuousBatcher.abort)."""
+        self.mark_dead()
+        self.batcher.abort(err)
+
     # -- traffic (router-facing) -------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
                seed: int = 0):
@@ -118,6 +137,22 @@ class Replica:
         return (queue * 1000.0
                 + (active / max(1, pool.num_slots)) * 10.0
                 + pool.utilization())
+
+    # -- health signals (fleet/health.py HealthMonitor) --------------------
+    def scheduler_alive(self) -> bool:
+        return self.batcher.scheduler_alive()
+
+    def heartbeat_age_s(self):
+        return self.batcher.heartbeat_age_s()
+
+    def step_latency_s(self):
+        return self.batcher.step_latency_s()
+
+    def reset_latency(self) -> None:
+        """Forget the step-latency EWMA baseline after a respawn/resize
+        (FailureDetector.reset_latency semantics) so recompile-slow
+        first iterations don't re-flag a recovered replica."""
+        self.batcher.reset_latency()
 
     def live_sequences(self) -> int:
         return self.batcher.pool.live_sequences()

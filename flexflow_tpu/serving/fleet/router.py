@@ -32,6 +32,22 @@ reached a slot wins, so a request is never in zero places. The caller's
 `FleetRequest` handle rebinds transparently (greedy/seeded decode is a
 pure function of (prompt, seed), never of the replica that runs it, so a
 handoff is token-invisible).
+
+Failover (`fail_over(name)`) is the ABRUPT-death version of drain,
+driven by the HealthMonitor's DEAD verdict: the replica is evicted, its
+batcher aborted (every in-flight request FENCED — the emitted-token
+snapshot frozen against a hung-then-resumed scheduler thread), and each
+unfinished request is re-dispatched to a survivor by replaying
+prompt ‖ already-emitted-tokens as a forced prefix. The replay is
+token-EXACT, not merely token-plausible: greedy decode is argmax over
+the same prefix, and sampled decode draws fold_in(PRNGKey(seed), pos)
+keys at ABSOLUTE cache positions — the replayed request reaches any
+position with the identical prefix and identical key, so its
+continuation tokens equal the fault-free run's. Chunked prefill plus the
+prefix-page band make the replay cheap (the prompt's shared pages are
+usually resident on the survivor). Re-dispatch runs under a per-request
+retry budget with exponential backoff and a deadline; exhaustion
+surfaces a typed `ReplicaLost` to the caller instead of a hang.
 """
 from __future__ import annotations
 
@@ -43,12 +59,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...elastic import events as ev
 from ...obs.registry import MetricsRegistry
 from ...obs.tracing import get_tracer
 from ..sched.admission import (AdmissionError, PoolSaturated, QueueFull,
                                SLOExceeded)
 from ..sched.continuous import RequestCancelled
 from ..sched.kvpool import prefix_route_chain
+from .health import ReplicaLost
 from .replica import Replica, ReplicaState
 
 _HANDOFF_REBIND_TIMEOUT_S = 10.0
@@ -67,10 +85,13 @@ class FleetUnavailable(AdmissionError):
 
 class FleetRequest:
     """The caller's handle for one routed request: a GenRequest proxy
-    that survives drain handoff. Handoff only ever happens while the
-    inner request is still QUEUED (zero tokens emitted), so a rebind
-    restarts the stream cleanly and greedy tokens are identical on the
-    new replica."""
+    that survives drain handoff AND failover. A drain handoff only ever
+    happens while the inner request is still QUEUED (zero tokens
+    emitted), so a plain rebind restarts the stream cleanly. A FAILOVER
+    can land mid-decode: the tokens the dead incarnation already emitted
+    become `_base` (the replayed prefix), the new inner produces only
+    the continuation, and `stream()`/`result()` stitch the two so the
+    caller sees one uninterrupted, token-exact sequence."""
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int, eos_id,
                  seed: int):
@@ -81,10 +102,21 @@ class FleetRequest:
         self.t_submit = time.monotonic()
         self.route = ""          # routing decision label (affine/...)
         self.handoffs = 0
+        self.failovers = 0
         self._cv = threading.Condition()
         self._inner = None
         self._replica: Optional[str] = None
         self._version = 0
+        # failover state: tokens/timestamps from DEAD incarnations (the
+        # replayed prefix), the first-token time captured at the fence
+        # (TTFT stays honest across a rebind), the terminal error when
+        # the retry budget is exhausted, and the finalized flag for
+        # requests whose budget/EOS completed at fence time
+        self._base: List[int] = []
+        self._base_times: List[float] = []
+        self._t_first: Optional[float] = None
+        self._lost: Optional[BaseException] = None
+        self._final = False
 
     # -- router side -------------------------------------------------------
     def _bind(self, replica_name: str, inner) -> None:
@@ -96,14 +128,74 @@ class FleetRequest:
             self._version += 1
             self._cv.notify_all()
 
+    def _rebind(self, replica_name: str, inner, base: List[int],
+                base_times: List[float],
+                t_first: Optional[float]) -> None:
+        """Failover bind: `inner` is the survivor's replay request,
+        `base` the full token prefix already emitted by dead
+        incarnations (which the replay carried in its prompt)."""
+        with self._cv:
+            self.failovers += 1
+            self._base = list(base)
+            self._base_times = list(base_times)
+            if self._t_first is None:
+                self._t_first = t_first
+            self._inner = inner
+            self._replica = replica_name
+            self._version += 1
+            self._cv.notify_all()
+
+    def _finalize(self, base: List[int], base_times: List[float],
+                  t_first: Optional[float]) -> None:
+        """The fence snapshot already completed the request (budget hit
+        or EOS emitted just before the crash): finish it locally, no
+        replay needed."""
+        with self._cv:
+            self._base = list(base)
+            self._base_times = list(base_times)
+            if self._t_first is None:
+                self._t_first = t_first
+            self._final = True
+            self._inner = None
+            self._version += 1
+            self._cv.notify_all()
+
+    def _terminate(self, err: BaseException) -> None:
+        """Failover gave up (retry budget/deadline exhausted, or no
+        survivor): the request is lost and consumers get the typed
+        error instead of hanging."""
+        with self._cv:
+            self._lost = err
+            self._cv.notify_all()
+
     def _snapshot(self):
         with self._cv:
             return self._inner, self._version
 
-    def _await_rebind(self, version: int) -> bool:
+    def _state(self):
         with self._cv:
-            return self._cv.wait_for(lambda: self._version != version,
-                                     timeout=_HANDOFF_REBIND_TIMEOUT_S)
+            return (self._inner, self._version, list(self._base),
+                    self._final, self._lost)
+
+    def _await_rebind(self, version: int) -> bool:
+        """Wait for a rebind/finalize after a cancel/loss error; False
+        when none arrives (timeout or terminal loss) — the caller then
+        raises a typed error instead of spinning."""
+        with self._cv:
+            self._cv.wait_for(lambda: self._version != version
+                              or self._lost is not None,
+                              timeout=_HANDOFF_REBIND_TIMEOUT_S)
+            return self._version != version
+
+    def _no_rebind_error(self, cause: BaseException) -> BaseException:
+        with self._cv:
+            if self._lost is not None:
+                return self._lost
+        if isinstance(cause, ReplicaLost):
+            return cause
+        return ReplicaLost(
+            f"replica {self._replica!r} lost this request and no rebind"
+            f" arrived within {_HANDOFF_REBIND_TIMEOUT_S}s")
 
     # -- consumer API (GenRequest contract) --------------------------------
     @property
@@ -114,85 +206,134 @@ class FleetRequest:
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            inner, version = self._snapshot()
+            inner, version, base, final, lost = self._state()
+            if final:
+                return np.asarray(base, np.int32)
+            if lost is not None:
+                raise lost
             left = None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
             try:
-                return inner.result(timeout=left)
-            except RequestCancelled:
-                # a drain handoff cancelled the queued inner: wait for
-                # the rebind and retry on the new replica's handle
+                out = np.asarray(inner.result(timeout=left), np.int32)
+                if base:
+                    out = np.concatenate(
+                        [np.asarray(base, np.int32), out])
+                return out
+            except (RequestCancelled, ReplicaLost) as e:
+                # a drain handoff cancelled the queued inner, or its
+                # replica died: wait for the rebind (or the finalize)
+                # and retry on the new incarnation
                 if not self._await_rebind(version):
-                    raise
+                    raise self._no_rebind_error(e) from e
 
     def stream(self, timeout: Optional[float] = None):
+        sent = 0  # tokens yielded so far, across all incarnations
         while True:
-            inner, version = self._snapshot()
-            try:
-                yield from inner.stream(timeout=timeout)
+            inner, version, base, final, lost = self._state()
+            # catch up on replayed-prefix tokens the dead incarnation
+            # emitted but this consumer had not yet received (the
+            # fence's FIFO guarantee: everything emitted precedes the
+            # error in the old stream, so `sent` never exceeds the base)
+            while sent < len(base):
+                yield base[sent]
+                sent += 1
+            if final:
                 return
-            except RequestCancelled:
+            if lost is not None:
+                raise lost
+            try:
+                for tok in inner.stream(timeout=timeout):
+                    sent += 1
+                    yield tok
+                return
+            except (RequestCancelled, ReplicaLost) as e:
                 if not self._await_rebind(version):
-                    raise
-                # rebound: no token was emitted pre-handoff, restart
+                    raise self._no_rebind_error(e) from e
+                # rebound: loop re-snapshots and resumes at `sent`
 
     def done(self) -> bool:
-        inner, _ = self._snapshot()
+        inner, _, _, final, lost = self._state()
+        if final or lost is not None:
+            return True
+        if inner is None:
+            return False
+        err = inner.error
+        if isinstance(err, (RequestCancelled, ReplicaLost)):
+            # fenced/cancelled but pending rebind — result() would
+            # block for the new incarnation, so the request is NOT done
+            return False
         return inner.done()
 
     @property
     def id(self):
         inner, _ = self._snapshot()
-        return inner.id
+        return None if inner is None else inner.id
 
     @property
     def tokens(self) -> List[int]:
-        inner, _ = self._snapshot()
-        return inner.tokens
+        inner, _, base, _, _ = self._state()
+        if inner is None:
+            return base
+        return base + inner.tokens
 
     @property
     def error(self):
-        inner, _ = self._snapshot()
-        return inner.error
+        inner, _, _, final, lost = self._state()
+        if lost is not None:
+            return lost
+        if final or inner is None:
+            return None
+        err = inner.error
+        if isinstance(err, (RequestCancelled, ReplicaLost)):
+            return None  # pending rebind, not a terminal failure
+        return err
 
     @property
     def token_times(self) -> List[float]:
-        inner, _ = self._snapshot()
-        return inner.token_times
+        with self._cv:
+            inner, times = self._inner, list(self._base_times)
+        if inner is None:
+            return times
+        return times + inner.token_times
 
     @property
     def cache_hit(self) -> bool:
         inner, _ = self._snapshot()
-        return inner.cache_hit
+        return False if inner is None else inner.cache_hit
 
     @property
     def prefix_tokens(self) -> int:
         inner, _ = self._snapshot()
-        return inner.prefix_tokens
+        return 0 if inner is None else inner.prefix_tokens
 
     @property
     def queue_wait_s(self):
         inner, _ = self._snapshot()
-        return inner.queue_wait_s
+        return None if inner is None else inner.queue_wait_s
 
     @property
     def t_done(self):
         inner, _ = self._snapshot()
-        return inner.t_done
+        return None if inner is None else inner.t_done
 
     @property
     def t_first_token(self):
-        inner, _ = self._snapshot()
-        return inner.t_first_token
+        with self._cv:
+            if self._t_first is not None:
+                return self._t_first
+            inner = self._inner
+        return None if inner is None else inner.t_first_token
 
     @property
     def ttft_s(self) -> Optional[float]:
         """Submit-to-first-token measured from the ROUTER's submit time:
-        a handoff's re-queue wait stays inside the number."""
-        inner, _ = self._snapshot()
-        if inner.t_first_token is None:
+        a handoff's re-queue wait stays inside the number, and a
+        failover keeps the DEAD incarnation's first-token time (the
+        caller saw that token — the blip lands in ITL, not TTFT)."""
+        t = self.t_first_token
+        if t is None:
             return None
-        return inner.t_first_token - self.t_submit
+        return t - self.t_submit
 
 
 class Router:
@@ -211,7 +352,9 @@ class Router:
                  slo_ttft_s: Optional[float] = None, route_depth: int = 1,
                  registry: Optional[MetricsRegistry] = None,
                  on_load_failure: Optional[Callable] = None,
-                 max_affinity_keys: int = 65536):
+                 max_affinity_keys: int = 65536,
+                 degraded_slo_factor: float = 0.5,
+                 event_log: Optional[ev.EventLog] = None):
         if policy not in self.POLICIES:
             raise ValueError(
                 f"policy={policy!r}: choose from {self.POLICIES}")
@@ -221,7 +364,16 @@ class Router:
             raise ValueError(f"route_depth={route_depth}: need >= 1")
         self.route_depth = int(route_depth)
         self.max_affinity_keys = max(1, int(max_affinity_keys))
+        # graceful degradation (fail_over): while lost capacity is not
+        # yet respawned, the SLO budget is MULTIPLIED by this (<1 =
+        # tighter) — the shrunken fleet sheds excess demand at the door
+        # instead of queueing everyone past their deadline
+        if not 0.0 < float(degraded_slo_factor) <= 1.0:
+            raise ValueError(
+                f"degraded_slo_factor={degraded_slo_factor}: need (0, 1]")
+        self.degraded_slo_factor = float(degraded_slo_factor)
         self.registry = MetricsRegistry() if registry is None else registry
+        self.events = event_log
         # called with (name, exception) when a replica factory fails —
         # server.py wires this to record_load_failure so fleet load
         # failures extend ff_model_load_failures_total and /healthz
@@ -229,6 +381,10 @@ class Router:
         self._lock = threading.RLock()
         self._replicas: Dict[str, Replica] = {}
         self._failed_loads: Dict[str, str] = {}
+        # replicas declared DEAD and evicted, not yet respawned: the
+        # autoscaler reads this to respawn from its factory, health()
+        # reports degraded while it is non-empty
+        self._lost_replicas: Dict[str, str] = {}
         # route key -> replica name, LRU-bounded at max_affinity_keys
         # (lifetime-unique tenants must not grow router memory without
         # bound); _homes mirrors it as a per-replica key count so the
@@ -252,6 +408,18 @@ class Router:
         self._c_handoffs = self.registry.counter(
             "ff_fleet_handoffs_total",
             "Queued requests re-homed off a draining replica")
+        self._c_failover_requests = self.registry.counter(
+            "ff_fleet_failover_requests_total",
+            "In-flight requests processed by fail_over, by outcome"
+            " (replayed/finalized/finished/lost)", labels=("outcome",))
+        self._c_failover_retries = self.registry.counter(
+            "ff_fleet_failover_retries_total",
+            "Failover re-dispatch attempts that hit an admission"
+            " rejection and backed off")
+        self._c_failovers = self.registry.counter(
+            "ff_fleet_failover_total",
+            "Replica failovers executed, by eviction reason",
+            labels=("reason",))
         self._g_replicas = self.registry.gauge(
             "ff_fleet_replicas", "Replicas by lifecycle state",
             labels=("state",))
@@ -324,6 +492,9 @@ class Router:
             for r in self._replicas.values():
                 counts[r.state.value] += 1
             counts["failed_load"] = len(self._failed_loads)
+            # DEAD replicas are evicted from _replicas immediately; the
+            # gauge shows the ones whose capacity is still missing
+            counts[ReplicaState.DEAD.value] += len(self._lost_replicas)
         for state, n in counts.items():
             self._g_replicas.set(n, state=state)
 
@@ -411,15 +582,22 @@ class Router:
         with tracer.span("fleet.route", decision=decision,
                          candidates=len(order)):
             # SLO gate: drop candidates predicting over budget; if that
-            # empties the list, shed with the fleet-wide minimum
-            if self.slo_ttft_s is not None:
+            # empties the list, shed with the fleet-wide minimum. While
+            # failed-over capacity is missing the budget TIGHTENS by
+            # degraded_slo_factor: the shrunken fleet sheds excess
+            # demand at the door instead of queueing everyone past
+            # their deadline (graceful degradation, docs/serving.md)
+            slo = self.slo_ttft_s
+            if slo is not None:
+                with self._lock:
+                    if self._lost_replicas:
+                        slo *= self.degraded_slo_factor
                 preds = [r.predicted_ttft_s(prompt.size, shared_tokens=sh)
                          for _, r, sh in order]
-                kept = [c for c, p in zip(order, preds)
-                        if p <= self.slo_ttft_s]
+                kept = [c for c, p in zip(order, preds) if p <= slo]
                 if not kept:
                     self._c_shed.inc(reason=SLOExceeded.reason)
-                    raise SLOExceeded(min(preds), self.slo_ttft_s,
+                    raise SLOExceeded(min(preds), slo,
                                       scope=f"fleet of {len(order)}")
                 order = kept
             last_err: Optional[AdmissionError] = None
@@ -453,7 +631,7 @@ class Router:
         name = fr.replica
         with self._lock:
             rep = self._replicas.get(name)
-        if rep is None:
+        if rep is None or inner is None:
             return False
         return rep.cancel(inner)
 
@@ -465,7 +643,12 @@ class Router:
         already reached a slot wins — the request is never in zero
         places. Active (decoding) requests finish where they are."""
         with self._lock:
-            rep = self._replicas[name]
+            rep = self._replicas.get(name)
+            if rep is None:
+                # already evicted (fail_over raced this drain): its
+                # in-flight work was replayed elsewhere, nothing to
+                # re-home
+                return {"handed_off": 0, "kept": 0}
             rep.mark_draining()
             pending = [f for f in self._outstanding.get(name, ())
                        if not f.done()]
@@ -516,22 +699,173 @@ class Router:
 
     def remove(self, name: str, timeout: Optional[float] = 60.0) -> None:
         """Drain (if not already), wait for the replica to empty, stop
-        it, and forget it. Its registry stops rendering on /metrics."""
+        it, and forget it. Its registry stops rendering on /metrics.
+        The drain-wait exits early when the HealthMonitor declares the
+        replica DEAD mid-drain — its remaining work is failed over to
+        survivors, so spinning until TimeoutError on sequences that
+        will never finish here would be wrong."""
         self.drain(name)
-        rep = self.replica(name)
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            return  # fail_over already evicted it
         deadline = None if timeout is None else time.monotonic() + timeout
         while rep.live_sequences() or rep.queue_depth():
+            if rep.state in (ReplicaState.STOPPED, ReplicaState.DEAD):
+                break  # died mid-drain: fail_over re-homed the work
+            if not rep.scheduler_alive():
+                break  # crashed mid-drain: handled below
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"replica {name!r} not drained within {timeout}s"
                     f" ({rep.live_sequences()} live,"
                     f" {rep.queue_depth()} queued)")
             time.sleep(0.01)
-        rep.stop()
+        if rep.state is ReplicaState.DEAD:
+            # a DEAD batcher was already aborted; stop() would join a
+            # possibly-hung scheduler thread for its full timeout
+            pass
+        elif not rep.scheduler_alive() \
+                and rep.state is not ReplicaState.STOPPED:
+            # the scheduler CRASHED while we drained: live hitting zero
+            # here means its slots were FAILED, not finished — racing
+            # the HealthMonitor to stop+forget the replica would discard
+            # work the failover machinery can still replay token-exactly
+            self.fail_over(name, reason="scheduler_crashed")
+            return
+        else:
+            rep.stop()
         with self._lock:
             self._replicas.pop(name, None)
             self._outstanding.pop(name, None)
         self._c_requests.remove(replica=name)
+        self._sync_replica_gauge()
+
+    # -- failover ----------------------------------------------------------
+    def fail_over(self, name: str, reason: str = "dead",
+                  error: Optional[BaseException] = None,
+                  retry_budget: int = 3, backoff_s: float = 0.05,
+                  deadline_s: float = 30.0) -> Dict[str, int]:
+        """Evict a DEAD replica and re-dispatch its in-flight requests
+        to survivors, token-exactly. The HealthMonitor's default
+        on_dead callback.
+
+        Order matters: (1) under the lock the replica leaves the
+        routing tables (no new traffic can land), (2) `kill` aborts its
+        batcher — FENCING every in-flight GenRequest, which atomically
+        freezes the emitted-token snapshot against a hung-then-resumed
+        scheduler thread, (3) each unfinished request is replayed on a
+        survivor as prompt ‖ emitted-tokens with the remaining budget
+        (same seed: sampled decode folds the key at absolute positions,
+        so the continuation is identical to the fault-free run), under
+        `retry_budget` attempts with exponential `backoff_s` and a
+        `deadline_s` cap; exhaustion terminates the caller's handle
+        with a typed ReplicaLost. Requests the fence caught already
+        complete (budget/EOS) are finalized locally without a replay.
+
+        Returns {"replayed", "finalized", "finished", "lost"} counts."""
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+            if rep is None:
+                return {"replayed": 0, "finalized": 0, "finished": 0,
+                        "lost": 0}
+            pending = [f for f in self._outstanding.pop(name, [])]
+            # affinity entries pointing at the dead replica go stale
+            self._affinity = OrderedDict(
+                (k, v) for k, v in self._affinity.items() if v != name)
+            self._homes.pop(name, None)
+            self._lost_replicas[name] = reason
+        err = error if error is not None else ReplicaLost(
+            f"replica {name!r} declared dead ({reason})")
+        rep.kill(err)
+        self._c_requests.remove(replica=name)
+        self._c_failovers.inc(reason=reason)
+        self._sync_replica_gauge()
+        counts = {"replayed": 0, "finalized": 0, "finished": 0, "lost": 0}
+        tracer = get_tracer()
+        for fr in pending:
+            inner, _ = fr._snapshot()
+            if inner is None or fr.replica != name:
+                continue  # finalized or already re-homed elsewhere
+            snap = inner._fence(err)
+            if snap is None:  # finished cleanly before the death
+                counts["finished"] += 1
+                self._c_failover_requests.inc(outcome="finished")
+                continue
+            toks, times = snap
+            with fr._cv:
+                base = fr._base + toks
+                base_times = fr._base_times + times
+                t_first = fr._t_first
+            if t_first is None:
+                t_first = inner.t_first_token
+            done_by_budget = len(base) >= fr.max_new_tokens
+            done_by_eos = (fr.eos_id is not None and toks
+                           and toks[-1] == fr.eos_id)
+            if done_by_budget or done_by_eos:
+                # the fence landed between the final emit and the
+                # retire: the snapshot IS the complete answer
+                fr._finalize(base, base_times, t_first)
+                counts["finalized"] += 1
+                self._c_failover_requests.inc(outcome="finalized")
+                continue
+            replay = fr.prompt if not base else np.concatenate(
+                [fr.prompt, np.asarray(base, np.int32)])
+            remaining = fr.max_new_tokens - len(base)
+            new = None
+            last_err: Optional[BaseException] = None
+            give_up = time.monotonic() + deadline_s
+            with tracer.span("fleet.failover", replica=name,
+                             replayed_tokens=len(base)):
+                for attempt in range(retry_budget + 1):
+                    try:
+                        new = self.submit(replay, remaining,
+                                          eos_id=fr.eos_id, seed=fr.seed)
+                        break
+                    except AdmissionError as e:
+                        last_err = e
+                        pause = backoff_s * (2 ** attempt)
+                        if (attempt >= retry_budget
+                                or time.monotonic() + pause > give_up):
+                            break
+                        self._c_failover_retries.inc()
+                        time.sleep(pause)
+            if new is None:
+                fr._terminate(ReplicaLost(
+                    f"failover of request from dead replica {name!r}"
+                    f" exhausted {retry_budget + 1} attempts"
+                    f" ({type(last_err).__name__}: {last_err})"))
+                counts["lost"] += 1
+                self._c_failover_requests.inc(outcome="lost")
+                continue
+            new_inner, _ = new._snapshot()
+            # track the CALLER's handle on the new home, not the
+            # router-internal replay wrapper (same rule as drain)
+            fr._rebind(new.replica, new_inner, base, base_times, t_first)
+            with self._lock:
+                pend = self._outstanding.setdefault(new.replica, [])
+                pend[:] = [f for f in pend if f is not new]
+                pend.append(fr)
+            counts["replayed"] += 1
+            self._c_failover_requests.inc(outcome="replayed")
+            if self.events is not None:
+                self.events.record(ev.FLEET_FAILOVER, replica=name,
+                                   to=new.replica,
+                                   replayed_tokens=len(base),
+                                   remaining=remaining)
+        return counts
+
+    def lost_replicas(self) -> Dict[str, str]:
+        """{name: reason} of failed-over replicas whose capacity has not
+        been respawned yet — the Autoscaler's respawn work list."""
+        with self._lock:
+            return dict(self._lost_replicas)
+
+    def clear_lost(self, name: str) -> None:
+        """Forget a lost replica (its replacement is up): health()
+        returns to "ok" and the SLO budget un-tightens."""
+        with self._lock:
+            self._lost_replicas.pop(name, None)
         self._sync_replica_gauge()
 
     def shutdown(self) -> None:
@@ -544,21 +878,25 @@ class Router:
     # -- reporting ---------------------------------------------------------
     def health(self) -> Dict[str, object]:
         """Aggregate fleet health: "ok" only when every replica is READY
-        and nothing failed to load; "degraded" while any replica drains
-        or a load failure is outstanding; "down" with zero ready."""
+        and nothing failed to load or died unreplaced; "degraded" while
+        any replica drains, a load failure is outstanding, or a
+        failed-over replica's capacity is missing (cleared when the
+        autoscaler respawns it); "down" with zero ready."""
         with self._lock:
             reps = dict(self._replicas)
             failed = dict(self._failed_loads)
+            lost = dict(self._lost_replicas)
         per = {n: r.health() for n, r in sorted(reps.items())}
         ready = sum(1 for h in per.values() if h["state"] == "ready")
         if ready == 0:
             status = "down"
-        elif failed or any(h["state"] != "ready" for h in per.values()):
+        elif failed or lost or any(h["state"] != "ready"
+                                   for h in per.values()):
             status = "degraded"
         else:
             status = "ok"
         return {"status": status, "ready": ready, "replicas": per,
-                "failed_loads": failed}
+                "failed_loads": failed, "lost_replicas": lost}
 
     def stats(self) -> Dict[str, object]:
         with self._lock:

@@ -877,8 +877,8 @@ def run_bench(argv=None) -> int:
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
                     choices=("mixed", "shared-prefix", "long-prefill",
-                             "mesh-resize", "fleet", "speculative",
-                             "moe"))
+                             "mesh-resize", "fleet", "chaos",
+                             "speculative", "moe"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=64)
@@ -947,6 +947,26 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--affine-margin", type=float, default=1.2,
                     help="require round-robin p99 TTFT / affine p99 TTFT"
                          " >= this (fleet)")
+    # chaos workload (serving/fleet/chaos.py, ISSUE 18): crash a loaded
+    # replica mid-decode, assert zero lost requests + token parity +
+    # detect/evict/respawn within the heartbeat window
+    ap.add_argument("--chaos-seed", type=int, default=18,
+                    help="seed for the FleetFaultPlan determinism check"
+                         " (chaos)")
+    ap.add_argument("--chaos-crash-after", type=int, default=12,
+                    help="crash the victim this many generated tokens"
+                         " after the chaos engine is armed (chaos)")
+    ap.add_argument("--chaos-suspect", type=float, default=2.0,
+                    help="heartbeat age that turns a replica SUSPECT"
+                         " (chaos; generous — cold-dispatch compiles"
+                         " look exactly like hangs)")
+    ap.add_argument("--chaos-dead", type=float, default=10.0,
+                    help="heartbeat age that turns a replica DEAD;"
+                         " the DEAD-detect latency is asserted against"
+                         " this window (chaos)")
+    ap.add_argument("--chaos-interval", type=float, default=0.1,
+                    help="HealthMonitor / Autoscaler poll interval"
+                         " (chaos)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="static routing runs per policy; the best"
                          " steady-state p99 of each is compared (fleet —"
@@ -995,6 +1015,10 @@ def run_bench(argv=None) -> int:
         from ..fleet.bench import run_fleet_cli
 
         return run_fleet_cli(args)
+    if args.workload == "chaos":
+        from ..fleet.bench import run_chaos_cli
+
+        return run_chaos_cli(args)
 
     window = args.prompt_max
     max_len = args.prompt_max + args.out_max

@@ -150,6 +150,12 @@ class GenRequest:
         # anti-starvation bound)
         self.expert_sig = frozenset()
         self.affinity_skips = 0
+        # failover fence (serving/fleet/router.py): once fenced, the
+        # emitted-token snapshot is frozen — a possibly-still-live
+        # scheduler thread (hung, then resumed) can no longer append
+        # tokens the fleet-level replay would duplicate
+        self._emit_lock = threading.Lock()
+        self._fenced = False
 
     # -- consumer API ------------------------------------------------------
     def stream(self, timeout: Optional[float] = None):
@@ -187,22 +193,57 @@ class GenRequest:
 
     # -- scheduler side ----------------------------------------------------
     def _emit(self, tok: int) -> None:
-        self.tokens.append(int(tok))
-        self.token_times.append(time.monotonic())
-        self._stream.put(int(tok))
+        with self._emit_lock:
+            if self._fenced:
+                return
+            self.tokens.append(int(tok))
+            self.token_times.append(time.monotonic())
+            self._stream.put(int(tok))
 
     def _finish(self) -> None:
-        self.state = RequestState.FINISHED
-        self.t_done = time.monotonic()
-        self._stream.put(_DONE)
-        self._done.set()
+        with self._emit_lock:
+            if self._fenced or self._done.is_set():
+                return
+            self.state = RequestState.FINISHED
+            self.t_done = time.monotonic()
+            self._stream.put(_DONE)
+            self._done.set()
 
     def _fail(self, err: BaseException) -> None:
-        self.state = RequestState.FAILED
-        self.error = err
-        self.t_done = time.monotonic()
-        self._stream.put(err)
-        self._done.set()
+        with self._emit_lock:
+            if self._fenced or self._done.is_set():
+                return
+            self.state = RequestState.FAILED
+            self.error = err
+            self.t_done = time.monotonic()
+            self._stream.put(err)
+            self._done.set()
+
+    def _fence(self, err: BaseException):
+        """Atomically freeze the request for fleet failover: no token
+        emitted after the fence is visible anywhere, so the returned
+        (tokens, token_times) snapshot is EXACTLY what the caller's
+        stream has seen or will see before the error sentinel (the
+        stream queue is FIFO — tokens precede the error). Returns None
+        when the request already FINISHED cleanly (nothing to replay);
+        otherwise fails the handle with `err` (unless some failure is
+        already recorded — the consumer must see exactly one error) and
+        returns the snapshot, even for already-FAILED requests, since a
+        scheduler crash fails its slots before the router's failover
+        runs."""
+        with self._emit_lock:
+            already = self._fenced
+            self._fenced = True
+            if self.state is RequestState.FINISHED:
+                return None
+            snap = (list(self.tokens), list(self.token_times))
+            if not already and self.error is None:
+                self.state = RequestState.FAILED
+                self.error = err
+                self.t_done = time.monotonic()
+                self._stream.put(err)
+                self._done.set()
+            return snap
 
 
 class _Slot:
@@ -483,6 +524,25 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._completed = 0
         self._failed = 0
+        # fleet health signals (serving/fleet/health.py): the scheduler
+        # stamps a heartbeat at the top of EVERY loop iteration (the idle
+        # wait wakes at least every 0.1 s, so a stale heartbeat means a
+        # stuck dispatch, not an empty queue) and keeps a busy-gap EWMA
+        # of the wall between consecutive iterations that had work —
+        # unlike _observe_decode_iter this includes any stall between
+        # dispatches, which is exactly what a straggling replica shows.
+        self._t_heartbeat: Optional[float] = None
+        self._t_iter_prev: Optional[float] = None
+        self._iter_had_work = False
+        self._ewma_step_s: Optional[float] = None
+        self._step_warmup = 0
+        # chaos hook (serving/fleet/chaos.py): called once per scheduler
+        # iteration with the batcher. Raising kills the loop like any
+        # scheduler bug (_fail_all); sleeping stalls it (hang/straggle).
+        self.fault_hook = None
+        # lifetime generated-token count — the chaos plan's
+        # crash-at-token-N trigger reads this, monotonic and cheap
+        self.tokens_emitted = 0
         # mesh resize (docs/resharding.md): one pending ticket at a time,
         # applied by the scheduler thread between iterations
         self._pending_resize: Optional[ResizeTicket] = None
@@ -936,6 +996,33 @@ class ContinuousBatcher:
         self._drain_queue(BatcherStopped("batcher stopped"))
         self._fail_pending_resize(BatcherStopped("batcher stopped"))
 
+    def abort(self, err: BaseException) -> None:
+        """Non-blocking kill for a replica declared DEAD: fence every
+        slotted request (freezing its emitted-token snapshot for the
+        fleet's replay — see GenRequest._fence), fail queued work and
+        any pending resize with `err`, and release the pool/admission
+        state. Unlike stop() this never joins the scheduler thread — it
+        may be hung inside a dispatch — so the thread is left to notice
+        `_running=False` and exit on its own; its late emissions are
+        fenced no-ops, and a late pool touch at worst kills the already
+        condemned loop. start() still refuses to spawn a second loop
+        while the old thread drains."""
+        with self._cv:
+            self._running = False
+            slots, self._slots = list(self._slots), [None] * self.num_slots
+            self._cv.notify_all()
+        for s in slots:
+            if s is None:
+                continue
+            self.pool.free(s.req.id)
+            self.admission.release(s.req.id)
+            self._failed += 1
+            self._c_requests.inc(outcome="failed")
+            s.req._fence(err)
+        self._drain_queue(err)
+        self._fail_pending_resize(err)
+        self._g_active.set(0, pool=self.pool.label)
+
     def __enter__(self):
         self.start()
         return self
@@ -1077,6 +1164,50 @@ class ContinuousBatcher:
         self._g_decode_iter.set(self._ewma_decode_iter_s * 1e3,
                                 pool=self.pool.label)
 
+    # health probes (serving/fleet/health.py): liveness, heartbeat age,
+    # and the busy-gap step-latency EWMA the straggler score reads.
+    _STEP_EWMA_ALPHA = 0.3   # mirrors elastic/detector.py
+    _STEP_WARMUP = 2
+
+    def scheduler_alive(self) -> bool:
+        """True while the scheduler thread exists and runs — False after
+        a crash (_fail_all leaves a dead thread) or a clean stop."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def heartbeat_age_s(self) -> Optional[float]:
+        """Seconds since the scheduler last passed the top of its loop
+        (None before the first iteration). The idle wait wakes at least
+        every 0.1 s, so an age of seconds means a hung dispatch or a
+        stalled host thread, never merely an empty queue."""
+        t = self._t_heartbeat
+        return None if t is None else max(0.0, time.monotonic() - t)
+
+    def step_latency_s(self) -> Optional[float]:
+        """EWMA wall between consecutive busy scheduler iterations
+        (None until warmed up) — the fleet HealthMonitor's straggler
+        signal, scored against the fleet median."""
+        return self._ewma_step_s
+
+    def reset_latency(self) -> None:
+        """Forget the step-latency baseline and re-enter warmup — the
+        FailureDetector.reset_latency contract: after a respawn/resize
+        the first iterations recompile and would otherwise flag the
+        recovered replica as a straggler."""
+        self._ewma_step_s = None
+        self._step_warmup = 0
+        self._t_iter_prev = None
+
+    def _observe_step_gap(self, dt: float) -> None:
+        if dt <= 0:
+            return
+        if self._step_warmup < self._STEP_WARMUP:
+            self._step_warmup += 1
+            return
+        old = self._ewma_step_s
+        self._ewma_step_s = dt if old is None else \
+            (1 - self._STEP_EWMA_ALPHA) * old + self._STEP_EWMA_ALPHA * dt
+
     def prefix_probe(self, prompt_ids) -> int:
         """Tokens of `prompt_ids` THIS batcher's prefix cache would
         install from already-resident pages (probe only — no pin, no
@@ -1214,6 +1345,8 @@ class ContinuousBatcher:
             "prefill_s_per_token": self._ewma_prefill_s_per_tok,
             "draft_prefill_s_per_token": self._ewma_draft_prefill_s_per_tok,
             "decode_iter_s": self._ewma_decode_iter_s,
+            "step_latency_s": self._ewma_step_s,
+            "tokens_emitted": self.tokens_emitted,
             "queued_prefill_tokens": self.queued_prefill_tokens(),
             "resizes": list(self._resizes),
             "pool": self.pool.stats(),
@@ -1252,10 +1385,30 @@ class ContinuousBatcher:
                     while (self._running and not self._queue
                            and not any(self._slots)
                            and self._pending_resize is None):
+                        # an idle loop is a HEALTHY loop: stamp the
+                        # heartbeat on every 0.1 s wake so the monitor
+                        # can tell "no work" from "hung dispatch"
+                        self._t_heartbeat = time.monotonic()
                         self._cv.wait(timeout=0.1)
                     if not self._running and not any(self._slots):
                         break
                     running = self._running
+
+                # health signals + chaos: stamp the heartbeat, sample
+                # the busy-gap step latency (gaps after an iteration
+                # that HAD work — so hook stalls and slow dispatches
+                # count, idle 0.1 s waits do not), then run the fault
+                # hook: a raise kills the loop like any scheduler bug,
+                # a sleep registers as a hang/straggle.
+                now = time.monotonic()
+                self._t_heartbeat = now
+                if self._iter_had_work and self._t_iter_prev is not None:
+                    self._observe_step_gap(now - self._t_iter_prev)
+                self._t_iter_prev = now
+                self._iter_had_work = bool(self._queue) or any(self._slots)
+                hook = self.fault_hook
+                if hook is not None:
+                    hook(self)
 
                 # 0) apply a pending mesh resize (a shrink defers until
                 #    live sequences fit; admissions are held meanwhile)
@@ -1767,6 +1920,7 @@ class ContinuousBatcher:
         req._emit(tok)
         s.last_tok = tok
         s.emitted += 1
+        self.tokens_emitted += 1
         self._c_tokens.inc()
         if ((req.eos_id is not None and tok == req.eos_id)
                 or s.emitted >= req.max_new_tokens):
