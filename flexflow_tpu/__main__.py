@@ -127,6 +127,13 @@ def main(argv=None):
         from .obs.cli import run_profile
 
         raise SystemExit(run_profile(argv[1:]))
+    # post-mortem timeline: merge a tracer export, an EventLog dump, and
+    # a flight-recorder bundle into ONE Perfetto trace on a shared clock
+    # (docs/observability.md "Request tracing & post-mortem timelines")
+    if argv and argv[0] == "timeline":
+        from .obs.timeline import run_timeline
+
+        raise SystemExit(run_timeline(argv[1:]))
     # collective microbench: sweep the explicit reduction-strategy
     # lowerings x message sizes on the live mesh; emits the calibration
     # rows the per-tier link-constant refit consumes (docs/machine.md

@@ -87,12 +87,34 @@ class EventLog:
         self._events: List[ElasticEvent] = []
         self._clock = clock
         self._lock = threading.Lock()
+        self._subscribers: List[Callable[[ElasticEvent], None]] = []
+
+    def subscribe(self, fn: Callable[[ElasticEvent], None]) -> Callable:
+        """Register a live listener called (on the recording thread, no
+        log lock held) with every ElasticEvent as it is recorded — how
+        the flight recorder (obs/flightrecorder.py) mirrors the stream.
+        Listener exceptions are swallowed: observation must never fail
+        the recovery path being observed."""
+        with self._lock:
+            self._subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn: Callable[[ElasticEvent], None]) -> None:
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
 
     def record(self, kind: str, step: int = -1, **details) -> ElasticEvent:
         ev = ElasticEvent(kind=kind, step=step, time_s=self._clock(),
                           details=details)
         with self._lock:
             self._events.append(ev)
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(ev)
+            except Exception:
+                pass
         return ev
 
     def events(self, kind: Optional[str] = None) -> List[ElasticEvent]:

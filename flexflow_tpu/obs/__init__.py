@@ -26,10 +26,14 @@ from .refit import (DriftDetector, FittedCoefficients, FittedProfile,
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                        get_registry, iter_samples, parse_exposition,
                        render_labeled, render_merged, validate_exposition)
+from .flightrecorder import DEFAULT_DUMP_KINDS, FlightRecorder
 from .stepstats import (StepStats, model_peak_tflops,
                         model_train_flops_per_step)
-from .tracing import (Tracer, disable_tracing, enable_tracing, get_tracer,
-                      span, traced_dispatch)
+from .timeline import merge_timeline
+from .tracing import (Handoff, TraceContext, Tracer, current_context,
+                      current_trace_id, disable_tracing, enable_tracing,
+                      get_tracer, new_trace_id, root_context, span,
+                      traced_dispatch, use_context)
 
 
 def reset_all() -> None:
@@ -54,6 +58,9 @@ __all__ = [
     "get_registry", "iter_samples", "parse_exposition", "render_labeled",
     "render_merged", "validate_exposition",
     "StepStats", "model_peak_tflops", "model_train_flops_per_step",
-    "Tracer", "disable_tracing", "enable_tracing", "get_tracer", "span",
-    "traced_dispatch", "reset_all",
+    "DEFAULT_DUMP_KINDS", "FlightRecorder", "merge_timeline",
+    "Handoff", "TraceContext", "Tracer", "current_context",
+    "current_trace_id", "disable_tracing", "enable_tracing", "get_tracer",
+    "new_trace_id", "root_context", "span", "traced_dispatch",
+    "use_context", "reset_all",
 ]

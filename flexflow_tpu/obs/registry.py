@@ -169,7 +169,12 @@ def _norm_buckets(buckets: Sequence[float]) -> Tuple[float, ...]:
 
 
 class Histogram(_Family):
-    """Cumulative-bucket histogram (`_bucket{le=}`/`_sum`/`_count`)."""
+    """Cumulative-bucket histogram (`_bucket{le=}`/`_sum`/`_count`).
+
+    `observe(v, exemplar=trace_id)` additionally pins the LATEST exemplar
+    onto the landing bucket, rendered as an OpenMetrics exemplar suffix
+    (`... # {trace_id="<id>"} <value>`) so a tail bucket links back to
+    the trace that caused it (docs/observability.md "Request tracing")."""
 
     kind = "histogram"
 
@@ -179,19 +184,40 @@ class Histogram(_Family):
         self.buckets: Tuple[float, ...] = _norm_buckets(buckets)
         # per-labelset: [bucket counts..., sum, count]
         self._hist: Dict[Tuple[str, ...], List[float]] = {}
+        # (labelset, landing-bucket index) -> (exemplar id, observed value)
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int],
+                              Tuple[str, float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
         key = self._key(labels)
         v = float(value)
         with self._lock:
             ent = self._hist.get(key)
             if ent is None:
                 ent = self._hist[key] = [0.0] * (len(self.buckets) + 2)
+            landing = None
             for i, le in enumerate(self.buckets):
                 if v <= le:
+                    if landing is None:
+                        landing = i
                     ent[i] += 1
             ent[-2] += v
             ent[-1] += 1
+            if exemplar is not None and landing is not None:
+                self._exemplars[(key, landing)] = (str(exemplar), v)
+
+    def exemplar(self, bucket_le: float, **labels) -> Optional[Tuple[str,
+                                                                     float]]:
+        """The (exemplar id, observed value) pinned on one bucket, or
+        None."""
+        key = self._key(labels)
+        try:
+            idx = self.buckets.index(float(bucket_le))
+        except ValueError:
+            return None
+        with self._lock:
+            return self._exemplars.get((key, idx))
 
     def count(self, **labels) -> int:
         key = self._key(labels)
@@ -259,10 +285,14 @@ class Histogram(_Family):
     def reset(self) -> None:
         with self._lock:
             self._hist.clear()
+            self._exemplars.clear()
 
     def remove(self, **labels) -> None:
         with self._lock:
-            self._hist.pop(self._key(labels), None)
+            key = self._key(labels)
+            self._hist.pop(key, None)
+            for k in [k for k in self._exemplars if k[0] == key]:
+                del self._exemplars[k]
 
     def _sample_lines(self, extra: Sequence[Tuple[str, str]] = ()) \
             -> List[str]:
@@ -273,14 +303,20 @@ class Histogram(_Family):
             # and a lock-free read could emit a torn histogram
             # (bucket{+Inf} != count) that breaks rate()/quantile math
             items = sorted((k, list(v)) for k, v in self._hist.items())
+            exemplars = dict(self._exemplars)
         ex_names = tuple(n for n, _ in extra)
         ex_vals = tuple(v for _, v in extra)
         for key, ent in items:
             names = ex_names + self.label_names + ("le",)
             for i, le in enumerate(self.buckets):
-                out.append(self._line(f"{self.name}_bucket", names,
-                                      ex_vals + tuple(key) + (_fmt(le),),
-                                      ent[i]))
+                line = self._line(f"{self.name}_bucket", names,
+                                  ex_vals + tuple(key) + (_fmt(le),),
+                                  ent[i])
+                ex = exemplars.get((key, i))
+                if ex is not None:
+                    line += (f' # {{trace_id="{escape_label_value(ex[0])}"}}'
+                             f" {_fmt(ex[1])}")
+                out.append(line)
             out.append(self._line(f"{self.name}_sum",
                                   ex_names + self.label_names,
                                   ex_vals + key, ent[-2]))
@@ -459,6 +495,11 @@ _SAMPLE_RE = re.compile(
     r"(?:\{(.*)\})?"                          # optional label block
     r" ([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
     r"(?: [0-9]+)?$")                         # optional timestamp
+# OpenMetrics exemplar suffix, split off a sample line before _SAMPLE_RE
+# runs (the greedy label-block match must never see it)
+_EXEMPLAR_RE = re.compile(
+    r"^\{(.*)\} ([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
+    r"(?: [0-9.]+)?$")
 _LABEL_PAIR_RE = re.compile(
     r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
@@ -480,14 +521,16 @@ def _unescape_label_value(v: str) -> str:
 def parse_exposition(text: str) -> Dict[str, Dict]:
     """Strict-enough parser for the exposition subset we emit. Returns
     {family name: {"type": ..., "help": ..., "samples":
-    [(name, {label: value}, float)]}}. Raises ValueError on any line that
-    does not parse — the checker the CI observability job and the obs
-    tests run over `/metrics` output."""
+    [(name, {label: value}, float)], "exemplars":
+    [(name, {label: value}, {exemplar label: value}, float)]}}. Raises
+    ValueError on any line that does not parse — the checker the CI
+    observability job and the obs tests run over `/metrics` output."""
     families: Dict[str, Dict] = {}
 
     def fam(name: str) -> Dict:
         return families.setdefault(
-            name, {"type": None, "help": None, "samples": []})
+            name, {"type": None, "help": None, "samples": [],
+                   "exemplars": []})
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -511,6 +554,17 @@ def parse_exposition(text: str) -> Dict[str, Dict]:
             continue
         if line.startswith("#"):
             continue  # comment
+        exemplar = None
+        if " # " in line:  # OpenMetrics exemplar suffix on a sample line
+            head, _, ex_part = line.rpartition(" # ")
+            em = _EXEMPLAR_RE.match(ex_part)
+            if em:  # else: " # " inside a label value — leave the line be
+                line = head
+                ex_labels = {pm.group(1): _unescape_label_value(pm.group(2))
+                             for pm in _LABEL_PAIR_RE.finditer(em.group(1))}
+                exemplar = (ex_labels,
+                            float(em.group(2).replace("Inf", "inf")
+                                  .replace("NaN", "nan")))
         m = _SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"line {lineno}: unparseable sample: {line!r}")
@@ -528,9 +582,13 @@ def parse_exposition(text: str) -> Dict[str, Dict]:
                 raise ValueError(
                     f"line {lineno}: bad label block: {label_block!r}")
         base = re.sub(r"_(bucket|sum|count)$", "", name)
-        fam(base if base in families else name)["samples"].append(
+        entry = fam(base if base in families else name)
+        entry["samples"].append(
             (name, labels, float(value.replace("Inf", "inf")
                                  .replace("NaN", "nan"))))
+        if exemplar is not None:
+            entry["exemplars"].append(
+                (name, labels, exemplar[0], exemplar[1]))
     return families
 
 

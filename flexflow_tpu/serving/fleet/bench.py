@@ -282,11 +282,58 @@ def _render_fleet(router) -> Dict:
             "replica_labeled_families": labeled}
 
 
+def _trace_continuity(trace: Dict, handles: List, victim: str) -> Dict:
+    """Did every failed-over request's spans stitch under ONE trace_id
+    across the NAMED scheduler tracks? Client threads are unnamed, so
+    the named-tid filter keeps exactly the per-replica tracks.
+
+    Two stitching grades: a request that died MID-DECODE
+    (`replayed_tokens > 0`) left spans on the victim's track, so its
+    trace must cover the victim AND a survivor (>= 2 named tracks). A
+    request still queued (or prefilling) when the victim died never
+    decoded there — its spans legitimately live on one track, and the
+    check is only that the replay landed under the ORIGINAL trace_id on
+    some named track (the respawned incarnation runs as 'respawn', so
+    the victim's name is unambiguously the dead track)."""
+    names = {e["tid"]: e["args"].get("name", "")
+             for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    tracks: Dict[str, set] = {}
+    for e in trace["traceEvents"]:
+        args = e.get("args")
+        if e.get("ph") != "X" or not isinstance(args, dict):
+            continue
+        if e.get("tid") in names and "trace_id" in args:
+            tracks.setdefault(args["trace_id"], set()).add(
+                names[e["tid"]])
+
+    def _ok(h) -> bool:
+        t = tracks.get(h.trace_id, ())
+        if h.replayed_tokens > 0:
+            return victim in t and len(t) >= 2
+        return len(t) >= 1
+
+    failed_over = [h for h in handles if h.failovers > 0]
+    mid_decode = [h for h in failed_over if h.replayed_tokens > 0]
+    stitched = [h for h in failed_over if _ok(h)]
+    return {
+        "failed_over": len(failed_over),
+        "mid_decode": len(mid_decode),
+        "stitched": len(stitched),
+        "unstitched": sorted(str(h.trace_id) for h in failed_over
+                             if h not in stitched),
+        "victim_track": victim,
+        "multi_track_traces": {t: sorted(v) for t, v in tracks.items()
+                               if len(v) >= 2},
+    }
+
+
 def run_fleet_chaos(model, workload, *, n_replicas: int, slots: int,
                     page_size: int, max_len: int, prefix_cache_pages: int,
                     deadline_s: float, crash_after_tokens: int,
                     suspect_after_s: float, dead_after_s: float,
-                    probe_interval_s: float) -> Dict:
+                    probe_interval_s: float,
+                    artifact_dir: Optional[str] = None) -> Dict:
     """The failure-domain drill (ISSUE 18): crash a loaded replica
     mid-decode under a live HealthMonitor + Autoscaler and prove the
     blast radius is a TTFT blip, not an outage.
@@ -313,6 +360,28 @@ def run_fleet_chaos(model, workload, *, n_replicas: int, slots: int,
     router.events = elog
     mon = HealthMonitor(router, suspect_after_s=suspect_after_s,
                         dead_after_s=dead_after_s, event_log=elog)
+    # observability leg (ISSUE 19): with an artifact dir, the drill runs
+    # under request tracing and an armed flight recorder — the DEAD
+    # verdict auto-dumps a post-mortem bundle, the trace + EventLog are
+    # exported beside it, and trace continuity across the failover is
+    # measured (every failed-over request's spans must share ONE
+    # trace_id across the victim's and a survivor's scheduler tracks)
+    tracer = recorder = None
+    if artifact_dir is not None:
+        import os
+
+        from ...obs.flightrecorder import FlightRecorder
+        from ...obs.tracing import get_tracer
+
+        os.makedirs(artifact_dir, exist_ok=True)
+        tracer = get_tracer()
+        tracer.clear()
+        tracer.enable()
+        recorder = FlightRecorder(
+            dump_dir=os.path.join(artifact_dir, "flight_recorder"),
+            tracer=tracer, registries={"router": router.registry})
+        recorder.attach(elog)
+        recorder.start(interval_s=0.2)
 
     def factory():
         return Replica("respawn", model, max_len=max_len, num_slots=slots,
@@ -417,10 +486,32 @@ def run_fleet_chaos(model, workload, *, n_replicas: int, slots: int,
             "token_lists": [[int(t) for t in h.tokens] for h in handles],
             "exposition": _render_fleet(router),
         })
+        if recorder is not None:
+            import json as _json
+            import os
+
+            recorder.detach()  # also stops the snapshot daemon
+            trace_path = os.path.join(artifact_dir, "trace.json")
+            tracer.export_chrome_trace(trace_path)
+            events_path = os.path.join(artifact_dir, "events.json")
+            with open(events_path, "w") as f:
+                f.write(elog.to_json())
+            with open(trace_path) as f:
+                trace = _json.load(f)
+            out["trace_continuity"] = _trace_continuity(
+                trace, handles, victim)
+            out["artifacts"] = {
+                "trace": trace_path, "events": events_path,
+                "flight_dumps": list(recorder.dumps),
+            }
         return out
     finally:
         if engine is not None:
             engine.disarm()
+        if recorder is not None:
+            recorder.detach()
+        if tracer is not None:
+            tracer.disable()
         mon.stop()
         asc.stop()
         router.shutdown()
@@ -475,7 +566,8 @@ def run_chaos_cli(args) -> int:
     chaos = run_fleet_chaos(
         model, workload, crash_after_tokens=args.chaos_crash_after,
         suspect_after_s=args.chaos_suspect, dead_after_s=args.chaos_dead,
-        probe_interval_s=args.chaos_interval, **common)
+        probe_interval_s=args.chaos_interval,
+        artifact_dir=args.artifacts, **common)
 
     def line(tag: str, r: Dict) -> None:
         print(f"[serve-bench] {tag:12s} {r['tokens']} tokens in"
@@ -543,6 +635,48 @@ def run_chaos_cli(args) -> int:
                 f"chaos: {required} missing a replica-labeled series in"
                 " the merged exposition")
 
+    # observability leg (ISSUE 19): failover trace continuity, the
+    # auto-dumped post-mortem bundle, and the merged Perfetto timeline
+    timeline_path = None
+    if args.artifacts:
+        import os
+
+        from ...obs.timeline import run_timeline
+
+        cont = chaos["trace_continuity"]
+        tracks = sorted({n for v in cont["multi_track_traces"].values()
+                         for n in v})
+        print(f"[serve-bench] tracing: {cont['stitched']}/"
+              f"{cont['failed_over']} failed-over requests' spans stitch"
+              f" under one trace_id ({cont['mid_decode']} died"
+              f" mid-decode) across replica tracks {tracks} |"
+              f" flight dumps:"
+              f" {len(chaos['artifacts']['flight_dumps'])}")
+        if cont["failed_over"] and cont["stitched"] != cont["failed_over"]:
+            failures.append(
+                f"trace continuity broken: only {cont['stitched']} of"
+                f" {cont['failed_over']} failed-over requests' spans"
+                f" stitch across the dead replica and a survivor"
+                f" (unstitched trace_ids: {cont['unstitched']})")
+        if cont["failed_over"] and not cont["mid_decode"]:
+            failures.append(
+                "no failed-over request died mid-decode — the drill"
+                " never exercised cross-replica span stitching (raise"
+                " --chaos-crash-after or --requests)")
+        if not chaos["artifacts"]["flight_dumps"]:
+            failures.append(
+                "the replica death triggered no flight-recorder"
+                " post-mortem dump")
+        timeline_path = os.path.join(args.artifacts, "timeline.json")
+        rc = run_timeline([
+            "--trace", chaos["artifacts"]["trace"],
+            "--events", chaos["artifacts"]["events"],
+            "--flight", os.path.join(args.artifacts, "flight_recorder"),
+            "--out", timeline_path])
+        if rc != 0:
+            failures.append(
+                "the merged post-mortem timeline failed validate_trace")
+
     blip = (chaos["ttft_ms_p99"] / ref["ttft_ms_p99"]
             if ref["ttft_ms_p99"] > 0 else 0.0)
     print(f"[serve-bench] ttft blip: chaos p99 / fault-free p99 ="
@@ -568,6 +702,12 @@ def run_chaos_cli(args) -> int:
             "failed_over_requests": chaos["failed_over_requests"],
         },
     }
+    if args.artifacts:
+        report["timeline"] = timeline_path
+        report["trace_continuity"] = chaos["trace_continuity"]
+        report["flight_dumps"] = chaos["artifacts"]["flight_dumps"]
+        report["pinned"]["stitched_failovers"] = \
+            chaos["trace_continuity"]["stitched"]
     if args.report:
         with open(args.report, "w") as f:
             json.dump(report, f, indent=2, default=str)

@@ -56,6 +56,10 @@ class Replica:
         self._lock = threading.Lock()
         self._state = ReplicaState.READY
         batcher_kw.setdefault("registry", self.registry)
+        # the scheduler thread's track in trace exports carries the
+        # replica name, so a merged post-mortem timeline shows one track
+        # per replica (metric labels keep the pool's own label)
+        batcher_kw.setdefault("trace_label", self.name)
         self.batcher = ContinuousBatcher(model, **batcher_kw)
         if start:
             self.batcher.start()

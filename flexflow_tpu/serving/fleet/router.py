@@ -61,7 +61,8 @@ import numpy as np
 
 from ...elastic import events as ev
 from ...obs.registry import MetricsRegistry
-from ...obs.tracing import get_tracer
+from ...obs.tracing import (current_context, get_tracer, root_context,
+                            use_context)
 from ..sched.admission import (AdmissionError, PoolSaturated, QueueFull,
                                SLOExceeded)
 from ..sched.continuous import RequestCancelled
@@ -103,6 +104,10 @@ class FleetRequest:
         self.route = ""          # routing decision label (affine/...)
         self.handoffs = 0
         self.failovers = 0
+        # the request's TraceContext (obs/tracing.py), captured at
+        # Router.submit — failover replays and drain handoffs run under
+        # it, so every incarnation's spans share ONE trace_id
+        self.trace_ctx = None
         self._cv = threading.Condition()
         self._inner = None
         self._replica: Optional[str] = None
@@ -198,6 +203,19 @@ class FleetRequest:
             f" arrived within {_HANDOFF_REBIND_TIMEOUT_S}s")
 
     # -- consumer API (GenRequest contract) --------------------------------
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace_ctx.trace_id if self.trace_ctx is not None \
+            else None
+
+    @property
+    def replayed_tokens(self) -> int:
+        """Tokens emitted by DEAD incarnations and carried into the
+        failover replay prompt (0 = the request was still queued or
+        prefilling when its replica died — it never decoded there)."""
+        with self._cv:
+            return len(self._base)
+
     @property
     def replica(self) -> Optional[str]:
         with self._cv:
@@ -579,8 +597,15 @@ class Router:
         key = chain[min(self.route_depth, len(chain)) - 1] if chain else ""
         order, decision = self._route_order(prompt.size, key, chain, ready)
         tracer = get_tracer()
-        with tracer.span("fleet.route", decision=decision,
-                         candidates=len(order)):
+        ctx = current_context()
+        if tracer.enabled and ctx is None:
+            # no caller context (the chaos bench and tests drive the
+            # router directly): every request still gets its own trace
+            # root, so failover continuity is checkable end to end
+            ctx = root_context()
+        with use_context(ctx), \
+                tracer.span("fleet.route", decision=decision,
+                            candidates=len(order)):
             # SLO gate: drop candidates predicting over budget; if that
             # empties the list, shed with the fleet-wide minimum. While
             # failed-over capacity is missing the budget TIGHTENS by
@@ -610,6 +635,7 @@ class Router:
                     continue
                 fr = FleetRequest(prompt, max_new_tokens, eos_id, seed)
                 fr.route = decision
+                fr.trace_ctx = ctx
                 fr._bind(name, inner)
                 with self._lock:
                     if key:
@@ -664,7 +690,8 @@ class Router:
             inner, _ = fr._snapshot()
             if fr.replica != name or inner.done():
                 continue
-            with tracer.span("fleet.handoff", replica=name):
+            with use_context(fr.trace_ctx), \
+                    tracer.span("fleet.handoff", replica=name):
                 try:
                     new = self.submit(fr.prompt, fr.max_new_tokens,
                                       eos_id=fr.eos_id, seed=fr.seed)
@@ -815,8 +842,12 @@ class Router:
             new = None
             last_err: Optional[BaseException] = None
             give_up = time.monotonic() + deadline_s
-            with tracer.span("fleet.failover", replica=name,
-                             replayed_tokens=len(base)):
+            # the replay CONTINUES the original trace: the survivor's
+            # submit sees fr's context, so both incarnations' spans
+            # stitch under one trace_id in the merged timeline
+            with use_context(fr.trace_ctx), \
+                    tracer.span("fleet.failover", replica=name,
+                                replayed_tokens=len(base)):
                 for attempt in range(retry_budget + 1):
                     try:
                         new = self.submit(replay, remaining,

@@ -967,6 +967,12 @@ def run_bench(argv=None) -> int:
     ap.add_argument("--chaos-interval", type=float, default=0.1,
                     help="HealthMonitor / Autoscaler poll interval"
                          " (chaos)")
+    ap.add_argument("--artifacts", default=None,
+                    help="directory for the chaos drill's observability"
+                         " artifacts: request trace, EventLog dump,"
+                         " flight-recorder post-mortem bundle, and the"
+                         " merged Perfetto timeline; also arms the"
+                         " failover trace-continuity assert (chaos)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="static routing runs per policy; the best"
                          " steady-state p99 of each is compared (fleet —"

@@ -150,6 +150,12 @@ class GenRequest:
         # anti-starvation bound)
         self.expert_sig = frozenset()
         self.affinity_skips = 0
+        # distributed-tracing handoff (obs/tracing.py): submit() stamps
+        # the caller's TraceContext here as a Handoff token; the
+        # scheduler thread resumes it around this request's spans, so
+        # server handler -> scheduler crossings stitch under one
+        # trace_id (None when tracing is off or no context is active)
+        self.trace = None
         # failover fence (serving/fleet/router.py): once fenced, the
         # emitted-token snapshot is frozen — a possibly-still-live
         # scheduler thread (hung, then resumed) can no longer append
@@ -190,6 +196,10 @@ class GenRequest:
         if self.t_first_token is None:
             return None
         return self.t_first_token - self.t_submit
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
 
     # -- scheduler side ----------------------------------------------------
     def _emit(self, tok: int) -> None:
@@ -321,7 +331,8 @@ class ContinuousBatcher:
                  prefix_cache_pages: Optional[int] = None,
                  draft_model=None, spec_tokens: int = 3,
                  expert_affinity: bool = False,
-                 affinity_window: int = 4):
+                 affinity_window: int = 4,
+                 trace_label: Optional[str] = None):
         if getattr(model.executor, "mesh", None) is not None:
             # a mesh is fine as long as nothing is actually partitioned
             # (the common replicated case — e.g. a dp axis the batch does
@@ -481,6 +492,10 @@ class ContinuousBatcher:
         self.pool = PagedKVPool(self.num_slots, self.max_len,
                                 page_size=page_size, registry=registry,
                                 prefix_cache_pages=prefix_pages)
+        # the scheduler thread's track name in trace exports (a Replica
+        # passes its own name so the merged timeline shows one track per
+        # replica); metric labels keep using pool.label, unchanged
+        self.trace_label = str(trace_label) if trace_label else self.pool.label
         self.admission = AdmissionController(
             self.pool,
             self.window if self.prefill_chunk_tokens == 0 else None,
@@ -1066,6 +1081,9 @@ class ContinuousBatcher:
                 self.admission.admit(rid, prompt.size, max_new_tokens,
                                      shared_pages=shared_pages)
             req = GenRequest(rid, prompt, max_new_tokens, eos_id, seed)
+            # capture the caller's TraceContext as an explicit handoff:
+            # the scheduler thread resumes it (None when tracing is off)
+            req.trace = get_tracer().handoff("serve.submit")
             req.expert_sig = sig
             self._queue.append(req)
             self._cv.notify_all()
@@ -1377,6 +1395,7 @@ class ContinuousBatcher:
         from ...obs.tracing import get_tracer
 
         tracer = get_tracer()
+        tracer.set_thread_name(self.trace_label)
         params = self.model.params
         state = self.model.state
         try:
@@ -1455,7 +1474,8 @@ class ContinuousBatcher:
                     self._spec_iterate(params, state, tracer, active,
                                        toks, pos)
                     continue
-                with tracer.span("serve.decode", slots=len(active)):
+                with tracer.span("serve.decode", slots=len(active),
+                                 requests=[s.req.id for s in active]):
                     t0 = time.monotonic()
                     next_tok, self._caches = self._decode_fn(
                         params, state, self._caches, jnp.asarray(toks),
@@ -1732,8 +1752,9 @@ class ContinuousBatcher:
             if self.prefill_chunk_tokens == 0:
                 padded = np.zeros((1, self.window), np.int32)
                 padded[0, :plen] = req.prompt
-                with tracer.span("serve.prefill", request=req.id,
-                                 tokens=plen):
+                with tracer.resume(req.trace), \
+                        tracer.span("serve.prefill", request=req.id,
+                                    tokens=plen):
                     t0 = time.monotonic()
                     tok, self._caches = self._prefill_fn(
                         params, state, self._caches, jnp.asarray(padded),
@@ -1769,8 +1790,9 @@ class ContinuousBatcher:
                         src_slot[b * ps:(b + 1) * ps] = bslot
                         src_row[b * ps:(b + 1) * ps] = (
                             roff + np.arange(ps))
-                    with tracer.span("serve.prefix_install",
-                                     request=req.id, tokens=matched):
+                    with tracer.resume(req.trace), \
+                            tracer.span("serve.prefix_install",
+                                        request=req.id, tokens=matched):
                         s.small = self._install_fn(
                             s.small, self._band, jnp.asarray(src_slot),
                             jnp.asarray(src_row),
@@ -1802,8 +1824,9 @@ class ContinuousBatcher:
                 # has the full prompt — the next spec iteration needs
                 # both sides of the sequence
                 continue
-            with tracer.span("serve.prefill", request=s.req.id,
-                             offset=off, tokens=n):
+            with tracer.resume(s.req.trace), \
+                    tracer.span("serve.prefill", request=s.req.id,
+                                offset=off, tokens=n):
                 if not last:
                     probs, s.small = self._chunk_fn(
                         params, state, s.small, jnp.asarray(tokens),
@@ -1842,8 +1865,9 @@ class ContinuousBatcher:
         dtokens = np.zeros((1, chunk), np.int32)
         dtokens[0, :dn] = s.req.prompt[doff:doff + dn]
         dlast = doff + dn >= s.plen
-        with tracer.span("serve.draft_prefill", request=s.req.id,
-                         offset=doff, tokens=dn):
+        with tracer.resume(s.req.trace), \
+                tracer.span("serve.draft_prefill", request=s.req.id,
+                            offset=doff, tokens=dn):
             if not dlast:
                 s.draft_small = self._draft_chunk_fn(
                     draft.params, draft.state, s.draft_small,
@@ -1897,7 +1921,8 @@ class ContinuousBatcher:
                 jnp.asarray(src), jnp.asarray(dst_slot),
                 jnp.asarray(dst_row))
 
-        with tracer.span("serve.prefix_insert", request=s.req.id):
+        with tracer.resume(s.req.trace), \
+                tracer.span("serve.prefix_insert", request=s.req.id):
             prefix.insert(s.req.prompt, s.plen, copy_pages)
 
     def _first_token(self, s: _Slot, tok: int) -> None:
@@ -1908,6 +1933,7 @@ class ContinuousBatcher:
         req.t_first_token = time.monotonic()
         self._h_ttft.observe(
             (req.t_first_token - req.t_submit) * 1e3,
+            exemplar=req.trace_id,
             cache="hit" if req.cache_hit else "miss")
         self._sync_active_gauge()
         self._emit_token(s, tok)

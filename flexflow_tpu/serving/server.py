@@ -9,16 +9,44 @@ framework; serving stays dependency-free.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
 
 from ..obs.registry import REGISTRY, MetricsRegistry
-from ..obs.tracing import get_tracer
+from ..obs.tracing import get_tracer, root_context, use_context
 from .batcher import DynamicBatcher
 from .model import InferenceModel
+
+# W3C trace-context inbound header: 00-<trace_id:32 hex>-<span_id:16
+# hex>-<flags:2 hex> — the span_id becomes the server root span's parent
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def _request_scope(headers):
+    """(TraceContext | None, request_id) for one HTTP request: an inbound
+    `traceparent` header CONTINUES the caller's trace (its ids are echoed
+    back and every span lands under them); otherwise a fresh trace root
+    is minted while tracing is enabled. The request id — taken from
+    `X-Request-Id` or minted — is always present, so rejection bodies
+    and streaming trailers can name the request even with tracing off."""
+    rid = (headers.get("X-Request-Id") or "").strip() or uuid.uuid4().hex[:16]
+    m = _TRACEPARENT_RE.match(
+        (headers.get("traceparent") or "").strip().lower())
+    if m:
+        return root_context(trace_id=m.group(1), parent_id=m.group(2)), rid
+    if get_tracer().enabled:
+        return root_context(), rid
+    return None, rid
+
+
+def _format_traceparent(ctx) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
 
 
 class ModelMetrics:
@@ -527,8 +555,20 @@ class InferenceServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                self._send_trace_headers()
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_trace_headers(self):
+                """Echo the request id and (when a trace is active) the
+                traceparent, so clients can join their logs to the
+                server's timeline."""
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header("X-Request-Id", rid)
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None:
+                    self.send_header("traceparent", _format_traceparent(ctx))
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
@@ -588,6 +628,7 @@ class InferenceServer:
                     eos_id=req.get("eos_id"), seed=int(req.get("seed") or 0))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                self._send_trace_headers()
                 self.end_headers()
                 toks = []
                 try:
@@ -598,19 +639,24 @@ class InferenceServer:
                         self.wfile.flush()
                     # cache_hit/prefix_tokens: the prefix-cache outcome
                     # (serving/sched/kvpool.py) — lets clients see why
-                    # their TTFT was what it was
+                    # their TTFT was what it was. request_id/trace_id
+                    # name the request in the server's timeline
+                    # (`python -m flexflow_tpu timeline`).
                     trailer = {
                         "done": True, "tokens": toks,
                         "cache_hit": bool(gen.cache_hit),
                         "prefix_tokens": int(gen.prefix_tokens),
                         "ttft_ms": (round(gen.ttft_s * 1e3, 3)
                                     if gen.ttft_s is not None else None),
+                        "request_id": self._request_id,
+                        "trace_id": getattr(gen, "trace_id", None),
                     }
                 except OSError:  # client disconnected mid-stream
                     return
                 except Exception as e:  # headers already sent: error trailer
                     trailer = {"done": False, "tokens": toks,
-                               "error": f"{type(e).__name__}: {e}"}
+                               "error": f"{type(e).__name__}: {e}",
+                               "request_id": self._request_id}
                 try:
                     self.wfile.write((json.dumps(trailer) + "\n").encode())
                 except OSError:
@@ -622,6 +668,12 @@ class InferenceServer:
             def do_POST(self):
                 from .sched.admission import AdmissionError
 
+                # request-scoped trace context + request id: every span
+                # below lands under the inbound traceparent (or a fresh
+                # root), and the id is echoed in headers, rejection
+                # bodies, and streaming trailers
+                self._trace_ctx, self._request_id = _request_scope(
+                    self.headers)
                 parts = self.path.strip("/").split("/")
                 if (len(parts) == 4 and parts[0] == "v2"
                         and parts[1] == "models"
@@ -645,29 +697,36 @@ class InferenceServer:
                         prompt = (req["prompt"] if continuous
                                   else np.asarray(req["prompt"],
                                                   dtype=np.int32))
-                        if continuous and req.get("stream"):
-                            self._stream_generate(
-                                parts[2], np.asarray(prompt, np.int32), req)
-                            return
-                        toks = server_ref.generate(
-                            parts[2], prompt,
-                            int(req.get("max_new_tokens", 16)),
-                            eos_id=req.get("eos_id"),
-                            seed=int(req.get("seed") or 0),
-                        )
+                        with use_context(self._trace_ctx):
+                            if continuous and req.get("stream"):
+                                self._stream_generate(
+                                    parts[2], np.asarray(prompt, np.int32),
+                                    req)
+                                return
+                            toks = server_ref.generate(
+                                parts[2], prompt,
+                                int(req.get("max_new_tokens", 16)),
+                                eos_id=req.get("eos_id"),
+                                seed=int(req.get("seed") or 0),
+                            )
                         toks = (toks.tolist()
                                 if isinstance(toks, np.ndarray) else toks)
                         self._reply(200, {"tokens": toks})
                     except AdmissionError as e:
                         # typed backpressure: 429 for transient saturation
-                        # (retry with backoff), 400 for can-never-fit
+                        # (retry with backoff), 400 for can-never-fit;
+                        # request_id lets a shed client quote exactly
+                        # which attempt was rejected
                         self._reply(e.http_status,
-                                    {"error": str(e), "reason": e.reason})
+                                    {"error": str(e), "reason": e.reason,
+                                     "request_id": self._request_id})
                     except ValueError as e:  # malformed request shape
-                        self._reply(400, {"error": str(e)})
+                        self._reply(400, {"error": str(e),
+                                          "request_id": self._request_id})
                     except Exception as e:
                         self._reply(
-                            500, {"error": f"{type(e).__name__}: {e}"})
+                            500, {"error": f"{type(e).__name__}: {e}",
+                                  "request_id": self._request_id})
                     return
                 # v2/models/<name>/infer
                 if (len(parts) != 4 or parts[0] != "v2"
@@ -683,12 +742,15 @@ class InferenceServer:
                         if not _is_int_list(v) else np.asarray(v, dtype=np.int32)
                         for k, v in req.get("inputs", {}).items()
                     }
-                    out = server_ref.infer(name, inputs, timeout=30.0)
+                    with use_context(self._trace_ctx):
+                        out = server_ref.infer(name, inputs, timeout=30.0)
                     self._reply(200, {"outputs": np.asarray(out).tolist()})
                 except KeyError as e:
-                    self._reply(404, {"error": str(e)})
+                    self._reply(404, {"error": str(e),
+                                      "request_id": self._request_id})
                 except Exception as e:
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}",
+                                      "request_id": self._request_id})
 
         httpd = ThreadingHTTPServer((host, port), Handler)
         if block:

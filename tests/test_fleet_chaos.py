@@ -422,3 +422,78 @@ def test_degraded_fleet_tightens_slo_budget(lm):
         h.result(timeout=300.0)
     finally:
         router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# observability (ISSUE 19): a failover CONTINUES the request's trace and
+# the death auto-dumps a flight-recorder post-mortem
+# ---------------------------------------------------------------------
+def test_failover_continues_trace_and_dumps_postmortem(lm, tmp_path):
+    import json
+    import os
+
+    from flexflow_tpu.elastic.events import EventLog
+    from flexflow_tpu.obs.flightrecorder import FlightRecorder
+    from flexflow_tpu.obs.tracing import get_tracer
+
+    tracer = get_tracer()
+    tracer.clear()
+    tracer.enable()
+    router = _mk_fleet(lm, 2)
+    elog = EventLog()
+    router.events = elog
+    rec = FlightRecorder(dump_dir=str(tmp_path / "fr"), tracer=tracer,
+                         registries={"router": router.registry}
+                         ).attach(elog)
+    mon = _monitor(router, event_log=elog)
+    try:
+        prompts = [_prompt(6, seed=s) for s in (1, 2, 3, 4)]
+        handles = [router.submit(p, 10) for p in prompts]
+        victim = handles[0].replica
+        at = router.replica(victim).batcher.tokens_emitted
+        engine = ChaosEngine(FleetFaultPlan().crash(victim, at_token=at),
+                             event_log=elog)
+        engine.arm(router)
+        _poll_until_dead(mon, victim)
+        for h in handles:
+            h.result(timeout=300.0)
+        failed = [h for h in handles if h.failovers > 0]
+        assert failed, "the crash caught no in-flight work"
+
+        # each failed-over request's spans stitch under its ORIGINAL
+        # trace_id: the survivor's scheduler track carries it, and a
+        # mid-decode victim leaves its spans on the dead track too
+        trace = tracer.to_chrome_trace()
+        names = {e["tid"]: e["args"]["name"]
+                 for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        tracks = {}
+        for e in trace["traceEvents"]:
+            a = e.get("args")
+            if e.get("ph") == "X" and isinstance(a, dict) \
+                    and "trace_id" in a and e["tid"] in names:
+                tracks.setdefault(a["trace_id"], set()).add(
+                    names[e["tid"]])
+        for h in failed:
+            assert h.trace_id is not None
+            got = tracks.get(h.trace_id, set())
+            assert got - {victim}, (h.trace_id, got)
+            if h.replayed_tokens:
+                assert victim in got, (h.trace_id, got)
+        # the replay leg itself is a span of the original trace
+        fo = tracer.events("fleet.failover")
+        assert fo
+        assert all(e["args"]["trace_id"] in tracks for e in fo)
+
+        # the DEAD verdict auto-dumped ONE bundle (the failover burst
+        # right behind it is debounced) with the trace alongside
+        assert len(rec.dumps) == 1, rec.dumps
+        bundle = rec.dumps[0]
+        with open(os.path.join(bundle, "recorder.json")) as f:
+            dump = json.load(f)
+        assert dump["meta"]["trigger"] == "fleet.dead"
+        assert os.path.exists(os.path.join(bundle, "trace.json"))
+    finally:
+        rec.detach()
+        tracer.disable()
+        router.shutdown()

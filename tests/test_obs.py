@@ -281,6 +281,203 @@ def test_tracer_ring_bounds_memory():
 
 
 # ---------------------------------------------------------------------------
+# Request-scoped tracing: handoff/resume stitching, ring drops, exemplars
+# ---------------------------------------------------------------------------
+def test_handoff_resume_stitches_spans_across_threads(tmp_path):
+    """The serving submit path in miniature: a client thread opens a span
+    and captures a Handoff; a scheduler thread resumes it. Both spans
+    must share one trace_id, the resumed span must parent under the
+    submitting span, and the flow-arrow pair must bind the two tracks."""
+    import threading
+
+    from flexflow_tpu.obs.tracing import root_context, use_context
+
+    t = Tracer(enabled=True)
+    t.set_thread_name("client")
+    ctx = root_context()
+    with use_context(ctx):
+        with t.span("submit"):
+            token = t.handoff("crossing")
+
+    def worker():
+        t.set_thread_name("sched")
+        with t.resume(token), t.span("prefill", request=1):
+            pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join(10.0)
+    assert not th.is_alive()
+
+    spans = {e["name"]: e for e in t.events() if e["ph"] == "X"}
+    assert spans["prefill"]["args"]["trace_id"] == ctx.trace_id
+    assert spans["submit"]["args"]["trace_id"] == ctx.trace_id
+    assert spans["prefill"]["args"]["parent_id"] \
+        == spans["submit"]["args"]["span_id"]
+    assert spans["submit"]["tid"] != spans["prefill"]["tid"]
+    # flow arrow: start on the client track, finish on the scheduler's,
+    # sharing one id under the "handoff" category
+    s, f = [e for e in t.events() if e["ph"] in ("s", "f")]
+    assert (s["ph"], f["ph"]) == ("s", "f")
+    assert s["id"] == f["id"] and s["cat"] == f["cat"] == "handoff"
+    assert f["bp"] == "e"
+    assert s["tid"] == spans["submit"]["tid"]
+    assert f["tid"] == spans["prefill"]["tid"]
+    # a second resume re-enters the context but must not re-emit the
+    # flow finish (the arrow is one edge, not one per resume)
+    with t.resume(token):
+        pass
+    assert len([e for e in t.events() if e["ph"] == "f"]) == 1
+
+    # the export names both tracks and still validates
+    path = t.export_chrome_trace(str(tmp_path / "t.json"))
+    from flexflow_tpu.obs.cli import validate_trace
+
+    assert validate_trace(path) == ["prefill", "submit"]
+    with open(path) as fh:
+        data = json.load(fh)
+    names = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert {"client", "sched"} <= names
+
+
+def test_handoff_is_noop_when_disabled_or_contextless():
+    t = Tracer(enabled=False)
+    assert t.handoff() is None
+    with t.resume(None):  # a None token must be a no-op scope
+        pass
+    t.enable()
+    assert t.handoff() is None  # no current context -> nothing to carry
+    assert t.events() == []
+
+
+def test_instant_args_are_jsonable(tmp_path):
+    """Regression: numpy scalars/arrays passed to instant() must not
+    break json.dump at export time."""
+    t = Tracer(enabled=True)
+    t.instant("marker", arr=np.arange(3), val=np.float64(1.5),
+              n=np.int64(7))
+    path = t.export_chrome_trace(str(tmp_path / "t.json"))
+    with open(path) as fh:
+        data = json.load(fh)  # would raise before the fix
+    (ev,) = [e for e in data["traceEvents"] if e.get("ph") == "i"]
+    assert ev["args"]["val"] == 1.5
+
+
+def test_ring_overflow_counts_drops_and_stamps_export():
+    t = Tracer(enabled=True, max_events=10)
+    for i in range(50):
+        with t.span(f"s{i}"):
+            pass
+    assert t.dropped_events == 40
+    data = t.to_chrome_trace()
+    meta = next(e for e in data["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "trace_metadata")
+    assert meta["args"]["dropped_events"] == 40
+    assert meta["args"]["epoch_wall_s"] > 0
+    # mirrored onto the registry so dashboards see the truncation
+    from flexflow_tpu.obs import get_registry
+
+    assert get_registry().counter(
+        "ff_trace_events_dropped_total", "").value() == 40
+    t.clear()
+    assert t.dropped_events == 0
+
+
+def test_histogram_exemplar_round_trip():
+    reg = MetricsRegistry()
+    h = reg.histogram("ff_e_ms", "latencies", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0, exemplar="abc123")
+    text = reg.render()
+    assert '# {trace_id="abc123"} 5' in text
+    validate_exposition(text)  # exemplars must not break validation
+    fams = parse_exposition(text)
+    # samples stay plain 3-tuples; exemplars ride in their own list
+    assert all(len(s) == 3 for s in fams["ff_e_ms"]["samples"])
+    (name, labels, exlabels, value), = fams["ff_e_ms"]["exemplars"]
+    assert name == "ff_e_ms_bucket" and labels["le"] == "10"
+    assert exlabels == {"trace_id": "abc123"}
+    assert value == pytest.approx(5.0)
+
+
+def test_traceparent_request_scope_parsing():
+    """The HTTP door: a well-formed inbound traceparent CONTINUES the
+    caller's trace; garbage or absence mints local state only when
+    tracing is on; the request id is always present."""
+    from flexflow_tpu.obs.tracing import get_tracer
+    from flexflow_tpu.serving.server import (_format_traceparent,
+                                             _request_scope)
+
+    caller_trace, caller_span = "ab" * 16, "cd" * 8
+    ctx, rid = _request_scope({
+        "traceparent": f"00-{caller_trace}-{caller_span}-01",
+        "X-Request-Id": "req-42"})
+    assert rid == "req-42"
+    assert ctx.trace_id == caller_trace and ctx.parent_id == caller_span
+    assert _format_traceparent(ctx) \
+        == f"00-{caller_trace}-{ctx.span_id}-01"
+    # malformed header + tracing disabled -> no context, minted id
+    assert not get_tracer().enabled  # conftest reset guarantees this
+    ctx2, rid2 = _request_scope({"traceparent": "00-nope-bad-ff"})
+    assert ctx2 is None and len(rid2) == 16
+    # tracing enabled -> a fresh local root even without a header
+    get_tracer().enable()
+    try:
+        ctx3, _ = _request_scope({})
+        assert ctx3 is not None and ctx3.parent_id is None
+    finally:
+        get_tracer().disable()
+
+
+def test_flight_recorder_rings_triggers_and_debounces(tmp_path):
+    from flexflow_tpu.elastic.events import EventLog
+    from flexflow_tpu.obs.flightrecorder import FlightRecorder
+
+    reg = MetricsRegistry()
+    reg.counter("ff_fr_total", "recorded things").inc()
+    tracer = Tracer(enabled=True)
+    with tracer.span("before_death"):
+        pass
+    elog = EventLog()
+    rec = FlightRecorder(dump_dir=str(tmp_path / "fr"), capacity=8,
+                         tracer=tracer, registries={"unit": reg},
+                         max_dumps=2, debounce_s=3600.0).attach(elog)
+    try:
+        elog.record("fleet.suspect", replica="r0")   # health stream
+        elog.record("retry", attempt=1)              # plain event
+        rec.snapshot_metrics()                       # metrics stream
+        assert not rec.dumps  # nothing triggered yet
+        elog.record("fleet.dead", replica="r0")      # TRIGGER
+        elog.record("fleet.failover", replica="r0")  # debounced away
+        assert len(rec.dumps) == 1
+        bundle = rec.dumps[0]
+        with open(bundle + "/recorder.json") as fh:
+            dump = json.load(fh)
+        assert dump["meta"]["trigger"] == "fleet.dead"
+        assert {"health", "events", "metrics"} \
+            <= set(dump["meta"]["streams"])
+        kinds = [e.get("kind") for e in dump["entries"]]
+        assert "fleet.suspect" in kinds and "retry" in kinds
+        # the bundle carries the trace and a fresh exposition render
+        with open(bundle + "/trace.json") as fh:
+            trace = json.load(fh)
+        assert any(e.get("name") == "before_death"
+                   for e in trace["traceEvents"])
+        with open(bundle + "/metrics_unit.txt") as fh:
+            assert "ff_fr_total" in fh.read()
+        # manual dumps bypass the debounce, max_dumps caps the disk
+        assert rec.dump(trigger="manual") is not None
+        assert rec.dump(trigger="manual") is None  # cap reached
+        # ring stays bounded
+        for i in range(20):
+            elog.record("retry", attempt=i)
+        assert len(rec.entries()) == 8
+    finally:
+        rec.detach()
+
+
+# ---------------------------------------------------------------------------
 # StepStats
 # ---------------------------------------------------------------------------
 def test_stepstats_rates_and_summary():

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST lint for repo-specific invariants ruff cannot express.
 
-Three rules, each with its own allowlist of known, deliberate
+Four rules, each with its own allowlist of known, deliberate
 exceptions (relative paths from the repo root). Run from the repo
 root; exits non-zero when any un-allowlisted violation is found.
 Wired into .github/workflows/lint.yml next to ruff.
@@ -29,11 +29,21 @@ span-discipline
     opened outside `with` is never closed on an exception path and
     skews every enclosing duration (obs/tracing.py).
 
+event-docs
+    Cross-file: every event-kind constant `flexflow_tpu/elastic/
+    events.py` declares (uppercase module-level string assignment)
+    must appear as a row of the "Event-kind catalogue" table in
+    docs/observability.md, and every kind row in that table must be a
+    declared constant — both directions, so the catalogue can never
+    drift from the code (post-mortem consumers grep the docs for what
+    a kind means; the FlightRecorder's trigger kinds live there too).
+
 Usage:  python tools/lint_invariants.py [--list] [paths...]
 """
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 from typing import Dict, Iterable, List, Tuple
@@ -53,6 +63,7 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
             "_host_fetch is the one sanctioned device->host edge",
     },
     "metric-help": {},
+    "event-docs": {},
     "span-discipline": {
         # the span() helper RETURNS the context manager for callers
         "flexflow_tpu/obs/tracing.py":
@@ -62,6 +73,12 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
 
 HOST_SYNC_SCOPES = ("flexflow_tpu/kernels/", "flexflow_tpu/runtime/")
 METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+EVENTS_PY = "flexflow_tpu/elastic/events.py"
+EVENT_DOCS_MD = "docs/observability.md"
+EVENT_DOCS_HEADING = "### Event-kind catalogue"
+# a kind cell: the first backticked token of a table row
+_KIND_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`")
 
 
 class Violation(Tuple[str, str, int, str]):
@@ -142,6 +159,57 @@ def lint_file(path: Path, rel: str) -> List[Tuple[str, str, int, str]]:
     return findings
 
 
+def lint_event_docs() -> List[Tuple[str, str, int, str]]:
+    """Cross-file rule: elastic/events.py kind constants <-> the
+    docs/observability.md "Event-kind catalogue" table, both ways."""
+    events_path = REPO / EVENTS_PY
+    docs_path = REPO / EVENT_DOCS_MD
+    findings: List[Tuple[str, str, int, str]] = []
+
+    tree = ast.parse(events_path.read_text(), filename=EVENTS_PY)
+    declared: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            declared[node.value.value] = node.lineno
+
+    documented: Dict[str, int] = {}
+    in_section = False
+    heading_line = 0
+    for lineno, line in enumerate(docs_path.read_text().splitlines(), 1):
+        if line.strip() == EVENT_DOCS_HEADING:
+            in_section = True
+            heading_line = lineno
+            continue
+        if in_section and line.startswith("#"):
+            break  # next heading of any level ends the catalogue
+        if in_section:
+            m = _KIND_ROW_RE.match(line)
+            if m:
+                documented[m.group(1)] = lineno
+    if not heading_line:
+        return [("event-docs", EVENT_DOCS_MD, 1,
+                 f"missing the {EVENT_DOCS_HEADING!r} section that"
+                 f" catalogues {EVENTS_PY} kind constants")]
+
+    for kind, lineno in sorted(declared.items(), key=lambda kv: kv[1]):
+        if kind not in documented:
+            findings.append((
+                "event-docs", EVENTS_PY, lineno,
+                f"event kind {kind!r} is not documented in the"
+                f" {EVENT_DOCS_MD} event-kind catalogue"))
+    for kind, lineno in sorted(documented.items(), key=lambda kv: kv[1]):
+        if kind not in declared:
+            findings.append((
+                "event-docs", EVENT_DOCS_MD, lineno,
+                f"catalogued kind {kind!r} matches no constant in"
+                f" {EVENTS_PY} (stale doc row?)"))
+    return findings
+
+
 def iter_files(paths: Iterable[str]) -> Iterable[Path]:
     for p in paths:
         base = (REPO / p) if not Path(p).is_absolute() else Path(p)
@@ -158,13 +226,17 @@ def main(argv: List[str]) -> int:
 
     violations = []
     waived = 0
-    for f in iter_files(roots):
-        rel = f.resolve().relative_to(REPO).as_posix()
-        for rule, relpath, line, msg in lint_file(f, rel):
-            if relpath in ALLOWLIST.get(rule, {}):
-                waived += 1
-                continue
-            violations.append((rule, relpath, line, msg))
+    per_file = [(f, lint_file(f, f.resolve().relative_to(REPO).as_posix()))
+                for f in iter_files(roots)]
+    cross = lint_event_docs() \
+        if (REPO / EVENTS_PY).exists() and (REPO / EVENT_DOCS_MD).exists() \
+        else []
+    for rule, relpath, line, msg in \
+            [v for _, vs in per_file for v in vs] + cross:
+        if relpath in ALLOWLIST.get(rule, {}):
+            waived += 1
+            continue
+        violations.append((rule, relpath, line, msg))
 
     for rule, relpath, line, msg in violations:
         print(f"{relpath}:{line}: [{rule}] {msg}")
