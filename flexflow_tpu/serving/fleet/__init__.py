@@ -25,6 +25,13 @@ them into the millions-of-users serving tier (docs/serving.md "Fleet"):
    probes scoring each replica READY → SUSPECT → DEAD
    (`ff_fleet_health_state`), with the DEAD verdict driving
    `Router.fail_over`.
+ - `DisaggCoordinator` (disagg.py, ISSUE 20): the disaggregated
+   prefill/decode plane — `role="prefill"` replicas park each request
+   after its first token, and the coordinator ships the finished KV
+   pages to a `role="decode"` replica as a priced, FFTA06x-gated,
+   64 MB-chunked TRANSFER (reusing `plan_slot_migration` + the machine
+   model's tier pricing), token-identical to unified serving with
+   `resume_parked` as the zero-drop fallback.
  - `ChaosEngine` / `FleetFaultPlan` (chaos.py, ISSUE 18): seeded,
    deterministic replica fault injection (crash-at-token-N / hang /
    straggle / flaky-submit) behind `serve-bench --workload chaos`, so
@@ -38,11 +45,13 @@ both when a fleet is registered.
 from .autoscaler import Autoscaler
 from .chaos import (FAULT_KINDS, ChaosEngine, FleetFault, FleetFaultPlan,
                     InjectedCrash)
+from .disagg import DisaggCoordinator, HandoffFailed
 from .health import HealthMonitor, HealthState, ReplicaLost
 from .replica import Replica, ReplicaState
 from .router import FleetRequest, FleetUnavailable, Router
 
-__all__ = ["Autoscaler", "ChaosEngine", "FAULT_KINDS", "FleetFault",
-           "FleetFaultPlan", "FleetRequest", "FleetUnavailable",
-           "HealthMonitor", "HealthState", "InjectedCrash", "Replica",
-           "ReplicaLost", "ReplicaState", "Router"]
+__all__ = ["Autoscaler", "ChaosEngine", "DisaggCoordinator", "FAULT_KINDS",
+           "FleetFault", "FleetFaultPlan", "FleetRequest",
+           "FleetUnavailable", "HandoffFailed", "HealthMonitor",
+           "HealthState", "InjectedCrash", "Replica", "ReplicaLost",
+           "ReplicaState", "Router"]

@@ -54,7 +54,9 @@ class Autoscaler:
                  idle_ticks_before_drain: int = 3,
                  ttft_window_ticks: int = 20,
                  preplanner=None, preplan_fn: Optional[Callable] = None,
-                 monitor=None):
+                 monitor=None, role: Optional[str] = None,
+                 prefill_backlog_slo_s: Optional[float] = None,
+                 itl_p99_slo_ms: Optional[float] = None):
         if not 1 <= int(min_slots) <= int(max_slots):
             raise ValueError(
                 f"need 1 <= min_slots ({min_slots}) <= max_slots"
@@ -97,6 +99,22 @@ class Autoscaler:
         # respawns and applied resizes reset the replica's health
         # verdict + straggler baseline through it
         self.monitor = monitor
+        # disaggregated serving (docs/serving.md): role=None governs the
+        # whole fleet (classic unified autoscaling); role="prefill" /
+        # "decode" scopes EVERY decision — overload signals, resizes,
+        # replica adds/drains, and respawns — to that pool, so the two
+        # pools size independently from their OWN saturation currencies:
+        # the prefill pool from queue depth + backlog-seconds at the
+        # measured prefill rate, the decode pool from pages-used
+        # utilization + windowed p99 inter-token latency
+        if role is not None and role not in ("prefill", "decode",
+                                             "unified"):
+            raise ValueError(
+                f"role={role!r}: choose prefill, decode, unified or None")
+        self.role = role
+        self.prefill_backlog_slo_s = prefill_backlog_slo_s
+        self.itl_p99_slo_ms = itl_p99_slo_ms
+        self._itl_snaps: Dict[str, Deque] = {}
         self._ttft_snaps: Dict[str, Deque] = {}
         self._replica_idle: Dict[str, int] = {}
         self.log: List[Dict] = []
@@ -113,6 +131,33 @@ class Autoscaler:
 
     # -- signals -----------------------------------------------------------
     def _overloaded(self, name: str, rep) -> bool:
+        if self.role == "prefill":
+            # prefill pool: pressure accumulates as queued prefill work,
+            # not page residency (parked requests release pages at
+            # handoff). Backlog-seconds is rate-aware: the same queue
+            # depth on a slower mesh is more overloaded.
+            if rep.queue_depth() > self.queue_hi:
+                return True
+            if self.prefill_backlog_slo_s is not None \
+                    and rep.prefill_backlog_s() \
+                    > self.prefill_backlog_slo_s:
+                return True
+            if self.ttft_p99_slo_ms is not None \
+                    and self._windowed_ttft_p99(name, rep) \
+                    > self.ttft_p99_slo_ms:
+                return True
+            return False
+        if self.role == "decode":
+            # decode pool: imports bypass the wait queue (the KV arrives
+            # materialized), so saturation is pages USED and what the
+            # user feels — windowed p99 inter-token latency
+            if rep.utilization() > self.util_hi:
+                return True
+            if self.itl_p99_slo_ms is not None \
+                    and self._windowed_itl_p99(name, rep) \
+                    > self.itl_p99_slo_ms:
+                return True
+            return False
         if rep.queue_depth() > self.queue_hi:
             return True
         if rep.utilization() > self.util_hi:
@@ -134,11 +179,22 @@ class Autoscaler:
         return rep.ttft_p99_ms(since=snaps[0])
 
     def _advance_ttft_window(self, name: str, rep) -> None:
-        if self.ttft_p99_slo_ms is None:
-            return
-        self._ttft_snaps.setdefault(
-            name, deque(maxlen=self.ttft_window_ticks)).append(
-            rep.ttft_window())
+        if self.ttft_p99_slo_ms is not None:
+            self._ttft_snaps.setdefault(
+                name, deque(maxlen=self.ttft_window_ticks)).append(
+                rep.ttft_window())
+        if self.itl_p99_slo_ms is not None:
+            self._itl_snaps.setdefault(
+                name, deque(maxlen=self.ttft_window_ticks)).append(
+                rep.itl_window())
+
+    def _windowed_itl_p99(self, name: str, rep) -> float:
+        """Windowed p99 ITL, same snapshot-delta mechanics as the TTFT
+        signal (`_windowed_ttft_p99`)."""
+        snaps = self._itl_snaps.get(name)
+        if not snaps:
+            return 0.0
+        return rep.itl_p99_ms(since=snaps[0])
 
     def _idle(self, rep) -> bool:
         return (rep.queue_depth() == 0
@@ -171,14 +227,20 @@ class Autoscaler:
             # replacement under the SAME name, so affinity re-learns it
             # and health() walks back from degraded to ok
             if self.replica_factory is not None:
+                lost_roles = self.router.lost_replica_roles()
                 for name, reason in self.router.lost_replicas().items():
+                    if self.role is not None \
+                            and lost_roles.get(name, "unified") \
+                            != self.role:
+                        continue  # another pool's casualty
                     act = self._respawn(name, reason, tracer)
                     if act:
                         actions.append(act)
             ready = [(n, r) for n, r in
                      ((n, self.router.replica(n))
                       for n in self.router.replica_names())
-                     if r.state is ReplicaState.READY]
+                     if r.state is ReplicaState.READY
+                     and (self.role is None or r.role == self.role)]
             all_idle = bool(ready) and all(self._idle(r) for _, r in ready)
             self._idle_ticks = self._idle_ticks + 1 if all_idle else 0
             if all_idle:
@@ -216,8 +278,7 @@ class Autoscaler:
                             actions.append(act)
                     elif (self.replica_factory is not None
                           and (self.max_replicas is None
-                               or len(self.router.replica_names())
-                               < self.max_replicas)):
+                               or self._pool_size() < self.max_replicas)):
                         act = self._add_replica(tracer)
                         if act:
                             actions.append(act)
@@ -283,9 +344,20 @@ class Autoscaler:
         return {"action": "respawn", "replica": name, "reason": reason,
                 "t": time.monotonic()}
 
+    def _pool_size(self) -> int:
+        """Replicas this autoscaler governs (max_replicas bounds the
+        POOL in a role-scoped autoscaler, not the whole fleet)."""
+        if self.role is None:
+            return len(self.router.replica_names())
+        return sum(1 for n in self.router.replica_names()
+                   if self.router.replica(n).role == self.role)
+
     def _add_replica(self, tracer) -> Optional[Dict]:
         self._added += 1
-        name = f"auto{self._added}"
+        # role-scoped autoscalers must not collide on replica names —
+        # two pools each minting "auto1" would trip add_replica
+        name = f"auto{self._added}" if self.role is None \
+            else f"auto-{self.role}{self._added}"
         with tracer.span("fleet.autoscale", action="add_replica",
                          replica=name):
             rep = self.router.add_replica(name, self.replica_factory)

@@ -720,6 +720,330 @@ def run_chaos_cli(args) -> int:
     return 0
 
 
+# -- disaggregated prefill/decode (ISSUE 20) -------------------------------
+
+# default pricing machine for the disagg handoff plane: two v5e pods of 8
+# bridged by DCN (mirrors examples/machines/multipod_2x8.json). The bench
+# places the prefill pool on pod 0 and the decode pool on pod 1, so every
+# KV shipment prices over the DCN hop, not the innermost p2p link.
+_DISAGG_MACHINE_SPEC = {
+    "chip": "tpu-v5e",
+    "num_chips": 16,
+    "tiers": [
+        {"name": "ici", "degree": 8, "gbps": 45.0, "links": 2},
+        {"name": "dcn", "degree": 2, "gbps": 3.125, "links": 1,
+         "latency_us": 10.0},
+    ],
+}
+
+
+def _itl_gaps_ms(handles: List) -> List[float]:
+    """Steady-state inter-token gaps across all requests, in ms. The
+    FIRST gap (token 1 -> token 2) is excluded symmetrically from both
+    runs: on the disagg fleet it is where the KV handoff settles, on the
+    unified fleet it is where slot scheduling settles — neither is the
+    steady decode cadence the ITL gate compares."""
+    gaps: List[float] = []
+    for h in handles:
+        ts = h.token_times
+        gaps.extend((b - a) * 1e3 for a, b in zip(ts[1:], ts[2:]))
+    return gaps
+
+
+def run_disagg_fleet(model, workload, *, roles: List[str], slots: int,
+                     page_size: int, max_len: int, deadline_s: float,
+                     concurrency: int, prefill_chunk: Optional[int] = None,
+                     machine=None, device_ids=(0,),
+                     trace: bool = False) -> Dict:
+    """One serving run over `workload` on a fleet described by `roles`
+    (e.g. ``["unified", "unified"]`` or ``["prefill", "decode"]``) —
+    equal chips means equal role-list length. Requests stream through a
+    sliding window of `concurrency` in-flight (sized to the decode
+    pool's slots, same window for every configuration), so prefill of
+    new arrivals continuously overlaps decode of resident ones — the
+    regime the disagg split exists for."""
+    from .replica import Replica
+    from .router import Router
+
+    router = Router(policy="least_loaded")
+    extra = {} if prefill_chunk is None \
+        else {"prefill_chunk_tokens": prefill_chunk}
+    counts: Dict[str, int] = {}
+    for role in roles:
+        counts[role] = counts.get(role, 0) + 1
+        name = f"{role[0]}{counts[role] - 1}"
+        router.add_replica(name, Replica(
+            name, model, role=role, max_len=max_len, num_slots=slots,
+            page_size=page_size, max_queue=max(len(workload), 16),
+            **extra))
+    coord = None
+    if "prefill" in roles:
+        from .disagg import DisaggCoordinator
+
+        coord = DisaggCoordinator(router, machine=machine,
+                                  device_ids=device_ids)
+        coord.attach_all()
+    tracer = None
+    if trace:
+        from ...obs.tracing import get_tracer
+
+        tracer = get_tracer()
+        tracer.clear()
+        tracer.enable()
+    handles: List = [None] * len(workload)
+    shed: Dict[str, int] = {}
+    try:
+        _warm(router, max_len, page_size)
+        if coord is not None:
+            # warm the handoff plane end to end (export gather, priced
+            # schedule, import scatter) outside the timed window
+            warm = np.zeros(max(1, min(page_size * 2 + 1, max_len - 2)),
+                            np.int32)
+            router.submit(warm, 2, seed=0).result(timeout=600.0)
+        committed0 = coord.committed if coord is not None else 0
+        t0 = time.monotonic()
+        active: List = []
+        idx = 0
+        while idx < len(workload) or active:
+            while idx < len(workload) and len(active) < concurrency:
+                handles[idx] = _submit_retry(router, workload[idx],
+                                             deadline_s, t0, shed)
+                active.append(handles[idx])
+                idx += 1
+            still = [h for h in active if not h.done()]
+            if len(still) == len(active):
+                time.sleep(0.002)
+            active = still
+        for h in handles:
+            try:
+                h.result(timeout=600.0)
+            except Exception:
+                pass  # surfaces in _collect as dropped
+        wall = time.monotonic() - t0
+        out = _collect(handles, workload, deadline_s, wall, len(roles),
+                       shed)
+        gaps = _itl_gaps_ms(handles)
+        out.update({
+            "roles": list(roles),
+            "concurrency": concurrency,
+            "itl_gaps": len(gaps),
+            "itl_ms_p50": round(_pct(gaps, 50), 3),
+            "itl_ms_p99": round(_pct(gaps, 99), 3),
+            "token_lists": [[int(t) for t in h.tokens] for h in handles],
+            "exposition": _render_fleet(router),
+        })
+        if coord is not None:
+            st = coord.stats()
+            text = router.registry.render()
+            out["handoff"] = {
+                **{k: st[k] for k in ("committed", "resumed", "failed",
+                                      "last_error", "last_predicted_us",
+                                      "us_per_byte", "bytes_per_token")},
+                "committed_run": coord.committed - committed0,
+                "requests_handed_off": sum(
+                    1 for h in handles if h.handoffs >= 1),
+                "disagg_families": sorted(
+                    n for n in ("ff_disagg_handoffs_total",
+                                "ff_disagg_handoff_bytes_total",
+                                "ff_disagg_handoff_chunks_total",
+                                "ff_disagg_handoff_ms",
+                                "ff_disagg_predicted_transfer_us")
+                    if n in text),
+            }
+        if tracer is not None:
+            handoff_ids = {e["args"].get("trace_id")
+                           for e in tracer.events("fleet.kv_handoff")}
+            out["trace"] = {
+                "span_names": tracer.span_names(),
+                "stitched": sum(1 for h in handles
+                                if h.trace_id in handoff_ids),
+                "unstitched": [str(h.trace_id) for h in handles
+                               if h.trace_id not in handoff_ids],
+            }
+        return out
+    finally:
+        if tracer is not None:
+            tracer.disable()
+        if coord is not None:
+            coord.stop()
+        router.shutdown()
+
+
+def run_disagg_cli(args) -> int:
+    """The `serve-bench --workload disagg` entry (dispatched from
+    serving/sched/bench.py): the SAME prefill-heavy request stream
+    through a unified fleet and a disaggregated (prefill pool + decode
+    pool + KV-handoff plane) fleet at equal chips, with the disagg
+    contract hard-asserted — decode-tail win, token parity, zero drops,
+    one priced handoff per routed request, handoff spans stitched into
+    each request's trace."""
+    import json
+
+    from ..sched.bench import build_tiny_lm, make_workload
+    from ...search.machine_model import (HierarchicalMachineModel,
+                                         load_machine_spec)
+
+    n_rep = args.replicas
+    if n_rep < 2:
+        print("[serve-bench] FAIL: disagg needs --replicas >= 2 — the"
+              " prefill and decode pools are disjoint replicas")
+        return 1
+    n_prefill = max(1, n_rep // 2)
+    n_decode = n_rep - n_prefill
+    window = args.prompt_max
+    max_len = args.prompt_max + args.out_max
+    spec = load_machine_spec(args.machine_spec) if args.machine_spec \
+        else dict(_DISAGG_MACHINE_SPEC)
+    machine = HierarchicalMachineModel.from_json(spec)
+    device_ids = tuple(range(machine.num_chips))
+    concurrency = args.slots * n_decode
+    print(f"[serve-bench] disagg: {args.requests} requests"
+          f" (prompts {args.prompt_min}-{args.prompt_max}, outputs"
+          f" {args.out_min}-{args.out_max}) | unified {n_rep}x{args.slots}"
+          f" slots vs {n_prefill} prefill + {n_decode} decode |"
+          f" window {concurrency} in flight | KV priced on"
+          f" {machine.num_chips}-chip"
+          f" {'/'.join(t['name'] for t in spec['tiers'])} machine")
+    model = build_tiny_lm(args.slots, window, vocab=args.vocab,
+                          hidden=args.hidden, heads=args.heads,
+                          layers=args.layers)
+    workload = make_workload(args.requests, args.prompt_min,
+                             args.prompt_max, args.out_min, args.out_max,
+                             args.vocab, args.seed)
+    common = dict(slots=args.slots, page_size=args.page_size,
+                  max_len=max_len, deadline_s=args.deadline,
+                  concurrency=concurrency,
+                  prefill_chunk=args.prefill_chunk)
+
+    def best_of(**kw) -> Dict:
+        """Best (lowest p99 ITL) of --repeats runs — the ITL comparison
+        is a wall-clock measurement on shared runners, and one
+        descheduling stall in either run would flip the hard assert.
+        Every repeat's drop/starve/handoff counts still gate."""
+        import gc
+
+        runs = []
+        for _ in range(max(1, args.repeats)):
+            gc.collect()  # drop the previous fleet's cache arrays
+            runs.append(run_disagg_fleet(model, workload, **common, **kw))
+        best = min(runs, key=lambda r: r["itl_ms_p99"] or 1e18)
+        best["repeats_dropped"] = sum(r["dropped"] for r in runs)
+        best["repeats_starved"] = sum(r["starved"] for r in runs)
+        if "handoff" in best:
+            best["repeats_handed_off_min"] = min(
+                r["handoff"]["requests_handed_off"] for r in runs)
+        return best
+
+    unified = best_of(roles=["unified"] * n_rep)
+    disagg = best_of(
+        roles=["prefill"] * n_prefill + ["decode"] * n_decode,
+        machine=machine, device_ids=device_ids, trace=True)
+
+    def line(tag: str, r: Dict) -> None:
+        print(f"[serve-bench] {tag:9s} {r['tokens']} tokens in"
+              f" {r['wall_s']}s = {r['tokens_per_s']} tok/s |"
+              f" itl p50/p99 {r['itl_ms_p50']}/{r['itl_ms_p99']} ms"
+              f" ({r['itl_gaps']} gaps) | ttft p99 {r['ttft_ms_p99']} ms |"
+              f" dropped={r['dropped']} starved={r['starved']}")
+
+    line("unified:", unified)
+    line("disagg:", disagg)
+    ho = disagg["handoff"]
+    print(f"[serve-bench] handoff: {ho['committed_run']} committed"
+          f" ({ho['resumed']} resumed, {ho['failed']} failed) |"
+          f" {ho['requests_handed_off']}/{len(workload)} requests |"
+          f" learned {round(ho['bytes_per_token'] or 0.0, 1)} B/token,"
+          f" last priced {round(ho['last_predicted_us'] or 0.0, 1)} us |"
+          f" routes {disagg['routes']}")
+
+    failures: List[str] = []
+    for tag, r in (("unified", unified), ("disagg", disagg)):
+        dropped = r.get("repeats_dropped", r["dropped"])
+        starved = r.get("repeats_starved", r["starved"])
+        if dropped:
+            failures.append(f"{tag}: {dropped} requests dropped/short")
+        if starved:
+            failures.append(f"{tag}: {starved} requests starved past"
+                            f" {args.deadline}s")
+    parity_bad = sum(1 for a, b in zip(disagg["token_lists"],
+                                       unified["token_lists"]) if a != b)
+    if parity_bad:
+        failures.append(
+            f"{parity_bad} requests' greedy tokens changed across the"
+            " prefill->decode handoff (vs the unified fleet)")
+    handed = disagg.get("repeats_handed_off_min",
+                        ho["requests_handed_off"])
+    if handed < len(workload):
+        failures.append(
+            f"only {handed} of {len(workload)} routed requests were"
+            " handed off to the decode pool (the rest resumed locally)")
+    if ho["committed_run"] < len(workload):
+        failures.append(
+            f"{ho['committed_run']} committed handoffs for"
+            f" {len(workload)} requests — every routed request must ship"
+            f" its KV once (last_error: {ho['last_error']})")
+    if not (ho["last_predicted_us"] or 0.0) > 0.0:
+        failures.append(
+            "handoffs were not priced: ff_disagg_predicted_transfer_us"
+            " stayed 0 despite a machine model")
+    missing = [n for n in ("ff_disagg_handoffs_total",
+                           "ff_disagg_handoff_bytes_total",
+                           "ff_disagg_handoff_ms")
+               if n not in ho["disagg_families"]]
+    if missing:
+        failures.append(f"disagg metric families missing from the fleet"
+                        f" exposition: {missing}")
+    tr = disagg["trace"]
+    if tr["stitched"] < len(workload):
+        failures.append(
+            f"handoff trace continuity broken: only {tr['stitched']} of"
+            f" {len(workload)} requests have a fleet.kv_handoff span"
+            f" under their own trace_id (unstitched:"
+            f" {tr['unstitched'][:4]})")
+    ratio = (unified["itl_ms_p99"] / disagg["itl_ms_p99"]
+             if disagg["itl_ms_p99"] > 0 else 0.0)
+    print(f"[serve-bench] disagg win: unified p99 ITL / disagg p99 ITL ="
+          f" {ratio:.2f}x ({unified['itl_ms_p99']} /"
+          f" {disagg['itl_ms_p99']} ms; require >="
+          f" {args.disagg_margin}x)")
+    if ratio < args.disagg_margin:
+        failures.append(
+            f"disaggregation did not protect the decode tail: p99 ITL"
+            f" ratio {ratio:.2f}x < required {args.disagg_margin}x")
+
+    report = {
+        "bench": "serving_disagg",
+        "config": vars(args),
+        "chips": n_rep,
+        "machine": spec,
+        "unified": {k: v for k, v in unified.items()
+                    if k != "token_lists"},
+        "disagg": {k: v for k, v in disagg.items() if k != "token_lists"},
+        "unified_over_disagg_itl_p99": round(ratio, 3),
+        "parity_mismatches_vs_unified": parity_bad,
+        # THE pinned numbers: what phase separation buys the decode tail
+        # at equal chips, and what one KV shipment costs
+        "pinned": {
+            "itl_ms_p99_unified": unified["itl_ms_p99"],
+            "itl_ms_p99_disagg": disagg["itl_ms_p99"],
+            "itl_p99_win_x": round(ratio, 3),
+            "handoffs_committed": ho["committed_run"],
+            "handoff_bytes_per_token": ho["bytes_per_token"],
+            "handoff_predicted_us": ho["last_predicted_us"],
+        },
+    }
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"[serve-bench] report -> {args.report}")
+    if failures:
+        for f in failures:
+            print(f"[serve-bench] FAIL: {f}")
+        return 1
+    print("[serve-bench] OK")
+    return 0
+
+
 def run_fleet_cli(args) -> int:
     """The `serve-bench --workload fleet` entry (dispatched from
     serving/sched/bench.py)."""

@@ -50,7 +50,8 @@ class Replica:
     """
 
     def __init__(self, name: str, model, registry: Optional[MetricsRegistry]
-                 = None, start: bool = True, **batcher_kw):
+                 = None, start: bool = True, role: str = "unified",
+                 **batcher_kw):
         self.name = str(name)
         self.registry = MetricsRegistry() if registry is None else registry
         self._lock = threading.Lock()
@@ -60,7 +61,14 @@ class Replica:
         # replica name, so a merged post-mortem timeline shows one track
         # per replica (metric labels keep the pool's own label)
         batcher_kw.setdefault("trace_label", self.name)
+        # disaggregated serving (docs/serving.md): 'prefill' replicas
+        # park every request after its first token for the KV-handoff
+        # plane, 'decode' replicas serve imported sequences, 'unified'
+        # is the classic both-phases replica. The batcher enforces the
+        # role's scheduling semantics; the Router routes by it.
+        batcher_kw.setdefault("role", role)
         self.batcher = ContinuousBatcher(model, **batcher_kw)
+        self.role = self.batcher.role
         if start:
             self.batcher.start()
 
@@ -102,9 +110,10 @@ class Replica:
 
     # -- traffic (router-facing) -------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
-               seed: int = 0):
+               seed: int = 0, prefill_only: bool = False):
         return self.batcher.submit(prompt_ids, max_new_tokens,
-                                   eos_id=eos_id, seed=seed)
+                                   eos_id=eos_id, seed=seed,
+                                   prefill_only=prefill_only)
 
     def cancel(self, req) -> bool:
         return self.batcher.cancel(req)
@@ -170,6 +179,29 @@ class Replica:
     def utilization(self) -> float:
         return self.batcher.pool.utilization()
 
+    def prefill_backlog_s(self) -> float:
+        """Queued prefill work in seconds at the measured rate — the
+        prefill pool's saturation signal (ContinuousBatcher
+        .prefill_backlog_s)."""
+        return self.batcher.prefill_backlog_s()
+
+    def itl_window(self):
+        """ff_serving_itl_ms Histogram.snapshot — the baseline the
+        role-scoped autoscaler passes back to `itl_p99_ms(since=)` so
+        the decode pool's latency signal covers a recent window."""
+        fam = self.registry.get("ff_serving_itl_ms")
+        return None if fam is None else fam.snapshot()
+
+    def itl_p99_ms(self, since=None) -> float:
+        """Observed p99 inter-token latency from this replica's own
+        registry — the decode pool's saturation signal (pages-used is
+        capacity; ITL is what the user feels when decode batches
+        thicken)."""
+        fam = self.registry.get("ff_serving_itl_ms")
+        if fam is None:
+            return 0.0
+        return fam.quantile(0.99, since=since)
+
     def ttft_window(self) -> Dict[str, tuple]:
         """{cache label: Histogram.snapshot row} for ff_serving_ttft_ms —
         the baseline the autoscaler passes back to `ttft_p99_ms(since=)`
@@ -199,6 +231,7 @@ class Replica:
         b = self.batcher
         return {
             "state": self.state.value,
+            "role": self.role,
             "num_slots": b.num_slots,
             "queue_depth": b.admission.queue_depth(),
             "live_sequences": b.pool.live_sequences(),

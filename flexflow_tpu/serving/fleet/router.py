@@ -150,6 +150,25 @@ class FleetRequest:
             self._version += 1
             self._cv.notify_all()
 
+    def _handoff_rebind(self, replica_name: str, inner, base: List[int],
+                        base_times: List[float],
+                        t_first: Optional[float]) -> None:
+        """Disagg KV-handoff bind (fleet/disagg.py): `inner` is the
+        decode replica's imported continuation, `base` the token(s) the
+        prefill replica emitted before parking. Counts as a handoff,
+        not a failover — nothing died; the stream stitches exactly like
+        a failover rebind (base ‖ continuation)."""
+        with self._cv:
+            self.handoffs += 1
+            self._base = list(base)
+            self._base_times = list(base_times)
+            if self._t_first is None:
+                self._t_first = t_first
+            self._inner = inner
+            self._replica = replica_name
+            self._version += 1
+            self._cv.notify_all()
+
     def _finalize(self, base: List[int], base_times: List[float],
                   t_first: Optional[float]) -> None:
         """The fence snapshot already completed the request (budget hit
@@ -390,6 +409,16 @@ class Router:
             raise ValueError(
                 f"degraded_slo_factor={degraded_slo_factor}: need (0, 1]")
         self.degraded_slo_factor = float(degraded_slo_factor)
+        # disaggregated serving: the DisaggCoordinator (fleet/disagg.py)
+        # installs its priced-transfer predictor here so the SLO gate
+        # charges prefill-role candidates the KV-handoff leg the request
+        # will pay before its decode stream starts (prompt_len -> s)
+        self.predicted_handoff_s: Optional[Callable[[int], float]] = None
+        # the owning DisaggCoordinator, when this router fronts a
+        # disaggregated fleet (repository.py sets it): shutdown() stops
+        # the handoff plane FIRST so queued handoffs resume locally
+        # before the replicas they would resume on are stopped
+        self.disagg = None
         self.registry = MetricsRegistry() if registry is None else registry
         self.events = event_log
         # called with (name, exception) when a replica factory fails —
@@ -401,8 +430,11 @@ class Router:
         self._failed_loads: Dict[str, str] = {}
         # replicas declared DEAD and evicted, not yet respawned: the
         # autoscaler reads this to respawn from its factory, health()
-        # reports degraded while it is non-empty
+        # reports degraded while it is non-empty. _lost_roles remembers
+        # each casualty's serving role so role-scoped autoscalers (one
+        # per pool in a disaggregated fleet) respawn only their own
         self._lost_replicas: Dict[str, str] = {}
+        self._lost_roles: Dict[str, str] = {}
         # route key -> replica name, LRU-bounded at max_affinity_keys
         # (lifetime-unique tenants must not grow router memory without
         # bound); _homes mirrors it as a per-replica key count so the
@@ -592,6 +624,14 @@ class Router:
         if not ready:
             self._c_shed.inc(reason=FleetUnavailable.reason)
             raise FleetUnavailable(f"{len(self._replicas)} registered")
+        # disaggregated serving: decode-role replicas receive work only
+        # through the KV-handoff plane (fleet/disagg.py) — fresh traffic
+        # routes to prefill/unified replicas. They stay a last resort:
+        # if every non-decode replica is gone, a decode-role batcher
+        # still serves both phases end to end (zero-drop beats purity).
+        front = [(n, r) for n, r in ready if r.role != "decode"]
+        if front:
+            ready = front
         chain = prefix_route_chain(prompt, self._page_size) \
             if self._page_size else []
         key = chain[min(self.route_depth, len(chain)) - 1] if chain else ""
@@ -617,7 +657,11 @@ class Router:
                 with self._lock:
                     if self._lost_replicas:
                         slo *= self.degraded_slo_factor
+                hand = self.predicted_handoff_s
                 preds = [r.predicted_ttft_s(prompt.size, shared_tokens=sh)
+                         + (hand(prompt.size)
+                            if hand is not None and r.role == "prefill"
+                            else 0.0)
                          for _, r, sh in order]
                 kept = [c for c, p in zip(order, preds) if p <= slo]
                 if not kept:
@@ -660,6 +704,33 @@ class Router:
         if rep is None or inner is None:
             return False
         return rep.cancel(inner)
+
+    # -- disagg handoff (fleet/disagg.py) ----------------------------------
+    def outstanding_for(self, name: str) -> List[FleetRequest]:
+        """Live FleetRequests currently homed on `name` — how the
+        DisaggCoordinator maps a parked GenRequest back to the caller's
+        fleet handle (GenRequest ids are per-batcher, so the match is
+        by inner identity, not id)."""
+        with self._lock:
+            return [f for f in self._outstanding.get(name, ())
+                    if not f.done()]
+
+    def rebind_handoff(self, fr: FleetRequest, to_name: str, inner,
+                       base: List[int], base_times: List[float],
+                       t_first: Optional[float]) -> None:
+        """Move a FleetRequest onto its decode replica after a KV
+        handoff: rebind the caller's handle to the imported continuation
+        and re-home it in the outstanding map, so a later drain or
+        failover of the DECODE replica finds it there. Must run BEFORE
+        the prefill side releases the parked original (release_parked
+        finishes the old inner — a consumer snapshotting in between
+        would see a finished stream with no continuation bound)."""
+        fr._handoff_rebind(to_name, inner, base, base_times, t_first)
+        with self._lock:
+            for pend in self._outstanding.values():
+                pend[:] = [f for f in pend if f is not fr]
+            self._outstanding.setdefault(to_name, []).append(fr)
+        self._c_handoffs.inc()
 
     # -- drain / removal ---------------------------------------------------
     def drain(self, name: str) -> Dict[str, int]:
@@ -802,6 +873,7 @@ class Router:
                 (k, v) for k, v in self._affinity.items() if v != name)
             self._homes.pop(name, None)
             self._lost_replicas[name] = reason
+            self._lost_roles[name] = rep.role
         err = error if error is not None else ReplicaLost(
             f"replica {name!r} declared dead ({reason})")
         rep.kill(err)
@@ -892,14 +964,23 @@ class Router:
         with self._lock:
             return dict(self._lost_replicas)
 
+    def lost_replica_roles(self) -> Dict[str, str]:
+        """{name: role} of failed-over replicas — lets a role-scoped
+        autoscaler respawn only its own pool's casualties."""
+        with self._lock:
+            return dict(self._lost_roles)
+
     def clear_lost(self, name: str) -> None:
         """Forget a lost replica (its replacement is up): health()
         returns to "ok" and the SLO budget un-tightens."""
         with self._lock:
             self._lost_replicas.pop(name, None)
+            self._lost_roles.pop(name, None)
         self._sync_replica_gauge()
 
     def shutdown(self) -> None:
+        if self.disagg is not None:
+            self.disagg.stop()
         with self._lock:
             reps = list(self._replicas.values())
         for r in reps:

@@ -42,6 +42,15 @@ config.json fields:
                  A replica that fails to construct is recorded
                  (ff_model_load_failures_total under "<name>/<replica>",
                  /healthz degraded) while the rest keep serving.
+                 {"mode": "disagg", ...} instead builds a DISAGGREGATED
+                 fleet (docs/serving.md): prefill_replicas /
+                 decode_replicas phase-specialized pools bridged by the
+                 DisaggCoordinator's priced KV-handoff plane, with
+                 machine_spec (path or inline dict) pricing each
+                 shipment and handoff_wait_s bounding export/import
+                 ticket waits. Same batcher knobs; policy defaults to
+                 "least_loaded"; speculative is rejected (a prefill
+                 replica never decodes).
 """
 from __future__ import annotations
 
@@ -152,6 +161,12 @@ class ModelRepository:
                 cfg = self.config(name)
                 model = self.build(name, cfg)
                 serving = cfg.get("serving") or {}
+                if serving.get("mode") == "disagg":
+                    self._register_disagg(
+                        server, name, model, serving,
+                        model_dir=os.path.join(self.path, name))
+                    loaded.append(name)
+                    continue
                 if serving.get("mode") == "fleet":
                     # speculative decoding: the draft is its OWN model
                     # entry (built, never registered here) scoring
@@ -252,6 +267,99 @@ class ModelRepository:
             server.unregister(name)
             raise RuntimeError(
                 f"{name}: all {n} fleet replicas failed to load")
+
+    @staticmethod
+    def _register_disagg(server, name: str, model, serving: dict,
+                         model_dir: str) -> None:
+        """Build a DISAGGREGATED serving fleet from one repository entry
+        (docs/serving.md "Disaggregated serving"): a prefill pool and a
+        decode pool of continuous-batching replicas behind one Router,
+        bridged by the DisaggCoordinator's priced KV-handoff plane.
+        Fresh requests route to the prefill pool, run chunked prefill to
+        completion, and ship their finished KV pages to the least-loaded
+        decode replica — token-identical to unified serving, with every
+        failure mode degrading to local decode (zero-drop). Keys:
+        prefill_replicas / decode_replicas (default 1 each), max_len
+        (required), the per-replica batcher knobs the fleet mode shares,
+        policy (default "least_loaded" — prefix affinity has no cross-
+        pool meaning when every decode entry arrives with its KV), and
+        machine_spec (optional hierarchical machine JSON — a path,
+        resolved against the model dir, or an inline dict — pricing each
+        handoff at the outermost tier the pools span; without it
+        shipments are gated but unpriced)."""
+        from .fleet import DisaggCoordinator, Replica, Router
+
+        if "max_len" not in serving:
+            raise ValueError(
+                f"{name}: disagg serving config needs 'max_len' (the"
+                " per-slot KV cache span)")
+        if serving.get("speculative"):
+            raise ValueError(
+                f"{name}: serving.speculative is not supported with"
+                " mode 'disagg' — a prefill replica never decodes, so a"
+                " draft model there could never verify")
+        n_pre = int(serving.get("prefill_replicas", 1))
+        n_dec = int(serving.get("decode_replicas", 1))
+        if n_pre < 1 or n_dec < 1:
+            raise ValueError(
+                f"{name}: prefill_replicas={n_pre},"
+                f" decode_replicas={n_dec}: need >= 1 each")
+        slo_ms = serving.get("slo_ttft_ms")
+        router = Router(
+            policy=str(serving.get("policy", "least_loaded")),
+            slo_ttft_s=None if slo_ms is None else float(slo_ms) / 1e3)
+        batcher_kw = {
+            k: serving[k]
+            for k in ("max_len", "num_slots", "page_size",
+                      "prefill_chunk_tokens", "prefix_cache_pages",
+                      "max_queue")
+            if k in serving
+        }
+        machine = None
+        spec = serving.get("machine_spec")
+        if spec:
+            from ..search.machine_model import (HierarchicalMachineModel,
+                                                load_machine_spec)
+
+            if isinstance(spec, str) and not os.path.isabs(spec):
+                spec = os.path.join(model_dir, spec)
+            machine = HierarchicalMachineModel.from_json(
+                load_machine_spec(spec))
+        device_ids = tuple(range(machine.num_chips)) \
+            if machine is not None else (0,)
+        coordinator = DisaggCoordinator(
+            router, machine=machine, device_ids=device_ids,
+            wait_s=float(serving.get("handoff_wait_s", 30.0)))
+        # register FIRST (load-failure hook), wire the coordinator into
+        # the router's shutdown so unregister() drains the handoff plane
+        # before stopping the replicas queued requests would resume on
+        server.register_fleet(name, router)
+        router.disagg = coordinator
+
+        def prefill_factory(i: int) -> Replica:
+            rep = Replica(f"prefill{i}", model, role="prefill",
+                          **batcher_kw)
+            coordinator.wire(rep)
+            return rep
+
+        for i in range(n_pre):
+            router.add_replica(f"prefill{i}",
+                               lambda i=i: prefill_factory(i))
+        for i in range(n_dec):
+            router.add_replica(
+                f"decode{i}",
+                lambda i=i: Replica(f"decode{i}", model, role="decode",
+                                    **batcher_kw))
+        roles = {n: router.replica(n).role for n in router.replica_names()}
+        if "prefill" not in roles.values() \
+                or "decode" not in roles.values():
+            server.unregister(name)
+            raise RuntimeError(
+                f"{name}: a disagg fleet needs at least one prefill AND"
+                f" one decode replica up (loaded: {roles})")
+        # installs the priced-transfer SLO charge; prefill replicas are
+        # already wired by their factories (re-wiring is idempotent)
+        coordinator.attach_all()
 
     def unload(self, server, name: str) -> None:
         server.unregister(name)

@@ -877,7 +877,7 @@ def run_bench(argv=None) -> int:
         description="continuous-batching vs lockstep serving load test")
     ap.add_argument("--workload", default="mixed",
                     choices=("mixed", "shared-prefix", "long-prefill",
-                             "mesh-resize", "fleet", "chaos",
+                             "mesh-resize", "fleet", "chaos", "disagg",
                              "speculative", "moe"))
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-min", type=int, default=8)
@@ -973,6 +973,18 @@ def run_bench(argv=None) -> int:
                          " flight-recorder post-mortem bundle, and the"
                          " merged Perfetto timeline; also arms the"
                          " failover trace-continuity assert (chaos)")
+    # disagg workload (serving/fleet/disagg.py, ISSUE 20): the same
+    # prefill-heavy stream through a unified fleet and a prefill/decode
+    # split at equal chips; the split must protect the decode tail while
+    # every request's KV ships through one priced, traced handoff
+    ap.add_argument("--disagg-margin", type=float, default=1.2,
+                    help="require unified p99 ITL / disagg p99 ITL >="
+                         " this (disagg)")
+    ap.add_argument("--machine-spec", default=None,
+                    help="hierarchical machine JSON pricing the KV"
+                         " handoff (disagg; default: a built-in 2x8"
+                         " two-pod spec mirroring"
+                         " examples/machines/multipod_2x8.json)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="static routing runs per policy; the best"
                          " steady-state p99 of each is compared (fleet —"
@@ -1025,6 +1037,10 @@ def run_bench(argv=None) -> int:
         from ..fleet.bench import run_chaos_cli
 
         return run_chaos_cli(args)
+    if args.workload == "disagg":
+        from ..fleet.bench import run_disagg_cli
+
+        return run_disagg_cli(args)
 
     window = args.prompt_max
     max_len = args.prompt_max + args.out_max

@@ -108,10 +108,51 @@ class ResizeTicket:
         self._done.set()
 
 
+class HandoffTicket:
+    """Handle for one scheduler-thread KV-handoff step: an EXPORT of a
+    parked sequence's cache rows to host memory, or an IMPORT of shipped
+    rows into this batcher's caches as a decode-entry request. Like
+    ResizeTicket, the work runs between scheduler iterations — the cache
+    arrays are jit-donated, so only the scheduler thread may touch them —
+    and `wait()` blocks until it resolves. Failures are typed: admission
+    errors and `KVGeometryMismatch` land here, never in the loop."""
+
+    def __init__(self):
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"KV handoff step not applied within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _finish(self, result) -> None:
+        self.result = result
+        self._done.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        self._done.set()
+
+
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    # disaggregated serving (docs/serving.md "Disaggregated serving"): a
+    # prefill-only request holds this state after its first token — KV
+    # complete and resident, slot + pages held, NOT decoding — until the
+    # fleet handoff plane exports its pages to a decode replica
+    # (release_parked) or the handoff fails and it degrades to local
+    # decode (resume_parked)
+    PARKED = "parked"
     FINISHED = "finished"
     FAILED = "failed"
 
@@ -150,6 +191,10 @@ class GenRequest:
         # anti-starvation bound)
         self.expert_sig = frozenset()
         self.affinity_skips = 0
+        # disaggregated serving: a prefill-only request parks after its
+        # first token instead of entering DECODE — the fleet handoff
+        # plane ships its finished KV to a decode replica
+        self.prefill_only = False
         # distributed-tracing handoff (obs/tracing.py): submit() stamps
         # the caller's TraceContext here as a Handoff token; the
         # scheduler thread resumes it around this request's spans, so
@@ -332,7 +377,8 @@ class ContinuousBatcher:
                  draft_model=None, spec_tokens: int = 3,
                  expert_affinity: bool = False,
                  affinity_window: int = 4,
-                 trace_label: Optional[str] = None):
+                 trace_label: Optional[str] = None,
+                 role: str = "unified"):
         if getattr(model.executor, "mesh", None) is not None:
             # a mesh is fine as long as nothing is actually partitioned
             # (the common replicated case — e.g. a dp axis the batch does
@@ -378,6 +424,22 @@ class ContinuousBatcher:
             raise ValueError(f"temperature={temperature}: must be >= 0")
         self.temperature = float(temperature)
         self.top_k = top_k
+        # disaggregated serving role (docs/serving.md "Disaggregated
+        # serving"): "prefill" parks every request after its first token
+        # for the fleet KV-handoff plane (and charges no decode leg in
+        # predicted_ttft_s — nothing decodes here); "decode" serves
+        # imported sequences beside normal traffic; "unified" is the
+        # classic both-phases replica.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role={role!r}: must be 'prefill', 'decode' or"
+                " 'unified'")
+        if role == "prefill" and draft_model is not None:
+            raise ValueError(
+                "role='prefill' cannot speculate: a parked request never"
+                " decodes here, and the draft's caches do not ship in"
+                " the KV handoff")
+        self.role = role
         self.attn_ops = [op for op in model.graph.ops.values()
                          if op.op_type == OpType.MULTIHEAD_ATTENTION]
         if not self.attn_ops:
@@ -558,6 +620,15 @@ class ContinuousBatcher:
         # lifetime generated-token count — the chaos plan's
         # crash-at-token-N trigger reads this, monotonic and cheap
         self.tokens_emitted = 0
+        # disaggregated serving (docs/serving.md): parked prefill-only
+        # requests awaiting KV handoff, the hook the fleet coordinator
+        # registers to hear about them, and the scheduler-thread work
+        # queue for export/import steps (the cache arrays are
+        # jit-donated — only the loop may touch them, same rule as
+        # _maybe_resize)
+        self._parked: Dict[int, _Slot] = {}
+        self.on_parked = None
+        self._pending_handoffs: List[tuple] = []
         # mesh resize (docs/resharding.md): one pending ticket at a time,
         # applied by the scheduler thread between iterations
         self._pending_resize: Optional[ResizeTicket] = None
@@ -883,6 +954,17 @@ class ContinuousBatcher:
         self._install_fn = jax.jit(install_prefix, donate_argnums=(0,))
         self._insert_fn = jax.jit(insert_pages, donate_argnums=(0,))
 
+        def import_span(caches, small, slot):
+            """KV-handoff import (disagg): scatter a shipped sequence's
+            padded (1, max_len) row span into pool slot `slot` — the same
+            donated one-dispatch install the fused prefill finish uses,
+            so an import stalls the decode loop no longer than a chunk
+            scatter does (per-array eager updates would serialize the
+            dispatch queue once per cache array)."""
+            return scatter_span(caches, small, slot, attn_names)
+
+        self._import_fn = jax.jit(import_span, donate_argnums=(0,))
+
         if self.draft_model is None:
             return
         # -- speculative decoding (draft + fused multi-query verify) ----
@@ -1010,6 +1092,8 @@ class ContinuousBatcher:
             # second loop over the same (donated) cache arrays
         self._drain_queue(BatcherStopped("batcher stopped"))
         self._fail_pending_resize(BatcherStopped("batcher stopped"))
+        self._fail_pending_handoffs(BatcherStopped("batcher stopped"))
+        self._fail_parked(BatcherStopped("batcher stopped"))
 
     def abort(self, err: BaseException) -> None:
         """Non-blocking kill for a replica declared DEAD: fence every
@@ -1025,6 +1109,7 @@ class ContinuousBatcher:
         with self._cv:
             self._running = False
             slots, self._slots = list(self._slots), [None] * self.num_slots
+            self._parked.clear()
             self._cv.notify_all()
         for s in slots:
             if s is None:
@@ -1036,6 +1121,7 @@ class ContinuousBatcher:
             s.req._fence(err)
         self._drain_queue(err)
         self._fail_pending_resize(err)
+        self._fail_pending_handoffs(err)
         self._g_active.set(0, pool=self.pool.label)
 
     def __enter__(self):
@@ -1047,12 +1133,23 @@ class ContinuousBatcher:
 
     # -- client API --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               eos_id: Optional[int] = None, seed: int = 0) -> GenRequest:
+               eos_id: Optional[int] = None, seed: int = 0,
+               prefill_only: bool = False) -> GenRequest:
         """Admit one request (prompt_ids: (L,) or (1, L) int tokens).
         Raises an AdmissionError subclass on rejection; otherwise returns
-        a GenRequest whose stream()/result() deliver the tokens."""
+        a GenRequest whose stream()/result() deliver the tokens.
+
+        prefill_only (implied by role='prefill'): the request runs its
+        prefill and emits its FIRST token, then PARKS — KV resident,
+        slot held, no decoding — for the fleet KV-handoff plane
+        (`request_export` / `release_parked` / `resume_parked`)."""
         from ...obs.tracing import get_tracer
 
+        prefill_only = bool(prefill_only) or self.role == "prefill"
+        if prefill_only and self.draft_model is not None:
+            raise ValueError(
+                "prefill_only cannot speculate: the draft's caches do"
+                " not ship in the KV handoff")
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -1081,6 +1178,7 @@ class ContinuousBatcher:
                 self.admission.admit(rid, prompt.size, max_new_tokens,
                                      shared_pages=shared_pages)
             req = GenRequest(rid, prompt, max_new_tokens, eos_id, seed)
+            req.prefill_only = prefill_only
             # capture the caller's TraceContext as an explicit handoff:
             # the scheduler thread resumes it (None when tracing is off)
             req.trace = get_tracer().handoff("serve.submit")
@@ -1136,6 +1234,239 @@ class ContinuousBatcher:
             self._pending_resize = ticket
             self._cv.notify_all()
         return ticket
+
+    # -- disaggregated KV handoff (serving/fleet/disagg.py) ----------------
+    # The prefill side parks finished requests (`_first_token`); the
+    # coordinator then drives: request_export -> ship rows -> the decode
+    # replica's request_import -> release_parked (or resume_parked on any
+    # failure). Export/import run on the scheduler thread between
+    # iterations — the cache arrays are jit-donated, so no other thread
+    # may read or write them (the _maybe_resize rule).
+
+    def parked_requests(self) -> List[GenRequest]:
+        with self._cv:
+            return [s.req for s in self._parked.values()]
+
+    def request_export(self, req: GenRequest) -> HandoffTicket:
+        """Schedule a host-side export of a PARKED request's finished KV
+        rows. Resolves with {"desc", "rows", "plen", "last_tok",
+        "bytes"}: `desc` is the pool's geometry-checked page descriptor
+        (`PagedKVPool.export_sequence`), `rows` maps "op/part" to the
+        (plen, heads, dim) host array of exactly the rows the page table
+        owns. The request STAYS parked — a failed ship can still
+        resume_parked with nothing lost."""
+        ticket = HandoffTicket()
+        with self._cv:
+            if not self._running:
+                raise BatcherStopped("batcher is not running")
+            self._pending_handoffs.append(("export", ticket, req))
+            self._cv.notify_all()
+        return ticket
+
+    def request_import(self, desc: Dict, rows: Dict, prompt,
+                       last_tok: int, max_new_tokens: int,
+                       eos_id: Optional[int] = None, seed: int = 0,
+                       trace=None) -> HandoffTicket:
+        """Schedule the decode-entry IMPORT of a shipped sequence: the
+        scheduler installs `rows` into a freshly allocated slot and the
+        request enters DECODE with ZERO recompute — `max_new_tokens` is
+        the REMAINING budget (the prefill side already emitted the first
+        token), `last_tok` seeds the first decode step, and greedy/
+        per-request-keyed sampling make the continuation token-identical
+        to unified serving (decode is a pure function of cache rows,
+        absolute positions and the request's own seed). Resolves with
+        the new GenRequest; fails typed — AdmissionError subclasses when
+        this replica sheds, `KVGeometryMismatch` when the exporter's
+        page regime differs (kvpool.py)."""
+        ticket = HandoffTicket()
+        payload = {"desc": desc, "rows": rows,
+                   "prompt": np.asarray(prompt, np.int32),
+                   "last_tok": int(last_tok),
+                   "max_new_tokens": int(max_new_tokens),
+                   "eos_id": eos_id, "seed": int(seed), "trace": trace}
+        with self._cv:
+            if not self._running:
+                raise BatcherStopped("batcher is not running")
+            self._pending_handoffs.append(("import", ticket, payload))
+            self._cv.notify_all()
+        return ticket
+
+    def resume_parked(self, req: GenRequest) -> bool:
+        """Fallback: convert a PARKED request back to local decoding
+        (the replica degrades to unified for this request). Zero-drop
+        safety net for every handoff failure mode — no decode replica,
+        shed on import, geometry mismatch, coordinator crash. Returns
+        False when the request is no longer parked (already released,
+        failed over, or resumed)."""
+        with self._cv:
+            s = self._parked.pop(req.id, None)
+            if s is None or req.state is not RequestState.PARKED:
+                return False
+            req.state = RequestState.DECODE
+            self._cv.notify_all()
+        return True
+
+    def release_parked(self, req: GenRequest) -> bool:
+        """The handoff COMMITTED on the decode side: free the parked
+        request's slot, pages and admission reservation here, and close
+        the local handle with `RequestCancelled` — NOT a clean finish.
+        The caller's FleetRequest has already rebound to the decode
+        continuation, and it treats RequestCancelled as
+        "await the rebind": a consumer blocked on THIS handle wakes,
+        sees the typed error, and retries on the new incarnation. A
+        clean _finish() would instead read as a complete 1-token answer
+        to any consumer that snapshotted before the rebind. Returns
+        False when the request is no longer parked."""
+        with self._cv:
+            s = self._parked.pop(req.id, None)
+            if s is None:
+                return False
+            self._slots[s.slot] = None
+        self.pool.free(req.id)
+        self.admission.release(req.id)
+        self._completed += 1
+        self._c_requests.inc(outcome="handed_off")
+        self._sync_active_gauge()
+        req._fail(RequestCancelled(
+            f"request {req.id} handed off to a decode replica"))
+        with self._cv:
+            self._cv.notify_all()
+        return True
+
+    def _runnable_locked(self) -> bool:
+        """Any slot that still schedules work (caller holds _cv): PARKED
+        slots hold pages but neither prefill nor decode."""
+        return any(s is not None
+                   and s.req.state is not RequestState.PARKED
+                   for s in self._slots)
+
+    def _process_handoffs(self, tracer) -> None:
+        """Run queued export/import steps (scheduler thread only). A
+        failing step fails ITS ticket — typed admission/geometry errors
+        are the coordinator's routing signals, never loop kills."""
+        with self._cv:
+            work, self._pending_handoffs = self._pending_handoffs, []
+        for kind, ticket, payload in work:
+            try:
+                if kind == "export":
+                    ticket._finish(self._export_parked(payload, tracer))
+                else:
+                    ticket._finish(self._import_one(tracer, **payload))
+            except Exception as e:
+                ticket._fail(e)
+
+    def _export_parked(self, req: GenRequest, tracer) -> Dict:
+        """Gather a parked request's owned cache rows to host numpy
+        (scheduler thread only — see _process_handoffs)."""
+        with self._cv:
+            s = self._parked.get(req.id)
+        if s is None or req.state is not RequestState.PARKED:
+            raise KeyError(f"request {req.id} is not parked")
+        desc = self.pool.export_sequence(req.id)
+        plen = int(s.plen)
+        with tracer.resume(req.trace), \
+                tracer.span("serve.kv_export", request=req.id,
+                            tokens=plen):
+            rows = {
+                f"{name}/{part}": np.asarray(arr[s.slot, :plen])
+                for name, pair in self._caches.items()
+                for part, arr in pair.items()
+            }
+        return {"desc": desc, "rows": rows, "plen": plen,
+                "last_tok": int(s.last_tok),
+                "bytes": int(sum(r.nbytes for r in rows.values()))}
+
+    def _import_one(self, tracer, desc, rows, prompt, last_tok,
+                    max_new_tokens, eos_id, seed, trace) -> GenRequest:
+        """Install a shipped sequence as a decode-entry request
+        (scheduler thread only — see request_import for the contract)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .kvpool import KVGeometryMismatch
+
+        plen = int(desc["n_tokens"])
+        for name, pair in self._caches.items():
+            for part, arr in pair.items():
+                src = rows.get(f"{name}/{part}")
+                want = (plen,) + tuple(int(d) for d in arr.shape[2:])
+                if src is None or tuple(src.shape) != want:
+                    raise KVGeometryMismatch(
+                        f"kv_rows[{name}/{part}]",
+                        None if src is None else tuple(src.shape), want)
+        rid = next(self._rid)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens}: an import with no"
+                " remaining budget has nothing to decode")
+        self.admission.admit(rid, plen, max_new_tokens)
+        try:
+            slot_idx = self.pool.import_sequence(desc, seq_id=rid)
+        except BaseException:
+            self.admission.release(rid)
+            raise
+        req = GenRequest(rid, np.asarray(prompt, np.int32),
+                         max_new_tokens, eos_id, seed)
+        req.trace = trace
+        # the KV arrived fully materialized: admission must never charge
+        # this request a prefill leg (predicted_ttft_s, own == 0)
+        req.cache_hit = True
+        req.prefix_tokens = plen
+        req.queue_wait_s = self.admission.on_scheduled(rid)
+        key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        s = _Slot(req, slot_idx, key)
+        s.plen = s.filled = s.pos = plen
+        s.last_tok = int(last_tok)
+        with tracer.resume(trace), \
+                tracer.span("serve.kv_import", request=rid, tokens=plen):
+            # pad each shipped span to (1, max_len) rows and scatter the
+            # whole slot in ONE jitted donated dispatch (rows past plen
+            # are zeros — stale by definition, decode overwrites row plen
+            # before any query can attend it)
+            small = {}
+            for name, pair in self._caches.items():
+                sm = {}
+                for part, arr in pair.items():
+                    pad = np.zeros(
+                        (1, self.max_len)
+                        + tuple(int(d) for d in arr.shape[2:]),
+                        dtype=arr.dtype)
+                    pad[0, :plen] = rows[f"{name}/{part}"]
+                    sm[part] = jnp.asarray(pad)
+                small[name] = sm
+            self._caches = self._import_fn(self._caches, small, slot_idx)
+        req.state = RequestState.DECODE
+        req.t_first_token = time.monotonic()
+        with self._cv:
+            self._slots[slot_idx] = s
+            self._cv.notify_all()
+        self._sync_active_gauge()
+        return req
+
+    def _fail_pending_handoffs(self, err: BaseException) -> None:
+        with self._cv:
+            work, self._pending_handoffs = self._pending_handoffs, []
+        for _, ticket, _ in work:
+            if not ticket.done():
+                ticket._fail(err)
+
+    def _fail_parked(self, err: BaseException) -> None:
+        """Fail every still-parked request (stop/crash paths): fence so
+        the fleet replay sees the frozen first-token snapshot, release
+        the pool and admission state."""
+        with self._cv:
+            parked, self._parked = dict(self._parked), {}
+            for s in parked.values():
+                if self._slots[s.slot] is s:
+                    self._slots[s.slot] = None
+        for s in parked.values():
+            self.pool.free(s.req.id)
+            self.admission.release(s.req.id)
+            self._failed += 1
+            self._c_requests.inc(outcome="failed")
+            s.req._fence(err)
+        if parked:
+            self._sync_active_gauge()
 
     # -- fleet probes ------------------------------------------------------
     # The router tier (serving/fleet/) routes and sheds on these three
@@ -1248,6 +1579,18 @@ class ContinuousBatcher:
         matched = self.pool.prefix.match_chain(chain) * self.pool.page_size
         return int(min(matched, max(int(prompt_len) - 1, 0)))
 
+    def prefill_backlog_s(self) -> float:
+        """Queued prefill work in seconds at the MEASURED prefill rate
+        (0.0 until the EWMA calibrates) — the prefill pool's saturation
+        currency for the role-scoped autoscaler: a prefill replica's
+        overload shows up as backlog-seconds growth long before its
+        pages fill (parked requests hold pages briefly; the queue is
+        where pressure accumulates)."""
+        per_tok = self._ewma_prefill_s_per_tok
+        if per_tok is None:
+            return 0.0
+        return self.queued_prefill_tokens() * per_tok
+
     def queued_prefill_tokens(self) -> int:
         """Prompt tokens admitted but not yet prefilled: the whole wait
         queue plus the unfilled remainder of every slot still in the
@@ -1276,8 +1619,16 @@ class ContinuousBatcher:
         leg additionally credits the draft's doubled prefill dispatches
         at the draft's own measured per-token cost. A cold batcher (no
         samples yet) predicts 0 and admits — the estimate only starts
-        shedding once it is backed by measurements."""
-        own = max(1, int(prompt_len) - max(0, int(shared_tokens)))
+        shedding once it is backed by measurements.
+
+        own = 0 — a request whose KV is ALREADY materialized (a
+        prefix-band hit covering the whole prompt, or a disaggregated
+        KV import) — is admitted on the decode legs only: charging it
+        the prefill-EWMA leg would shed servable traffic. A replica
+        with role='prefill' conversely charges NO decode leg: nothing
+        decodes there (parked requests hold pages, not iterations), so
+        the chunk-interleave term is structurally zero."""
+        own = max(0, int(prompt_len) - max(0, int(shared_tokens)))
         backlog = self.queued_prefill_tokens()
         total = own + backlog
         per_tok = self._ewma_prefill_s_per_tok
@@ -1297,7 +1648,11 @@ class ContinuousBatcher:
                 t += (int(prompt_len) + backlog) * draft_per_tok
         chunk = self.prefill_chunk_tokens
         iter_s = self._ewma_decode_iter_s
-        if chunk and iter_s is not None:
+        if own == 0 and iter_s is not None:
+            # fully materialized KV: its first emission rides the next
+            # decode wall — the only latency it is honestly owed
+            t += iter_s
+        if chunk and iter_s is not None and self.role != "prefill":
             with self._cv:
                 interleaved = len(self._queue) > 0 or any(
                     s is not None and s.req.state is RequestState.DECODE
@@ -1353,9 +1708,12 @@ class ContinuousBatcher:
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
             queued = len(self._queue)
+            parked = len(self._parked)
         out = {
             "queue_depth": queued,
             "slots_active": active,
+            "role": self.role,
+            "parked": parked,
             "completed": self._completed,
             "failed": self._failed,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
@@ -1401,15 +1759,20 @@ class ContinuousBatcher:
         try:
             while True:
                 with self._cv:
+                    # PARKED slots hold KV for the fleet handoff plane
+                    # but schedule nothing — they must not keep the loop
+                    # spinning hot, nor block a clean stop (stop() fails
+                    # them after the join)
                     while (self._running and not self._queue
-                           and not any(self._slots)
-                           and self._pending_resize is None):
+                           and not self._runnable_locked()
+                           and self._pending_resize is None
+                           and not self._pending_handoffs):
                         # an idle loop is a HEALTHY loop: stamp the
                         # heartbeat on every 0.1 s wake so the monitor
                         # can tell "no work" from "hung dispatch"
                         self._t_heartbeat = time.monotonic()
                         self._cv.wait(timeout=0.1)
-                    if not self._running and not any(self._slots):
+                    if not self._running and not self._runnable_locked():
                         break
                     running = self._running
 
@@ -1434,6 +1797,12 @@ class ContinuousBatcher:
                 if self._pending_resize is not None:
                     self._maybe_resize(tracer)
 
+                # 0b) disaggregated KV handoff steps (export parked
+                #     rows / import shipped ones) — scheduler thread
+                #     only, same donated-cache rule as the resize
+                if self._pending_handoffs:
+                    self._process_handoffs(tracer)
+
                 # 1) move queued requests into free slots (skipped once
                 #    stopping: queued requests fail fast in stop()). In
                 #    one-shot mode this runs the whole prefill; in chunked
@@ -1456,6 +1825,19 @@ class ContinuousBatcher:
                 toks = np.zeros(self.num_slots, np.int32)
                 pos = np.zeros(self.num_slots, np.int32)
                 keys = np.zeros((self.num_slots, 2), np.uint32)
+                for s in self._slots:
+                    if s is not None \
+                            and s.req.state is not RequestState.DECODE:
+                        # the decode dispatch writes one KV row at `pos`
+                        # for EVERY slot, active or not. An owned but
+                        # non-decoding slot (PARKED awaiting handoff,
+                        # mid-chunk PREFILL) must not take that dummy
+                        # write at row 0 of its live pages — aim it at
+                        # the slot's own next-write row instead: beyond
+                        # `filled`, never attended, and overwritten by
+                        # the slot's next real fill
+                        pos[s.slot] = min(int(s.pos),
+                                          self.pool.max_len - 1)
                 for s in active:
                     if s.shared and s.pos < s.shared:
                         # copy-on-write break: this decode writes inside
@@ -1927,7 +2309,11 @@ class ContinuousBatcher:
 
     def _first_token(self, s: _Slot, tok: int) -> None:
         """Prefill complete: the request starts decoding and its TTFT is
-        recorded, split by prefix-cache outcome."""
+        recorded, split by prefix-cache outcome. A prefill-only request
+        (disaggregated serving) PARKS instead: first token emitted, KV
+        resident, slot held — `on_parked` tells the fleet handoff plane;
+        if the hook itself fails, the request degrades to local decode
+        (zero-drop: a broken coordinator never strands traffic)."""
         req = s.req
         req.state = RequestState.DECODE
         req.t_first_token = time.monotonic()
@@ -1937,6 +2323,16 @@ class ContinuousBatcher:
             cache="hit" if req.cache_hit else "miss")
         self._sync_active_gauge()
         self._emit_token(s, tok)
+        if req.prefill_only and req.state is RequestState.DECODE:
+            with self._cv:
+                req.state = RequestState.PARKED
+                self._parked[req.id] = s
+            cb = self.on_parked
+            if cb is not None:
+                try:
+                    cb(req)
+                except Exception:
+                    self.resume_parked(req)
 
     def _emit_token(self, s: _Slot, tok: int) -> None:
         """Deliver one generated token; retire the request when it hits
@@ -1954,6 +2350,8 @@ class ContinuousBatcher:
 
     def _retire(self, s: _Slot) -> None:
         self._slots[s.slot] = None
+        with self._cv:
+            self._parked.pop(s.req.id, None)
         self.pool.free(s.req.id)
         self.admission.release(s.req.id)
         self._completed += 1
@@ -1986,6 +2384,7 @@ class ContinuousBatcher:
         with self._cv:
             self._running = False
             slots, self._slots = list(self._slots), [None] * self.num_slots
+            self._parked.clear()
         for s in slots:
             if s is None:
                 continue
@@ -1996,3 +2395,4 @@ class ContinuousBatcher:
             s.req._fail(err)
         self._drain_queue(err)
         self._fail_pending_resize(err)
+        self._fail_pending_handoffs(err)

@@ -60,6 +60,24 @@ class PoolExhausted(RuntimeError):
     bypassed the controller's page reservation."""
 
 
+class KVGeometryMismatch(ValueError):
+    """An exported sequence cannot land in this pool: the importer's page
+    geometry differs from the exporter's. Page ids are meaningful only
+    under one (page_size, max_len) regime — importing across a mismatch
+    would silently misalign every block boundary, so the disaggregated
+    handoff plane treats this as a typed, non-retryable routing error
+    (the fleet-level `add_replica` geometry check is advisory; THIS is
+    the enforcement point)."""
+
+    def __init__(self, field: str, exporter, importer):
+        self.field = field
+        self.exporter = exporter
+        self.importer = importer
+        super().__init__(
+            f"kv import geometry mismatch on {field!r}: exporter has"
+            f" {exporter}, importing pool has {importer}")
+
+
 def _chain_key(parent: bytes, block: np.ndarray) -> bytes:
     """Rolling hash over page-aligned token blocks: the key of block i is
     blake2b(key of block i-1, tokens of block i), so a prefix chain is
@@ -611,6 +629,62 @@ class PagedKVPool:
         self._g_total.set(self.total_pages, pool=self.label)
         self._sync_gauges()
         return moves
+
+    # -- cross-pool handoff (disaggregated serving) ------------------------
+    def export_sequence(self, seq_id) -> Dict[str, object]:
+        """Snapshot a live sequence's page-table state for a cross-pool
+        KV handoff (docs/serving.md "Disaggregated serving"). Read-only:
+        the sequence stays allocated here — including any prefix-cache
+        pins it holds — until the caller `free()`s it after the import
+        commits, so a failed handoff leaves the exporter untouched. The
+        descriptor carries the full geometry the importer must match
+        (`import_sequence` enforces it) plus the owned row spans the
+        device copy must ship and nothing else (`owned_view`)."""
+        with self._lock:
+            ent = self._table.get(seq_id)
+            if ent is None:
+                raise KeyError(f"sequence {seq_id!r} not allocated")
+            n_tokens = self._tokens[seq_id]
+            n_pages = len(ent[1])
+        return {
+            "seq_id": seq_id,
+            "n_tokens": int(n_tokens),
+            "n_pages": int(n_pages),
+            "page_size": self.page_size,
+            "max_len": self.max_len,
+            "spans": self.owned_view(seq_id),
+        }
+
+    def import_sequence(self, desc: Dict[str, object],
+                        seq_id=None) -> int:
+        """Admit an exported sequence into THIS pool: geometry-checked
+        slot + page allocation, symmetric to the exporter's accounting —
+        the pages claimed here equal the pages the exporter reported, so
+        fleet-wide `pages_used` is conserved across a handoff once the
+        source side frees. Raises `KVGeometryMismatch` (typed,
+        non-retryable) when the descriptor's page regime differs from
+        this pool's, `PoolExhausted` when no slot is free (retryable on
+        a sibling). The import takes NO prefix-cache pins and touches no
+        band accounting: the shipped rows become the sequence's private
+        materialized copy, exactly like a post-install slot — the
+        exporter's pins die with its `free()`, keeping band refcounts
+        symmetric."""
+        sid = seq_id if seq_id is not None else desc["seq_id"]
+        if int(desc["page_size"]) != self.page_size:
+            raise KVGeometryMismatch(
+                "page_size", desc["page_size"], self.page_size)
+        if int(desc["n_tokens"]) > self.max_len:
+            raise KVGeometryMismatch(
+                "max_len", f"{desc['n_tokens']} live tokens"
+                f" (max_len {desc['max_len']})", self.max_len)
+        slot = self.alloc(sid, int(desc["n_tokens"]))
+        got = len(self.pages_of(sid))
+        if got != int(desc["n_pages"]):
+            # same page_size + n_tokens must yield the same page count;
+            # a divergence means the descriptor lied — undo and refuse
+            self.free(sid)
+            raise KVGeometryMismatch("n_pages", desc["n_pages"], got)
+        return slot
 
     # -- accounting --------------------------------------------------------
     def slot_of(self, seq_id) -> Optional[int]:
